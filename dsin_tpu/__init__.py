@@ -1,0 +1,22 @@
+"""dsin_tpu — a TPU-native framework for decoder-side-information image compression.
+
+A from-scratch JAX/XLA re-design of the capabilities of ayziksha/DSIN
+(ECCV 2020, "Deep Image Compression using Decoder Side Information"):
+a learned lossy codec whose decoder exploits a correlated side image the
+encoder never sees.
+
+Design principles (TPU-first, not a port):
+  * NHWC layouts everywhere (TPU native), bfloat16-friendly compute paths.
+  * One jitted train step — no feed_dicts, no separate "create y_dec" pass;
+    the whole DSIN pipeline (encode -> quantize -> decode -> patch search ->
+    fusion -> entropy model -> losses -> grads) is a single XLA program.
+  * Batched by construction: the reference forces batch=1 whenever the
+    side-information path is on (reference AE.py:26); here the SI search is
+    vmapped and the train step is sharded over a `jax.sharding.Mesh`.
+  * Static shapes, `lax` control flow, XLA fusion; Pallas for the hot
+    correlation kernel.
+"""
+
+__version__ = "0.1.0"
+
+from dsin_tpu.config import Config, parse_config, parse_config_file  # noqa: F401

@@ -1,0 +1,1 @@
+from dsin_tpu.data.manifest import read_pair_manifest  # noqa: F401

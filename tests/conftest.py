@@ -1,0 +1,14 @@
+"""Test harness config: force an 8-device virtual CPU platform.
+
+Multi-chip sharding is validated on a virtual CPU mesh (no TPU pod in CI);
+the flags must be set before jax initializes, hence this conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,107 @@
+import pytest
+
+from dsin_tpu.config import Config, ConfigError, parse_config, parse_config_file
+
+
+def test_parse_literals_and_comments():
+    cfg = parse_config(
+        """
+        # a comment
+        iterations = 300000
+        crop_size = (320, 960)  # inline comment
+        lr_initial = 1e-4
+        name = 'model # not a comment'
+        do_flips = True
+        nothing = None
+        H_target = 2*0.02
+        """)
+    assert cfg.iterations == 300000
+    assert cfg.crop_size == (320, 960)
+    assert cfg.lr_initial == 1e-4
+    assert cfg.name == "model # not a comment"
+    assert cfg.do_flips is True
+    assert cfg.nothing is None
+    assert cfg.H_target == pytest.approx(0.04)
+
+
+def test_constrain_enforced():
+    cfg = parse_config(
+        """
+        constrain lr_schedule :: FIXED, DECAY
+        lr_schedule = 'DECAY'
+        """)
+    assert cfg.lr_schedule == "DECAY"
+    with pytest.raises(ConfigError):
+        parse_config(
+            """
+            constrain lr_schedule :: FIXED, DECAY
+            lr_schedule = 'LINEAR'
+            """)
+
+
+def test_bare_identifier_is_string():
+    cfg = parse_config("arch = CVPR\n")
+    assert cfg.arch == "CVPR"
+
+
+def test_set_respects_constraints():
+    cfg = parse_config("constrain opt :: ADAM, SGD\nopt = 'ADAM'\n")
+    cfg.opt = "SGD"
+    with pytest.raises(ConfigError):
+        cfg.opt = "LION"
+
+
+def test_replace_returns_copy():
+    cfg = parse_config("a = 1\nb = 2\n")
+    cfg2 = cfg.replace(a=10)
+    assert cfg.a == 1 and cfg2.a == 10 and cfg2.b == 2
+
+
+def test_missing_key_raises_attribute_error():
+    cfg = parse_config("a = 1\n")
+    with pytest.raises(AttributeError):
+        _ = cfg.zzz
+
+
+def test_snapshot_roundtrip():
+    cfg = parse_config(
+        """
+        constrain norm :: OFF, FIXED
+        norm = 'FIXED'
+        crop = (320, 960)
+        lr = 1e-4
+        flag = False
+        """)
+    again = parse_config(str(cfg))
+    assert again.to_dict() == cfg.to_dict()
+
+
+def test_shipped_configs_parse(tmp_path):
+    import dsin_tpu
+    import os
+    base = os.path.join(os.path.dirname(dsin_tpu.__file__), "configs")
+    ae = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
+    pc = parse_config_file(os.path.join(base, "pc_default"))
+    assert ae.arch == "CVPR"
+    assert ae.num_chan_bn == 32
+    assert ae.H_target == pytest.approx(0.04)
+    assert ae.y_patch_size == (20, 24)
+    assert pc.arch == "res_shallow"
+    assert pc.kernel_size == 3
+    assert pc.arch_param__k == 24
+    assert pc.regularization_factor is None
+    # snapshot roundtrip of real configs
+    assert parse_config(str(ae)).to_dict() == ae.to_dict()
+
+
+def test_pair_manifest(tmp_path):
+    from dsin_tpu.data.manifest import num_pairs, read_pair_manifest
+    m = tmp_path / "pairs.txt"
+    m.write_text("a/x1.png\na/y1.png\nb/x2.png\nb/y2.png\n")
+    pairs = read_pair_manifest(str(m), root="/data")
+    assert pairs == [("/data/a/x1.png", "/data/a/y1.png"),
+                     ("/data/b/x2.png", "/data/b/y2.png")]
+    assert num_pairs(str(m)) == 2
+    m.write_text("a\nb\nc\n")
+    with pytest.raises(ValueError):
+        read_pair_manifest(str(m))
