@@ -1,0 +1,92 @@
+"""Benchmark: full-DSIN training throughput on the real TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "train_images_per_sec", "value": N, "unit": "images/sec",
+   "vs_baseline": R}
+
+Measures the complete DSIN training step (encoder + decoder + y_dec
+synthesis + siFinder correlation search + siNet fusion + probclass entropy
+model + backward + optimizer) at the reference operating point: crop
+320x960, patch 20x24, C=32, B=5, L=6 (reference ae_run_configs).
+
+vs_baseline: the reference publishes no throughput numbers (BASELINE.md);
+the denominator is our documented estimate of the reference's V100 training
+throughput (3 sess.run round trips per iteration at batch 1). Until a
+measured V100 number exists, V100_BASELINE_IMG_PER_SEC below is an assumed
+constant — the north star is >= 1.5x it (BASELINE.json).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Assumed reference throughput (tensorflow-gpu 1.11, V100, batch 1, the
+# 3-forward+1-backward step of reference AE.py:108-118). Documented
+# assumption, not a measurement — see module docstring.
+V100_BASELINE_IMG_PER_SEC = 3.0
+
+CROP_H, CROP_W = 320, 960
+PATCH_H, PATCH_W = 20, 24
+BATCH = int(os.environ.get("BENCH_BATCH", "2"))
+WARMUP = 3
+ITERS = int(os.environ.get("BENCH_ITERS", "10"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    from dsin_tpu.train import optim as optim_lib
+    from dsin_tpu.train import step as step_lib
+
+    base = os.path.join(os.path.dirname(__file__), "dsin_tpu", "configs")
+    ae_cfg = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
+    ae_cfg = ae_cfg.replace(batch_size=BATCH, crop_size=(CROP_H, CROP_W),
+                            AE_only=False, load_model=False, train_model=True,
+                            test_model=False)
+    pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
+
+    model = DSIN(ae_cfg, pc_cfg)
+    shape = (BATCH, CROP_H, CROP_W, 3)
+    variables = model.init_variables(jax.random.PRNGKey(0), shape)
+    tx = optim_lib.build_optimizer(variables.params, ae_cfg, pc_cfg,
+                                   num_training_imgs=1576)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0), shape,
+                                        tx)
+    mask = jnp.asarray(gaussian_position_mask(CROP_H, CROP_W, PATCH_H,
+                                              PATCH_W))
+    train_step = step_lib.make_train_step(model, tx, si_mask=mask,
+                                          donate=True)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 255, shape).astype(np.float32))
+    y = jnp.asarray(np.clip(
+        np.asarray(x) + rng.normal(0, 4, shape), 0, 255).astype(np.float32))
+
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = train_step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "train_images_per_sec",
+        "value": round(imgs_per_sec, 3),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / V100_BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,203 @@
+"""CVPR-style convolutional autoencoder (flax, NHWC, TPU-first).
+
+Capability parity with the reference `_CVPR` architecture (reference
+autoencoder_imgcomp.py:214-269): encoder = two stride-2 5x5 convs
+(n/2 then n=128) -> B groups of three 2-conv residual blocks with a group
+skip -> one final residual block + outer skip -> stride-2 5x5 conv to the
+bottleneck (C channels + 1 learned heatmap channel); decoder mirrors it with
+stride-2 transposed convs. Batch norm (decay .9, eps 1e-5, scaled) follows
+every conv, including the bottleneck and output convs, exactly as slim's
+arg_scope applies it in the reference (autoencoder_imgcomp.py:106-125).
+Subsampling factor 8 (autoencoder_imgcomp.py:216-217).
+
+The bottleneck heatmap gating (autoencoder_imgcomp.py:172-201): channel 0 ->
+sigmoid * C -> per-channel ramp mask clip(h - c, 0, 1) multiplied into the
+remaining C channels, letting the network spend bits only where needed.
+
+Layout is NHWC (TPU native); the reference's NCHW is a GPU-era choice.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsin_tpu.models import quantizer as quantizer_lib
+
+ARCH_PARAM_N = 128  # reference autoencoder_imgcomp.py:211
+
+# KITTI RGB statistics (reference autoencoder_imgcomp.py:160-170)
+KITTI_MEAN = np.array([93.70454143384742, 98.28243432206516,
+                       94.84678088809876], dtype=np.float32)
+KITTI_VAR = np.array([5411.79935676, 5758.60456747, 5890.31451232],
+                     dtype=np.float32)
+
+
+class EncoderOutput(NamedTuple):
+    qbar: jnp.ndarray                 # quantized bottleneck (STE)
+    qhard: jnp.ndarray
+    symbols: jnp.ndarray              # int32 (N, Hb, Wb, C)
+    z: jnp.ndarray                    # pre-quantization bottleneck
+    heatmap: Optional[jnp.ndarray]    # (N, Hb, Wb, C) in [0, 1] or None
+
+
+def normalize_image(x: jnp.ndarray, style: str) -> jnp.ndarray:
+    if style == "OFF":
+        return x
+    if style == "FIXED":
+        return (x - KITTI_MEAN) / np.sqrt(KITTI_VAR + 1e-10)
+    raise ValueError(f"invalid normalization style {style!r}")
+
+
+def denormalize_image(x: jnp.ndarray, style: str) -> jnp.ndarray:
+    if style == "OFF":
+        return x
+    if style == "FIXED":
+        return x * np.sqrt(KITTI_VAR + 1e-10) + KITTI_MEAN
+    raise ValueError(f"invalid normalization style {style!r}")
+
+
+def heatmap3d(bottleneck: jnp.ndarray) -> jnp.ndarray:
+    """Per-channel ramp mask from the heatmap channel (channel 0).
+
+    bottleneck: (N, H, W, C+1) -> mask (N, H, W, C) with
+    mask[..., c] = clip(sigmoid(b[..., 0]) * C - c, 0, 1).
+    """
+    c_total = bottleneck.shape[-1] - 1
+    heat2d = jax.nn.sigmoid(bottleneck[..., 0]) * c_total        # (N, H, W)
+    ramp = jnp.arange(c_total, dtype=jnp.float32)                # (C,)
+    return jnp.clip(heat2d[..., None] - ramp, 0.0, 1.0)
+
+
+_BN_KW = dict(momentum=0.9, epsilon=1e-5, use_scale=True, use_bias=True)
+
+
+class _ConvBN(nn.Module):
+    """Conv + batch norm (+ optional relu), slim-arg_scope style."""
+    features: int
+    kernel: int
+    stride: int = 1
+    relu: bool = True
+    transpose: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv_cls = nn.ConvTranspose if self.transpose else nn.Conv
+        x = conv_cls(self.features, (self.kernel, self.kernel),
+                     strides=(self.stride, self.stride), padding="SAME",
+                     use_bias=False,
+                     kernel_init=nn.initializers.xavier_uniform())(x)
+        x = nn.BatchNorm(use_running_average=not train, **_BN_KW)(x)
+        if self.relu:
+            x = nn.relu(x)
+        return x
+
+
+class _ResBlock(nn.Module):
+    """Two 3x3 conv+BN; relu after the first only (unless relu_first=False);
+    residual add (reference autoencoder_imgcomp.py:275-288)."""
+    features: int
+    relu_first: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        inp = x
+        x = _ConvBN(self.features, 3, relu=self.relu_first)(x, train)
+        x = _ConvBN(self.features, 3, relu=False)(x, train)
+        return x + inp
+
+
+class _ResGroupStack(nn.Module):
+    """B groups of three residual blocks, each group with its own skip,
+    followed by a no-activation residual block and an outer skip
+    (reference autoencoder_imgcomp.py:226-235, 253-263)."""
+    features: int
+    num_groups: int
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        outer = x
+        for _ in range(self.num_groups):
+            inner = x
+            for _ in range(3):
+                x = _ResBlock(self.features)(x, train)
+            x = x + inner
+        x = _ResBlock(self.features, relu_first=False)(x, train)
+        return x + outer
+
+
+class Encoder(nn.Module):
+    """Image (N, H, W, 3) in [0,255] -> bottleneck (N, H/8, W/8, C(+1))."""
+    config: object  # ae config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        n = ARCH_PARAM_N
+        cfg = self.config
+        x = normalize_image(x, cfg.normalization)
+        x = _ConvBN(n // 2, 5, stride=2)(x, train)
+        x = _ConvBN(n, 5, stride=2)(x, train)
+        x = _ResGroupStack(n, cfg.arch_param_B)(x, train)
+        c_out = cfg.num_chan_bn + 1 if cfg.heatmap else cfg.num_chan_bn
+        x = _ConvBN(c_out, 5, stride=2, relu=False)(x, train)
+        return x
+
+
+class Decoder(nn.Module):
+    """Quantized bottleneck (N, H/8, W/8, C) -> image (N, H, W, 3) in [0,255]."""
+    config: object
+
+    @nn.compact
+    def __call__(self, q, train: bool):
+        n = ARCH_PARAM_N
+        cfg = self.config
+        x = _ConvBN(n, 3, stride=2, transpose=True)(q, train)
+        x = _ResGroupStack(n, cfg.arch_param_B)(x, train)
+        x = _ConvBN(n // 2, 5, stride=2, transpose=True)(x, train)
+        x = _ConvBN(3, 5, stride=2, transpose=True, relu=False)(x, train)
+        x = denormalize_image(x, cfg.normalization)
+        return jnp.clip(x, 0.0, 255.0)
+
+
+SUBSAMPLING_FACTOR = 8
+
+
+def encode(encoder: Encoder, variables, x, centers, train: bool,
+           mutable=False):
+    """Run the encoder + heatmap gating + quantization.
+
+    Returns (EncoderOutput, new_batch_stats_or_None).
+    """
+    if train:
+        # train-mode BN always computes batch stats and proposes updated
+        # running averages; the caller decides whether to keep them
+        # (bn_stats='frozen' replicates the reference's never-updated stats)
+        bottleneck, mut = encoder.apply(variables, x, train,
+                                        mutable=["batch_stats"])
+        if not mutable:
+            mut = None
+    else:
+        bottleneck, mut = encoder.apply(variables, x, train), None
+
+    cfg = encoder.config
+    if cfg.heatmap:
+        heat = heatmap3d(bottleneck)
+        z = heat * bottleneck[..., 1:]
+    else:
+        heat = None
+        z = bottleneck
+    qout = quantizer_lib.quantize(z, centers, sigma=1.0)
+    return EncoderOutput(qbar=qout.qbar, qhard=qout.qhard,
+                         symbols=qout.symbols, z=z, heatmap=heat), mut
+
+
+def decode(decoder: Decoder, variables, q, train: bool, mutable=False):
+    """Run the decoder. Returns (x_out, new_batch_stats_or_None)."""
+    if train:
+        out, mut = decoder.apply(variables, q, train, mutable=["batch_stats"])
+        return out, (mut if mutable else None)
+    return decoder.apply(variables, q, train), None

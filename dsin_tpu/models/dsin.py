@@ -1,0 +1,118 @@
+"""DSIN model bundle: autoencoder + entropy model (+ SI path).
+
+Owns the module instances and the parameter partitioning that the whole
+framework (train step, checkpointing, optimizer labeling) relies on:
+
+    params = {'encoder': ..., 'decoder': ..., 'centers': ...,
+              'probclass': ..., 'sinet': ...}          (sinet iff not AE_only)
+    batch_stats = {'encoder': ..., 'decoder': ...}
+
+This mirrors the reference's TF variable scopes ('encoder/encoder_body',
+'decoder', 'imgcomp', 'siNetwork'; reference AE.py:158-175) so the 3-phase
+workflow (train AE_only -> warm-start + train siNet -> inference) keeps its
+partial-checkpoint semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dsin_tpu.models import autoencoder as ae_lib
+from dsin_tpu.models import probclass as pc_lib
+from dsin_tpu.models import quantizer as quant_lib
+
+
+class DSINVariables(NamedTuple):
+    params: Dict[str, Any]
+    batch_stats: Dict[str, Any]
+
+
+class DSIN:
+    """Module bundle + pure forward helpers (no state held here)."""
+
+    def __init__(self, ae_config, pc_config):
+        self.ae_config = ae_config
+        self.pc_config = pc_config
+        self.encoder = ae_lib.Encoder(ae_config)
+        self.decoder = ae_lib.Decoder(ae_config)
+        self.probclass = pc_lib.get_network_cls(pc_config)(
+            pc_config, num_centers=ae_config.num_centers)
+        self.ae_only = bool(ae_config.AE_only)
+        self.si_weight = 0.0 if self.ae_only else ae_config.si_weight
+        if not self.ae_only:
+            from dsin_tpu.models.sinet import SiNet
+            self.sinet = SiNet()
+        else:
+            self.sinet = None
+
+    # -- initialization -----------------------------------------------------
+
+    def init_variables(self, rng: jax.Array,
+                       input_shape: Tuple[int, int, int, int]) -> DSINVariables:
+        """Build the partitioned params/batch_stats trees for `input_shape`
+        = (N, H, W, 3)."""
+        k_enc, k_dec, k_pc, k_centers, k_sinet = jax.random.split(rng, 5)
+        x = jnp.zeros(input_shape, jnp.float32)
+
+        enc_vars = self.encoder.init(k_enc, x, True)
+        centers = quant_lib.init_centers(
+            k_centers, self.ae_config.num_centers,
+            self.ae_config.centers_initial_range)
+        enc_out, _ = ae_lib.encode(self.encoder, enc_vars, x, centers,
+                                   train=True)
+        dec_vars = self.decoder.init(k_dec, enc_out.qbar, True)
+
+        vol = pc_lib.pad_volume(
+            jnp.transpose(enc_out.qbar, (0, 3, 1, 2))[..., None],
+            self.pc_config.kernel_size, 0.0)
+        pc_vars = self.probclass.init(k_pc, vol)
+
+        params = {
+            "encoder": enc_vars["params"],
+            "decoder": dec_vars["params"],
+            "centers": centers,
+            "probclass": pc_vars["params"],
+        }
+        batch_stats = {
+            "encoder": enc_vars["batch_stats"],
+            "decoder": dec_vars["batch_stats"],
+        }
+        if self.sinet is not None:
+            si_in = jnp.zeros(input_shape[:3] + (6,), jnp.float32)
+            sinet_vars = self.sinet.init(k_sinet, si_in)
+            params["sinet"] = sinet_vars["params"]
+        return DSINVariables(params=params, batch_stats=batch_stats)
+
+    # -- forward pieces -----------------------------------------------------
+
+    def encode(self, params, batch_stats, x, train: bool, mutable: bool = False):
+        enc_vars = {"params": params["encoder"],
+                    "batch_stats": batch_stats["encoder"]}
+        return ae_lib.encode(self.encoder, enc_vars, x, params["centers"],
+                             train=train, mutable=mutable)
+
+    def decode(self, params, batch_stats, q, train: bool, mutable: bool = False):
+        dec_vars = {"params": params["decoder"],
+                    "batch_stats": batch_stats["decoder"]}
+        return ae_lib.decode(self.decoder, dec_vars, q, train=train,
+                             mutable=mutable)
+
+    def bitcost(self, params, q, symbols):
+        pad = pc_lib.auto_pad_value(self.pc_config, params["centers"])
+        return pc_lib.bitcost(self.probclass, {"params": params["probclass"]},
+                              q, symbols, pad_value=pad)
+
+    def apply_sinet(self, params, x_dec, y_syn):
+        """Fuse the decoded image with the synthesized side image
+        (reference AE.py:63-69): 6-channel normalized concat, stop-gradient
+        on the y_syn branch, denormalized 3-channel output."""
+        style = self.ae_config.normalization
+        concat = jnp.concatenate(
+            [ae_lib.normalize_image(x_dec, style),
+             jax.lax.stop_gradient(ae_lib.normalize_image(y_syn, style))],
+            axis=-1)
+        out = self.sinet.apply({"params": params["sinet"]}, concat)
+        return ae_lib.denormalize_image(out, style)

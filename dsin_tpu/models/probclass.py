@@ -1,0 +1,160 @@
+"""Causal 3-D context model ("probclass") estimating symbol entropy.
+
+Capability parity with the reference `_ResShallow` (reference
+probclass_imgcomp.py:27-221): the quantized bottleneck is treated as a 3-D
+volume over (channel-depth D, H, W) with one feature channel; a stack of
+VALID masked 3-D convolutions (filter DHW = (K//2+1, K, K)) predicts, for
+every symbol, a distribution over the L quantizer centers from its causal
+context only:
+
+* `first_mask` zeroes the center tap and everything after it in raster order
+  within the last depth slice (probclass_imgcomp.py:150-162);
+* `other_mask` keeps the center tap (163-176);
+* the volume is padded `pad = context//2` in front (depth), left/right and
+  top/bottom — never behind in depth ("the future is not seen by any
+  filter", probclass_imgcomp.py:285-292) — with `centers[0]` when
+  `use_centers_for_padding` (pc config);
+* residual blocks re-align the VALID-conv shrinkage by cropping the skip
+  input `[2:, 2:-2, 2:-2]` (probclass_imgcomp.py:196);
+* bitcost = cross-entropy(logits, symbols) * log2(e)  [bits per symbol]
+  (probclass_imgcomp.py:100-106).
+
+Layout note: framework tensors are NHWC; this module transposes to the
+(N, D=C, H, W, 1) volume internally. Depth stays a real spatial axis of the
+conv (that is the causality structure), H/W tiles map onto the MXU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def context_size(kernel_size: int, num_layers: int = 4) -> int:
+    """Receptive-field width: num_layers*(K-1) + 1 (reference :43-52)."""
+    return num_layers * (kernel_size - 1) + 1
+
+
+def context_shape(kernel_size: int):
+    """(D, H, W) receptive field (reference :18-24)."""
+    cs = context_size(kernel_size)
+    return cs // 2 + 1, cs, cs
+
+
+def filter_shape(kernel_size: int):
+    """(D, H, W) of each conv filter (reference :145-148)."""
+    return kernel_size // 2 + 1, kernel_size, kernel_size
+
+
+def make_mask(kernel_size: int, include_center: bool) -> np.ndarray:
+    """Causality mask over the (D, H, W) filter.
+
+    In the last depth slice: zero all rows below the center row and, in the
+    center row, everything right of the center — plus the center tap itself
+    for the first layer (include_center=False).
+    """
+    d, h, w = filter_shape(kernel_size)
+    mask = np.ones((d, h, w), dtype=np.float32)
+    ch, cw = kernel_size // 2, kernel_size // 2
+    start = cw + 1 if include_center else cw
+    mask[-1, ch, start:] = 0.0
+    mask[-1, ch + 1:, :] = 0.0
+    return mask
+
+
+def pad_volume(vol: jnp.ndarray, kernel_size: int, pad_value) -> jnp.ndarray:
+    """Pad (N, D, H, W, 1): depth front only, H/W both sides, by context//2."""
+    pad = context_size(kernel_size) // 2
+    assert pad >= 1
+    cfg = ((0, 0), (pad, 0), (pad, pad), (pad, pad), (0, 0))
+    # The pad value may be a traced scalar (centers[0]) whose gradient must
+    # flow; lax.pad's transpose rule drops the padding-value cotangent, so
+    # pad with zeros and add pad_value through the complement mask instead.
+    pv = jnp.asarray(pad_value, dtype=vol.dtype)
+    padded = jnp.pad(vol, cfg)
+    interior = jnp.pad(jnp.ones_like(vol), cfg)
+    return padded + (1.0 - interior) * pv
+
+
+class _MaskedConv3D(nn.Module):
+    """VALID 3-D conv with a fixed causality mask multiplied into the weights."""
+    features: int
+    kernel_size: int
+    include_center: bool
+
+    @nn.compact
+    def __call__(self, x):  # x: (N, D, H, W, F)
+        fs = filter_shape(self.kernel_size)
+        in_feat = x.shape[-1]
+        w = self.param("kernel", nn.initializers.xavier_uniform(),
+                       fs + (in_feat, self.features), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (self.features,),
+                       jnp.float32)
+        mask = jnp.asarray(make_mask(self.kernel_size, self.include_center))
+        w = w * mask[..., None, None]
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding="VALID",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        return out + b
+
+
+class ResShallow(nn.Module):
+    """conv0(first_mask) -> 1 residual block -> conv to L logits."""
+    config: object      # pc config
+    num_centers: int    # L
+
+    @nn.compact
+    def __call__(self, vol):  # vol: (N, D, H, W, 1) padded volume
+        k = self.config.arch_param__k
+        ks = self.config.kernel_size
+        net = _MaskedConv3D(k, ks, include_center=False)(vol)
+        net = nn.relu(net)
+        # residual block (2 masked convs, relu between, cropped skip)
+        inp = net
+        net = nn.relu(_MaskedConv3D(k, ks, include_center=True)(net))
+        net = _MaskedConv3D(k, ks, include_center=True)(net)
+        net = net + inp[:, 2:, 2:-2, 2:-2, :]
+        net = _MaskedConv3D(self.num_centers, ks, include_center=True)(net)
+        # the reference's conv3d applies its default ReLU even to this final
+        # logits layer (probclass_imgcomp.py:220,234,260) — logits are >= 0
+        return nn.relu(net)  # (N, D, H, W, L) logits
+
+
+def get_network_cls(pc_config):
+    return {"res_shallow": ResShallow}[pc_config.arch]
+
+
+def auto_pad_value(pc_config, centers: jnp.ndarray):
+    """centers[0] when use_centers_for_padding else 0 (reference :59-61)."""
+    return centers[0] if pc_config.use_centers_for_padding else 0.0
+
+
+def logits_from_q(model: ResShallow, variables, q_nhwc: jnp.ndarray,
+                  pad_value) -> jnp.ndarray:
+    """q (N, H, W, C) -> causal logits (N, H, W, C, L)."""
+    vol = jnp.transpose(q_nhwc, (0, 3, 1, 2))[..., None]  # (N, D=C, H, W, 1)
+    vol = pad_volume(vol, model.config.kernel_size, pad_value)
+    logits = model.apply(variables, vol)                  # (N, D, H, W, L)
+    return jnp.transpose(logits, (0, 2, 3, 1, 4))         # (N, H, W, C, L)
+
+
+def bitcost(model: ResShallow, variables, q_nhwc: jnp.ndarray,
+            symbols_nhwc: jnp.ndarray, pad_value) -> jnp.ndarray:
+    """Bits per symbol, shape (N, H, W, C) (reference :63-106)."""
+    logits = logits_from_q(model, variables, q_nhwc, pad_value)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, symbols_nhwc[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    return nll * np.log2(np.e)
+
+
+def bitcost_to_bpp(bit_cost: jnp.ndarray, input_batch: jnp.ndarray):
+    """Total bits / total image pixels (reference bits_imgcomp.py:4-21).
+
+    bit_cost: (N, H, W, C) over bottleneck positions; input_batch: (N, H, W, 3).
+    """
+    num_bits = jnp.sum(bit_cost)
+    num_pixels = input_batch.size // input_batch.shape[-1]
+    return num_bits / num_pixels

@@ -1,0 +1,59 @@
+"""Soft-to-hard scalar quantizer with straight-through estimator.
+
+Capability parity with the reference quantizer (reference
+quantizer_imgcomp.py:37-100): L learned scalar centers; soft assignment
+softmax(-sigma * |x - c|^2) for gradients, hard assignment argmin |x - c| for
+the forward value, STE `qbar = qsoft + stop_grad(qhard - qsoft)`
+(reference autoencoder_imgcomp.py:127-134).
+
+TPU-first notes: the distance tensor broadcasts to (..., L) with L=6 — tiny
+trailing axis; XLA fuses the softmax/argmax chain into the surrounding ops so
+nothing materializes in HBM. No reshape to (B, C, m, 1) is needed (the
+reference's reshape is a TF broadcasting workaround).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+HARD_SIGMA = 1e7  # reference quantizer_imgcomp.py:5
+
+
+class QuantizerOutput(NamedTuple):
+    qbar: jnp.ndarray     # STE value: hard forward, soft backward
+    qsoft: jnp.ndarray    # soft (differentiable) quantization
+    qhard: jnp.ndarray    # nearest-center value
+    symbols: jnp.ndarray  # int32 center indices
+
+
+def init_centers(rng: jax.Array, num_centers: int,
+                 initial_range=(-2, 2)) -> jnp.ndarray:
+    """Uniform init over `initial_range` (reference quantizer_imgcomp.py:28-31)."""
+    minval, maxval = initial_range
+    return jax.random.uniform(rng, (num_centers,), jnp.float32,
+                              float(minval), float(maxval))
+
+
+def quantize(x: jnp.ndarray, centers: jnp.ndarray,
+             sigma: float = 1.0) -> QuantizerOutput:
+    """Quantize `x` (any shape) against `centers` (L,).
+
+    Returns qsoft/qhard/qbar of x's shape and int32 symbols.
+    """
+    assert centers.ndim == 1, centers.shape
+    dist = jnp.square(x[..., None] - centers)          # (..., L)
+    phi_soft = jax.nn.softmax(-sigma * dist, axis=-1)  # (..., L)
+    symbols = jnp.argmin(dist, axis=-1)                # (...)
+    qsoft = jnp.sum(phi_soft * centers, axis=-1)
+    qhard = centers[symbols]
+    qbar = qsoft + jax.lax.stop_gradient(qhard - qsoft)
+    return QuantizerOutput(qbar=qbar, qsoft=qsoft, qhard=qhard,
+                           symbols=symbols.astype(jnp.int32))
+
+
+def centers_regularization(centers: jnp.ndarray, factor: float) -> jnp.ndarray:
+    """L2 on the centers: factor * sum(c^2)/2 (reference quantizer_imgcomp.py:18-24)."""
+    return factor * 0.5 * jnp.sum(jnp.square(centers))

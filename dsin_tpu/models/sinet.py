@@ -1,0 +1,49 @@
+"""siNet: dilated-convolution fusion network.
+
+Capability parity with the reference siNet (reference siNet.py:29-41): a
+context-aggregation net — nine 3x3 conv layers, 32 channels, dilation rates
+1,2,4,8,16,32,64,128,1, leaky-relu(0.2), identity-initialized, *no*
+normalization — followed by a 1x1 conv to 3 channels. Input is the
+6-channel concat of normalized (x_dec, y_syn); output is the normalized
+residual image, denormalized by the caller (reference AE.py:63-69).
+
+NHWC layout; dilated 3x3 convs lower to efficient XLA window ops on TPU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+_DILATIONS = (1, 2, 4, 8, 16, 32, 64, 128, 1)
+
+
+def identity_kernel_init(key, shape, dtype=jnp.float32):
+    """Center-tap identity over matching in/out channels
+    (reference siNet.py:13-20)."""
+    kh, kw, cin, cout = shape
+    kernel = np.zeros(shape, dtype=np.float32)
+    ch, cw = kh // 2, kw // 2
+    for i in range(min(cin, cout)):
+        kernel[ch, cw, i, i] = 1.0
+    return jnp.asarray(kernel, dtype)
+
+
+class SiNet(nn.Module):
+    """(N, H, W, 6) normalized concat -> (N, H, W, 3) normalized output."""
+    features: int = 32
+    out_features: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        for i, rate in enumerate(_DILATIONS):
+            x = nn.Conv(self.features, (3, 3), padding="SAME",
+                        kernel_dilation=(rate, rate),
+                        kernel_init=identity_kernel_init,
+                        name=f"g_conv{i + 1}")(x)
+            x = nn.leaky_relu(x, negative_slope=0.2)
+        x = nn.Conv(self.out_features, (1, 1), padding="SAME",
+                    kernel_init=nn.initializers.xavier_uniform(),
+                    name="g_conv_last")(x)
+        return x

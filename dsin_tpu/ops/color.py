@@ -1,0 +1,74 @@
+"""Color transforms for the side-information patch search.
+
+Capability parity with the reference (reference siFinder.py:56-73,138-210):
+* `rgb_to_h1h2h3`: decorrelated channels H1=R+G, H2=R-G, H3=0.5*(R+B) used
+  for the Pearson search;
+* `rgb_to_lab`: CIELAB conversion used when `use_L2andLAB`;
+* `normalize_for_search`: per-channel KITTI mean/variance scaling (Pearson
+  mode) or [-1, 1] scaling (LAB mode).
+
+All functions take NHWC float tensors.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# KITTI per-channel mean and *std-scale* divisors used by the reference's
+# search normalization (reference siFinder.py:61-63 — note these are not the
+# AE normalization variances).
+SEARCH_MEANS = np.array([93.70454143384742, 98.28243432206516,
+                         94.84678088809876], dtype=np.float32)
+SEARCH_VARS = np.array([73.56493292844912, 75.88547006820752,
+                        76.74838442810665], dtype=np.float32)
+
+
+def rgb_to_h1h2h3(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3) RGB -> (R+G, R-G, 0.5*(R+B))."""
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    return jnp.stack([r + g, r - g, 0.5 * (r + b)], axis=-1)
+
+
+def normalize_for_search(x: jnp.ndarray, use_lab: bool) -> jnp.ndarray:
+    """Pre-search normalization (reference siFinder.py:56-73)."""
+    if use_lab:
+        return 2.0 * (jnp.clip(x, 0.0, 255.0) / 255.0 - 0.5)
+    return (x - SEARCH_MEANS) / SEARCH_VARS
+
+
+def search_transform(x: jnp.ndarray, use_lab: bool) -> jnp.ndarray:
+    """Full transform applied to both sides before correlation
+    (reference siFinder.py:13-17): LAB mode feeds the RAW [0,255] pixels to
+    rgb_to_lab (the reference never normalizes in its L2/LAB branch — its
+    [-1,1] scaling there is dead code); Pearson mode normalizes then maps to
+    H1H2H3."""
+    if use_lab:
+        return rgb_to_lab(x)
+    return rgb_to_h1h2h3(normalize_for_search(x, False))
+
+
+def rgb_to_lab(srgb: jnp.ndarray) -> jnp.ndarray:
+    """sRGB in [0, 1]-ish -> CIELAB (D65). Standard colorimetry pipeline."""
+    px = srgb.reshape(-1, 3)
+    linear = px / 12.92
+    exp = ((px + 0.055) / 1.055) ** 2.4
+    rgb_lin = jnp.where(px <= 0.04045, linear, exp)
+    rgb_to_xyz = jnp.asarray([
+        [0.412453, 0.212671, 0.019334],
+        [0.357580, 0.715160, 0.119193],
+        [0.180423, 0.072169, 0.950227],
+    ], dtype=srgb.dtype)
+    xyz = rgb_lin @ rgb_to_xyz
+    xyz = xyz * jnp.asarray([1 / 0.950456, 1.0, 1 / 1.088754],
+                            dtype=srgb.dtype)
+    eps = 6 / 29
+    f = jnp.where(xyz <= eps ** 3, xyz / (3 * eps ** 2) + 4 / 29,
+                  jnp.cbrt(xyz))
+    f_to_lab = jnp.asarray([
+        [0.0, 500.0, 0.0],
+        [116.0, -500.0, 200.0],
+        [0.0, 0.0, -200.0],
+    ], dtype=srgb.dtype)
+    lab = f @ f_to_lab + jnp.asarray([-16.0, 0.0, 0.0], dtype=srgb.dtype)
+    return lab.reshape(srgb.shape)

@@ -1,0 +1,91 @@
+"""Distortion metrics: MAE / MSE / PSNR with integer-cast semantics.
+
+Capability parity with the reference `Distortions` class
+(reference Distortions_imgcomp.py:7-111), re-expressed for NHWC JAX:
+
+* Images are float32 in [0, 255]. When a metric is *not* the one being
+  optimized (or when evaluating), both operands are truncated to int32
+  first so the reported error matches real-world quantized pixels
+  (reference Distortions_imgcomp.py:17-28).
+* Per-image means over (H, W, C), then a batch mean.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+def mae_per_image(x: jnp.ndarray, x_out: jnp.ndarray,
+                  cast_to_int: bool) -> jnp.ndarray:
+    """Mean absolute error per image. x, x_out: NHWC in [0, 255] -> (N,)."""
+    if cast_to_int:
+        x = x.astype(jnp.int32)
+        x_out = x_out.astype(jnp.int32)
+    err = jnp.abs(x_out - x).astype(jnp.float32)
+    return jnp.mean(err, axis=(1, 2, 3))
+
+
+def mse_per_image(x: jnp.ndarray, x_out: jnp.ndarray,
+                  cast_to_int: bool) -> jnp.ndarray:
+    """Mean squared error per image. x, x_out: NHWC in [0, 255] -> (N,)."""
+    if cast_to_int:
+        x = x.astype(jnp.int32)
+        x_out = x_out.astype(jnp.int32)
+    err = jnp.square(x_out - x).astype(jnp.float32)
+    return jnp.mean(err, axis=(1, 2, 3))
+
+
+def psnr_per_image(x: jnp.ndarray, x_out: jnp.ndarray,
+                   cast_to_int: bool) -> jnp.ndarray:
+    """PSNR (dB, max_val=255) per image -> (N,)."""
+    mse = mse_per_image(x, x_out, cast_to_int)
+    return 10.0 * jnp.log10(255.0 * 255.0 / mse)
+
+
+class Distortions(NamedTuple):
+    """Batch-mean distortions plus the scalar selected for minimization."""
+    mae: jnp.ndarray
+    mse: jnp.ndarray
+    psnr: jnp.ndarray
+    ms_ssim: Optional[jnp.ndarray]
+    d_loss_scaled: jnp.ndarray
+
+
+def compute_distortions(config, x: jnp.ndarray, x_out: jnp.ndarray,
+                        is_training: bool) -> Distortions:
+    """All metrics + the distortion term to minimize.
+
+    Follows the reference's cast rules: each metric casts to int unless it is
+    the one being trained on; at eval time everything casts
+    (reference Distortions_imgcomp.py:20-22, 43-55). MS-SSIM is only computed
+    when it is the optimization target (it is the most expensive metric).
+    """
+    minimize_for = config.distortion_to_minimize
+    assert minimize_for in ("mae", "mse", "psnr", "ms_ssim"), minimize_for
+
+    cast_psnr = (not is_training) or minimize_for != "psnr"
+    cast_mse = (not is_training) or minimize_for != "mse"
+    cast_mae = (not is_training) or minimize_for != "mae"
+
+    mae = jnp.mean(mae_per_image(x, x_out, cast_mae))
+    mse = jnp.mean(mse_per_image(x, x_out, cast_mse))
+    psnr = jnp.mean(psnr_per_image(x, x_out, cast_psnr))
+
+    ms_ssim = None
+    if minimize_for == "ms_ssim":
+        from dsin_tpu.ops.msssim import multiscale_ssim
+        ms_ssim = multiscale_ssim(x, x_out)
+
+    if minimize_for == "mae":
+        d = mae
+    elif minimize_for == "mse":
+        d = mse
+    elif minimize_for == "psnr":
+        d = config.K_psnr - psnr
+    else:
+        d = config.K_ms_ssim * (1.0 - ms_ssim)
+
+    return Distortions(mae=mae, mse=mse, psnr=psnr, ms_ssim=ms_ssim,
+                       d_loss_scaled=d)

@@ -1,0 +1,36 @@
+"""Non-overlapping patch extraction / reassembly.
+
+The reference uses `tf.extract_image_patches` with stride == patch size and
+inverts it with a double-`tf.gradients` scatter-add trick (reference
+siFull_img.py:45-68). With non-overlapping patches on exactly-divisible
+extents (the only configuration the pipeline uses: 320x960 and 320x1224 with
+20x24 patches) both operations are pure reshapes — free on TPU, no gather or
+scatter at all.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def extract_patches(img: jnp.ndarray, patch_h: int,
+                    patch_w: int) -> jnp.ndarray:
+    """(H, W, C) -> (num_patches, patch_h, patch_w, C), row-major grid order."""
+    h, w, c = img.shape
+    assert h % patch_h == 0 and w % patch_w == 0, (
+        f"image {h}x{w} not divisible by patch {patch_h}x{patch_w}")
+    gh, gw = h // patch_h, w // patch_w
+    x = img.reshape(gh, patch_h, gw, patch_w, c)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4))  # (gh, gw, ph, pw, c)
+    return x.reshape(gh * gw, patch_h, patch_w, c)
+
+
+def assemble_patches(patches: jnp.ndarray, img_h: int,
+                     img_w: int) -> jnp.ndarray:
+    """(num_patches, ph, pw, C) row-major grid -> (img_h, img_w, C)."""
+    n, ph, pw, c = patches.shape
+    gh, gw = img_h // ph, img_w // pw
+    assert n == gh * gw, (n, gh, gw)
+    x = patches.reshape(gh, gw, ph, pw, c)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4))  # (gh, ph, gw, pw, c)
+    return x.reshape(img_h, img_w, c)

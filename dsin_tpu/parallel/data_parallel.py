@@ -1,0 +1,45 @@
+"""Sharded train/eval steps over a device mesh.
+
+One jitted SPMD program: parameters/optimizer state replicated, the batch
+sharded over the 'data' axis. The loss is a global batch mean, so GSPMD
+emits the `psum` gradient all-reduce over ICI on its own — no hand-written
+collectives, exactly the "annotate shardings, let XLA insert collectives"
+recipe. Multi-host: call `jax.distributed.initialize()` first and feed each
+host its `PairDataset` shard (data/loader.py host_id/num_hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dsin_tpu.models.dsin import DSIN
+from dsin_tpu.parallel import mesh as mesh_lib
+from dsin_tpu.train import step as step_lib
+
+
+def make_sharded_train_step(model: DSIN, tx: optax.GradientTransformation,
+                            mesh, si_mask: Optional[jnp.ndarray] = None,
+                            donate: bool = True):
+    """(state, x, y) -> (state, metrics), batch sharded over 'data'."""
+    fn = step_lib.build_train_step_fn(model, tx, si_mask)
+    repl = mesh_lib.replicated(mesh)
+    batch = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        fn,
+        in_shardings=(repl, batch, batch),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_sharded_eval_step(model: DSIN, mesh,
+                           si_mask: Optional[jnp.ndarray] = None):
+    eval_fn = step_lib.build_eval_step_fn(model, si_mask)
+    repl = mesh_lib.replicated(mesh)
+    batch = mesh_lib.batch_sharding(mesh)
+    return jax.jit(eval_fn, in_shardings=(repl, batch, batch),
+                   out_shardings=repl)

@@ -1,0 +1,60 @@
+"""Device-mesh construction and sharding specs.
+
+The reference is strictly single-GPU (SURVEY §2: no distribution of any
+kind); this layer is the new-first-class TPU capability: data parallelism
+over ICI via `jax.sharding.Mesh` + jit-with-shardings (GSPMD inserts the
+gradient all-reduce collectives), multi-host via `jax.distributed`.
+
+Axes:
+  * 'data'    — batch axis; gradients all-reduce over ICI automatically
+                because the loss is a global batch mean under jit-SPMD.
+  * 'spatial' — optional second axis for sharding image height on very
+                large inputs (halo'd convs via GSPMD); 1 by default.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              spatial: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (data, spatial) mesh over the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    n = len(devices)
+    assert n % spatial == 0, (n, spatial)
+    arr = np.asarray(devices).reshape(n // spatial, spatial)
+    return Mesh(arr, axis_names=(DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over 'data'; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Device-put arrays with the batch axis sharded over 'data'."""
+    sh = batch_sharding(mesh)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def replicate_state(mesh: Mesh, state):
+    """Replicate a TrainState (or any pytree) across the mesh."""
+    sh = replicated(mesh)
+    return jax.device_put(state, sh)

@@ -1,0 +1,160 @@
+"""Partitioned checkpointing with partial-restore semantics.
+
+Capability parity with the reference's scope-filtered `tf.train.Saver`
+workflow (reference AE.py:154-175 + main.py:141-165), which enables the
+3-phase DSIN recipe:
+  (a) train AE_only              -> save ae partitions
+  (b) fresh siNet, frozen-ish AE -> restore ae only, train SI
+  (c) inference                  -> restore ae + sinet
+and `load_train_step` additionally restores optimizer state + step counter.
+
+Design: each partition is serialized independently (flax msgpack) inside a
+checkpoint directory, so a restore can pick any subset; a `meta.json`
+records step/best-val, and the config snapshot + `last_saved` sidecars match
+the reference's text files. Directory layout:
+
+    <dir>/
+      params_encoder.msgpack     params_decoder.msgpack
+      params_centers.msgpack     params_probclass.msgpack
+      params_sinet.msgpack       batch_stats.msgpack
+      opt_state.msgpack          meta.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, Optional
+
+import flax.serialization
+import jax
+import numpy as np
+
+AE_PARTITIONS = ("encoder", "decoder", "centers", "probclass")
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
+def _write_msgpack(path: str, tree) -> None:
+    # to_state_dict first: opt_state holds optax NamedTuple/dataclass nodes
+    # (e.g. multi_transform's PartitionState) that msgpack can't serialize raw
+    state = flax.serialization.to_state_dict(_to_host(tree))
+    with open(path, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(state))
+
+
+def _read_msgpack(path: str):
+    with open(path, "rb") as f:
+        return flax.serialization.msgpack_restore(f.read())
+
+
+def _restore_like(template, loaded):
+    """Shape the raw msgpack dict back into the template's pytree types."""
+    return flax.serialization.from_state_dict(template, loaded)
+
+
+def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
+                    extra_meta: Optional[Dict[str, Any]] = None) -> None:
+    """Save a TrainState (params/batch_stats/opt_state/step) partitioned."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    for part, sub in state.params.items():
+        _write_msgpack(os.path.join(ckpt_dir, f"params_{part}.msgpack"), sub)
+    _write_msgpack(os.path.join(ckpt_dir, "batch_stats.msgpack"),
+                   state.batch_stats)
+    _write_msgpack(os.path.join(ckpt_dir, "opt_state.msgpack"),
+                   state.opt_state)
+    meta = {"step": int(state.step),
+            "partitions": sorted(state.params.keys())}
+    if best_val is not None:
+        meta["best_val"] = float(best_val)
+    if extra_meta:
+        meta.update(extra_meta)
+    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_meta(ckpt_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        return json.load(f)
+
+
+def restore_partitions(ckpt_dir: str, state, partitions: Iterable[str],
+                       *, load_opt_state: bool = False,
+                       load_batch_stats: bool = True):
+    """Restore the named param partitions into `state`, leaving the rest at
+    their current (usually freshly-initialized) values. Returns a new state.
+
+    Missing partition files raise FileNotFoundError — restoring 'sinet' from
+    an AE_only checkpoint is a real error, as in the reference where the
+    Saver would fail on absent variables.
+    """
+    params = dict(state.params)
+    for part in partitions:
+        path = os.path.join(ckpt_dir, f"params_{part}.msgpack")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"checkpoint {ckpt_dir} has no partition {part!r}")
+        params[part] = _restore_like(state.params[part], _read_msgpack(path))
+
+    batch_stats = state.batch_stats
+    if load_batch_stats:
+        bs_path = os.path.join(ckpt_dir, "batch_stats.msgpack")
+        if os.path.exists(bs_path):
+            batch_stats = _restore_like(state.batch_stats,
+                                        _read_msgpack(bs_path))
+
+    opt_state = state.opt_state
+    step = state.step
+    if load_opt_state:
+        opt_state = _restore_like(state.opt_state, _read_msgpack(
+            os.path.join(ckpt_dir, "opt_state.msgpack")))
+        step = jax.numpy.asarray(load_meta(ckpt_dir)["step"],
+                                 dtype=state.step.dtype)
+
+    return state.replace(params=params, batch_stats=batch_stats,
+                         opt_state=opt_state, step=step)
+
+
+def restore_for_mode(ckpt_dir: str, state, ae_config):
+    """Reference AE.load_model mode logic (reference AE.py:158-175):
+
+    * always restore the AE partitions (encoder/decoder/centers/probclass);
+    * `load_train_step`  -> + optimizer state (+ siNet when not AE_only,
+      i.e. resuming SI training);
+    * test-only SI run   -> + siNet.
+    """
+    parts = list(AE_PARTITIONS)
+    load_opt = bool(ae_config.load_train_step)
+    ae_only = bool(ae_config.AE_only)
+    if load_opt and not ae_only:
+        parts.append("sinet")
+    elif (ae_config.test_model and not ae_config.train_model
+          and not ae_only):
+        parts.append("sinet")
+    return restore_partitions(ckpt_dir, state, parts,
+                              load_opt_state=load_opt)
+
+
+def write_sidecars(root: str, model_name: str, ae_config, pc_config,
+                   iteration: int, total_iterations: int,
+                   best_val: float) -> None:
+    """`last_saved_*.txt` + `configs_*.txt` sidecars (reference main.py:153-163)."""
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, f"last_saved_{model_name}.txt"), "w") as f:
+        f.write(f"{os.path.join(root, model_name)}\n"
+                f"last saved iteration number: {iteration}/{total_iterations}\n"
+                f"last saved val loss: {best_val}")
+    cfg_path = os.path.join(root, f"configs_{model_name}.txt")
+    if not os.path.exists(cfg_path):
+        with open(cfg_path, "w") as f:
+            f.write("#  ae configs:\n" + str(ae_config))
+            f.write("\n\n#  pc configs:\n" + str(pc_config))
+
+
+def model_name_for(ae_config, timestamp: str) -> str:
+    """'target_bpp<bpp>_<AE_only_|sinet_><ts>' (reference main.py:141-149)."""
+    target_bpp = ae_config.H_target / (64.0 / ae_config.num_chan_bn)
+    mode = "_AE_only_" if ae_config.AE_only else "_sinet_"
+    return f"target_bpp{target_bpp}{mode}{timestamp}"

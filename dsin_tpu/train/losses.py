@@ -1,0 +1,81 @@
+"""Rate-distortion loss assembly.
+
+Capability parity with the reference `get_loss` (reference
+Distortions_imgcomp.py:113-146) and the AE-level combination
+(reference AE.py:80-99):
+
+  H_real  = mean(bitcost)
+  H_mask  = mean(bitcost * heatmap)         (heatmap gates where bits count)
+  H_soft  = (H_mask + H_real) / 2
+  pc_loss = beta * max(H_soft - H_target, 0)
+  total   = d_loss_scaled + pc_loss + L2(enc) + L2(dec) + L2(centers) + L2(pc)
+  loss    = total + si_weight * L1(x, x_with_si)     [/ batch_size if SI batch>1]
+
+where d_loss_scaled already carries the (1 - si_weight) factor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class RateLoss(NamedTuple):
+    pc_loss: jnp.ndarray
+    H_real: jnp.ndarray
+    H_mask: jnp.ndarray
+    H_soft: jnp.ndarray
+
+
+def rate_loss(bitcost: jnp.ndarray, heatmap: Optional[jnp.ndarray],
+              H_target: float, beta: float) -> RateLoss:
+    H_real = jnp.mean(bitcost)
+    if heatmap is not None:
+        H_mask = jnp.mean(bitcost * heatmap)
+    else:
+        H_mask = H_real
+    H_soft = 0.5 * (H_mask + H_real)
+    pc_loss = beta * jnp.maximum(H_soft - H_target, 0.0)
+    return RateLoss(pc_loss=pc_loss, H_real=H_real, H_mask=H_mask,
+                    H_soft=H_soft)
+
+
+def _l2_of_kernels(params: Any) -> jnp.ndarray:
+    """Sum of ||w||^2/2 over conv kernels only — slim regularizes conv
+    weights, not biases or norm scales (reference autoencoder_imgcomp.py:101-103)."""
+    total = jnp.float32(0.0)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "kernel":
+            total = total + 0.5 * jnp.sum(jnp.square(leaf))
+    return total
+
+
+def regularization_losses(params: Dict[str, Any], ae_config,
+                          pc_config) -> Dict[str, jnp.ndarray]:
+    """L2 terms per partition. `params` holds top-level keys
+    'encoder', 'decoder', 'centers', 'probclass' (and optionally 'sinet',
+    which the reference never regularizes)."""
+    out = {}
+    factor = ae_config.regularization_factor
+    out["enc"] = factor * _l2_of_kernels(params["encoder"])
+    out["dec"] = factor * _l2_of_kernels(params["decoder"])
+    out["centers"] = (ae_config.regularization_factor_centers *
+                      0.5 * jnp.sum(jnp.square(params["centers"])))
+    pc_factor = pc_config.regularization_factor
+    out["pc"] = (pc_factor * _l2_of_kernels(params["probclass"])
+                 if pc_factor is not None else jnp.float32(0.0))
+    return out
+
+
+def total_loss(d_loss_scaled: jnp.ndarray, rate: RateLoss,
+               regs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    reg = regs["enc"] + regs["dec"] + regs["centers"] + regs["pc"]
+    return d_loss_scaled + rate.pc_loss + reg
+
+
+def si_l1_loss(x: jnp.ndarray, x_with_si: jnp.ndarray) -> jnp.ndarray:
+    """tf.losses.absolute_difference default: mean |x - y| (reference AE.py:94)."""
+    return jnp.mean(jnp.abs(x - x_with_si))

@@ -1,0 +1,199 @@
+"""Jitted train / eval steps for DSIN.
+
+The reference runs every SI training iteration as three `sess.run` round
+trips (reference AE.py:108-118: an extra full AE forward on `y` to make
+`y_dec`, then the train fetch; plus the data-session fetch). Here the whole
+thing — including the `y_dec` synthesis — is ONE jitted XLA program: no
+host round trips, no feed_dicts, fully fused on TPU.
+
+Semantics preserved from the reference graph:
+  * `y_dec` is computed with eval-mode BN under stop_gradient
+    (reference AE.py:150-152 runs it as inference);
+  * the train-branch bitcost sees stop_gradient(qbar) so the heatmap only
+    receives the rate gradient through the H_mask product (AE.py:73-76);
+  * the eval loss uses the *train* distortion cast rules (the reference
+    builds `Distortions(..., is_training=True)` once and reuses
+    `d_train.d_loss_scaled` in loss_test — AE.py:89-91), while BN runs in
+    eval mode;
+  * `loss = total + si_weight * L1(x, x_si)`, divided by batch_size when the
+    SI path trains with batch > 1 (AE.py:93-99).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from dsin_tpu.models import probclass as pc_lib
+from dsin_tpu.models.dsin import DSIN
+from dsin_tpu.ops import metrics as metrics_lib
+from dsin_tpu.train import losses as loss_lib
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Dict[str, Any]
+    batch_stats: Dict[str, Any]
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def create_train_state(model: DSIN, rng: jax.Array, input_shape,
+                       tx: optax.GradientTransformation) -> TrainState:
+    variables = model.init_variables(rng, input_shape)
+    return TrainState(
+        params=variables.params,
+        batch_stats=variables.batch_stats,
+        opt_state=tx.init(variables.params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def _forward_losses(model: DSIN, params, batch_stats, x, y,
+                    si_mask: Optional[jnp.ndarray], train: bool,
+                    collect_mutations: bool):
+    """Shared forward pass. Returns (loss, aux dict)."""
+    ae_cfg = model.ae_config
+
+    enc_out, enc_mut = model.encode(params, batch_stats, x, train=train,
+                                    mutable=collect_mutations)
+    x_dec, dec_mut = model.decode(params, batch_stats, enc_out.qbar,
+                                  train=train, mutable=collect_mutations)
+
+    if model.ae_only:
+        x_with_si = jnp.zeros_like(x)
+        y_syn = None
+        si_l1 = jnp.float32(0.0)
+    else:
+        from dsin_tpu.ops.sifinder import synthesize_side_image
+        # y_dec: inference-mode AE reconstruction of the side image,
+        # no gradients (reference AE.py:150-152)
+        stop = jax.lax.stop_gradient
+        y_enc, _ = model.encode(stop(params), batch_stats, y, train=False)
+        y_dec, _ = model.decode(stop(params), batch_stats, y_enc.qbar,
+                                train=False)
+        y_syn = synthesize_side_image(
+            x_dec=stop(x_dec), y_img=y, y_dec=stop(y_dec), mask=si_mask,
+            patch_h=ae_cfg.y_patch_size[0], patch_w=ae_cfg.y_patch_size[1],
+            config=ae_cfg)
+        x_with_si = model.apply_sinet(params, x_dec, y_syn)
+        si_l1 = loss_lib.si_l1_loss(x, x_with_si)
+
+    # distortion: train cast rules even at eval (see module docstring)
+    dist = metrics_lib.compute_distortions(ae_cfg, x, x_dec, is_training=True)
+    d_scaled = (1.0 - model.si_weight) * dist.d_loss_scaled
+
+    pc_in = enc_out.qbar if not train else jax.lax.stop_gradient(enc_out.qbar)
+    bc = model.bitcost(params, pc_in, enc_out.symbols)
+    bpp = pc_lib.bitcost_to_bpp(bc, x)
+    rate = loss_lib.rate_loss(bc, enc_out.heatmap, ae_cfg.H_target,
+                              ae_cfg.beta)
+    regs = loss_lib.regularization_losses(params, ae_cfg, model.pc_config)
+    total = loss_lib.total_loss(d_scaled, rate, regs)
+
+    loss = total + model.si_weight * si_l1
+    if (not model.ae_only) and ae_cfg.batch_size > 1 and train:
+        loss = loss / float(ae_cfg.batch_size)
+
+    aux = {
+        "bpp": bpp,
+        "H_real": rate.H_real,
+        "H_soft": rate.H_soft,
+        "pc_loss": rate.pc_loss,
+        "d_loss": dist.d_loss_scaled,
+        "mae": dist.mae,
+        "psnr": dist.psnr,
+        "si_l1": si_l1,
+        "x_dec": x_dec,
+        "x_with_si": x_with_si,
+        "y_syn": y_syn,
+        "enc_mut": enc_mut,
+        "dec_mut": dec_mut,
+    }
+    return loss, aux
+
+
+SCALAR_METRICS = ("bpp", "H_real", "H_soft", "pc_loss", "d_loss", "mae",
+                  "psnr", "si_l1")
+
+
+def _scalar_metrics(loss, aux):
+    metrics = {k: aux[k] for k in SCALAR_METRICS}
+    metrics["loss"] = loss
+    return metrics
+
+
+def build_train_step_fn(model: DSIN, tx: optax.GradientTransformation,
+                        si_mask: Optional[jnp.ndarray] = None):
+    """The un-jitted train step (state, x, y) -> (state, metrics) — callers
+    wrap it in `jax.jit` (single chip) or jit-with-shardings (mesh)."""
+    update_bn = model.ae_config.get("bn_stats", "update") == "update"
+
+    def train_step(state: TrainState, x, y):
+        def loss_fn(params):
+            return _forward_losses(model, params, state.batch_stats, x, y,
+                                   si_mask, train=True,
+                                   collect_mutations=update_bn)
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        if update_bn:
+            batch_stats = {"encoder": aux["enc_mut"]["batch_stats"],
+                           "decoder": aux["dec_mut"]["batch_stats"]}
+        else:
+            batch_stats = state.batch_stats
+
+        new_state = TrainState(params=params, batch_stats=batch_stats,
+                               opt_state=opt_state, step=state.step + 1)
+        return new_state, _scalar_metrics(loss, aux)
+
+    return train_step
+
+
+def make_train_step(model: DSIN, tx: optax.GradientTransformation,
+                    si_mask: Optional[jnp.ndarray] = None,
+                    donate: bool = True):
+    """Build the jitted single-chip train step: (state, x, y) -> (state, metrics)."""
+    train_step = build_train_step_fn(model, tx, si_mask)
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def build_eval_step_fn(model: DSIN, si_mask: Optional[jnp.ndarray] = None):
+    """The un-jitted eval step (state, x, y) -> metrics — callers wrap it in
+    `jax.jit` (single chip) or jit-with-shardings (mesh)."""
+
+    def eval_step(state: TrainState, x, y):
+        loss, aux = _forward_losses(model, state.params, state.batch_stats,
+                                    x, y, si_mask, train=False,
+                                    collect_mutations=False)
+        return _scalar_metrics(loss, aux)
+
+    return eval_step
+
+
+def make_eval_step(model: DSIN, si_mask: Optional[jnp.ndarray] = None):
+    """Build the jitted eval step: (state, x, y) -> metrics (incl. loss)."""
+    return jax.jit(build_eval_step_fn(model, si_mask))
+
+
+def make_inference_step(model: DSIN, si_mask: Optional[jnp.ndarray] = None):
+    """Full reconstruction fetch (reference AE.py:132-148):
+    (state, x, y) -> dict with x_dec, x_with_si, y_syn, bpp."""
+
+    def infer(state: TrainState, x, y):
+        loss, aux = _forward_losses(model, state.params, state.batch_stats,
+                                    x, y, si_mask, train=False,
+                                    collect_mutations=False)
+        return {"x_dec": aux["x_dec"], "x_with_si": aux["x_with_si"],
+                "y_syn": aux["y_syn"], "bpp": aux["bpp"], "loss": loss,
+                "psnr": aux["psnr"], "mae": aux["mae"]}
+
+    return jax.jit(infer)

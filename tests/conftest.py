@@ -1,14 +1,23 @@
 """Test harness config: force an 8-device virtual CPU platform.
 
-Multi-chip sharding is validated on a virtual CPU mesh (no TPU pod in CI);
-the flags must be set before jax initializes, hence this conftest.
+The driver environment routes jax at the single real TPU chip through the
+axon relay and its site hook *overrides* `jax_platforms` to "axon,cpu" via
+`jax.config.update` at import time, ignoring the JAX_PLATFORMS env var.
+Tests must never contend for the one chip (concurrent clients block on the
+device grant), so after importing jax we force the config back to cpu —
+conftest runs before any test module touches a backend.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert all(d.platform == "cpu" for d in jax.devices())

@@ -1,0 +1,114 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.models import autoencoder as ae_lib
+from dsin_tpu.models.quantizer import init_centers
+
+
+def small_cfg(**over):
+    cfg = parse_config(
+        """
+        arch = CVPR
+        arch_param_B = 1
+        num_chan_bn = 4
+        heatmap = True
+        num_centers = 6
+        centers_initial_range = (-2, 2)
+        constrain normalization :: OFF, FIXED
+        normalization = FIXED
+        """)
+    return cfg.replace(**over) if over else cfg
+
+
+@pytest.fixture(scope="module")
+def ae_setup():
+    cfg = small_cfg()
+    enc = ae_lib.Encoder(cfg)
+    dec = ae_lib.Decoder(cfg)
+    x = jnp.asarray(np.random.default_rng(0).uniform(
+        0, 255, (1, 32, 48, 3)).astype(np.float32))
+    enc_vars = enc.init(jax.random.PRNGKey(0), x, True)
+    centers = init_centers(jax.random.PRNGKey(1), cfg.num_centers)
+    out, _ = ae_lib.encode(enc, enc_vars, x, centers, train=True)
+    dec_vars = dec.init(jax.random.PRNGKey(2), out.qbar, True)
+    return cfg, enc, dec, enc_vars, dec_vars, centers, x
+
+
+def test_encoder_shapes_subsampling_8(ae_setup):
+    cfg, enc, dec, enc_vars, dec_vars, centers, x = ae_setup
+    out, _ = ae_lib.encode(enc, enc_vars, x, centers, train=True)
+    assert out.qbar.shape == (1, 4, 6, cfg.num_chan_bn)
+    assert out.symbols.shape == out.qbar.shape
+    assert out.symbols.dtype == jnp.int32
+    assert out.heatmap.shape == out.qbar.shape
+
+
+def test_heatmap_in_01_and_monotone(ae_setup):
+    cfg, enc, dec, enc_vars, dec_vars, centers, x = ae_setup
+    out, _ = ae_lib.encode(enc, enc_vars, x, centers, train=True)
+    h = np.asarray(out.heatmap)
+    assert h.min() >= 0.0 and h.max() <= 1.0
+    # ramp property: mask is non-increasing along the channel axis
+    assert np.all(np.diff(h, axis=-1) <= 1e-6)
+
+
+def test_heatmap3d_formula():
+    # sigmoid(0)=0.5 -> heat2d = 0.5*C; with C=4 -> 2.0
+    b = jnp.zeros((1, 2, 2, 5))
+    h = np.asarray(ae_lib.heatmap3d(b))
+    np.testing.assert_allclose(h[0, 0, 0], [1.0, 1.0, 0.0, 0.0], atol=1e-6)
+
+
+def test_decoder_output_range_and_shape(ae_setup):
+    cfg, enc, dec, enc_vars, dec_vars, centers, x = ae_setup
+    out, _ = ae_lib.encode(enc, enc_vars, x, centers, train=True)
+    x_dec, _ = ae_lib.decode(dec, dec_vars, out.qbar, train=True)
+    assert x_dec.shape == x.shape
+    assert float(jnp.min(x_dec)) >= 0.0 and float(jnp.max(x_dec)) <= 255.0
+
+
+def test_batch_stats_mutation(ae_setup):
+    cfg, enc, dec, enc_vars, dec_vars, centers, x = ae_setup
+    _, mut = ae_lib.encode(enc, enc_vars, x, centers, train=True, mutable=True)
+    assert "batch_stats" in mut
+    # frozen-eval path runs with init stats
+    out_eval, _ = ae_lib.encode(enc, enc_vars, x, centers, train=False)
+    assert out_eval.qbar.shape == (1, 4, 6, cfg.num_chan_bn)
+
+
+def test_normalize_denormalize_roundtrip():
+    x = jnp.asarray(np.random.default_rng(1).uniform(
+        0, 255, (1, 4, 4, 3)).astype(np.float32))
+    y = ae_lib.denormalize_image(ae_lib.normalize_image(x, "FIXED"), "FIXED")
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-3)
+    np.testing.assert_array_equal(
+        np.asarray(ae_lib.normalize_image(x, "OFF")), np.asarray(x))
+
+
+def test_no_heatmap_config():
+    cfg = small_cfg(heatmap=False)
+    enc = ae_lib.Encoder(cfg)
+    x = jnp.zeros((1, 16, 16, 3))
+    vars_ = enc.init(jax.random.PRNGKey(0), x, True)
+    centers = init_centers(jax.random.PRNGKey(1), 6)
+    out, _ = ae_lib.encode(enc, vars_, x, centers, train=True)
+    assert out.heatmap is None
+    assert out.qbar.shape == (1, 2, 2, cfg.num_chan_bn)
+
+
+def test_gradients_reach_all_encoder_params(ae_setup):
+    cfg, enc, dec, enc_vars, dec_vars, centers, x = ae_setup
+
+    def loss_fn(params):
+        out, _ = ae_lib.encode(enc, {**enc_vars, "params": params}, x,
+                               centers, train=True)
+        return jnp.sum(out.qbar ** 2)
+
+    g = jax.grad(loss_fn)(enc_vars["params"])
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    nonzero = sum(float(jnp.sum(jnp.abs(l))) > 0 for l in leaves)
+    assert nonzero > len(leaves) * 0.5

@@ -1,0 +1,154 @@
+"""Checkpoint save/restore, incl. the partial-restore phase semantics
+(reference AE.py:154-175 + main.py:141-165)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.train import checkpoint as ckpt_lib
+from dsin_tpu.train import optim as optim_lib
+from dsin_tpu.train.step import TrainState
+
+
+def _params(seed=0):
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 5)
+    return {
+        "encoder": {"conv": {"kernel": jax.random.normal(ks[0], (3,))}},
+        "decoder": {"conv": {"kernel": jax.random.normal(ks[1], (3,))}},
+        "centers": jax.random.normal(ks[2], (6,)),
+        "probclass": {"conv": {"kernel": jax.random.normal(ks[3], (3,))}},
+        "sinet": {"conv": {"kernel": jax.random.normal(ks[4], (3,))}},
+    }
+
+
+def _cfgs(**ae_over):
+    ae = parse_config(
+        """
+        batch_size = 1
+        num_crops_per_img = 1
+        AE_only = False
+        optimizer = 'ADAM'
+        lr_initial = 0.1
+        lr_schedule = 'FIXED'
+        train_autoencoder = True
+        train_probclass = True
+        lr_centers_factor = None
+        load_train_step = False
+        train_model = True
+        test_model = False
+        """)
+    pc = parse_config(
+        "optimizer = 'ADAM'\nlr_initial = 0.001\nlr_schedule = 'FIXED'\n")
+    return (ae.replace(**ae_over) if ae_over else ae), pc
+
+
+def _state(params, tx, step=7):
+    return TrainState(params=params,
+                      batch_stats={"encoder": {}, "decoder": {}},
+                      opt_state=tx.init(params),
+                      step=jnp.asarray(step, jnp.int32))
+
+
+def test_roundtrip_with_real_multi_transform_opt_state(tmp_path):
+    """save_checkpoint must serialize the optax multi_transform opt_state
+    (NamedTuple/PartitionState nodes) and restore it bit-exactly."""
+    ae, pc = _cfgs()
+    params = _params()
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    state = _state(params, tx)
+    # advance the optimizer once so slots are non-trivial
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, opt_state = tx.update(grads, state.opt_state, params)
+    state = state.replace(opt_state=opt_state)
+
+    ckpt_lib.save_checkpoint(str(tmp_path), state, best_val=1.25)
+
+    fresh = _state(_params(seed=1), tx, step=0)
+    restored = ckpt_lib.restore_partitions(
+        str(tmp_path), fresh,
+        list(ckpt_lib.AE_PARTITIONS) + ["sinet"], load_opt_state=True)
+
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == 7
+    assert ckpt_lib.load_meta(str(tmp_path))["best_val"] == 1.25
+
+
+def test_partial_restore_leaves_other_partitions_fresh(tmp_path):
+    ae, pc = _cfgs()
+    params = _params()
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    ckpt_lib.save_checkpoint(str(tmp_path), _state(params, tx))
+
+    fresh = _state(_params(seed=1), tx, step=0)
+    restored = ckpt_lib.restore_partitions(str(tmp_path), fresh,
+                                           ckpt_lib.AE_PARTITIONS)
+    np.testing.assert_array_equal(np.asarray(restored.params["centers"]),
+                                  np.asarray(params["centers"]))
+    # sinet untouched -> stays at the fresh init
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["sinet"]["conv"]["kernel"]),
+        np.asarray(fresh.params["sinet"]["conv"]["kernel"]))
+    assert int(restored.step) == 0  # no opt-state load -> step untouched
+
+
+def test_restore_missing_partition_raises(tmp_path):
+    ae, pc = _cfgs()
+    params = _params()
+    del params["sinet"]
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    ckpt_lib.save_checkpoint(str(tmp_path), _state(params, tx))
+
+    full = _params(seed=1)
+    tx2 = optim_lib.build_optimizer(full, ae, pc, num_training_imgs=10)
+    fresh = _state(full, tx2)
+    with pytest.raises(FileNotFoundError):
+        ckpt_lib.restore_partitions(str(tmp_path), fresh, ["sinet"])
+
+
+def test_restore_for_mode_matrix(tmp_path):
+    """Reference AE.load_model mode logic: which partitions load per phase."""
+    ae, pc = _cfgs()
+    params = _params()
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    ckpt_lib.save_checkpoint(str(tmp_path), _state(params, tx))
+
+    def fresh():
+        return _state(_params(seed=2), tx, step=0)
+
+    # (b) fresh siNet from an AE checkpoint: sinet must NOT be restored
+    r = ckpt_lib.restore_for_mode(str(tmp_path), fresh(),
+                                  ae.replace(AE_only=False))
+    np.testing.assert_array_equal(
+        np.asarray(r.params["sinet"]["conv"]["kernel"]),
+        np.asarray(fresh().params["sinet"]["conv"]["kernel"]))
+
+    # resume SI training: sinet + opt state + step
+    r = ckpt_lib.restore_for_mode(str(tmp_path), fresh(),
+                                  ae.replace(load_train_step=True))
+    np.testing.assert_array_equal(
+        np.asarray(r.params["sinet"]["conv"]["kernel"]),
+        np.asarray(params["sinet"]["conv"]["kernel"]))
+    assert int(r.step) == 7
+
+    # (c) SI inference: sinet, no opt state
+    r = ckpt_lib.restore_for_mode(
+        str(tmp_path), fresh(),
+        ae.replace(train_model=False, test_model=True))
+    np.testing.assert_array_equal(
+        np.asarray(r.params["sinet"]["conv"]["kernel"]),
+        np.asarray(params["sinet"]["conv"]["kernel"]))
+    assert int(r.step) == 0
+
+
+def test_model_name_for():
+    ae, _ = _cfgs(H_target=0.04, num_chan_bn=32, AE_only=True)
+    name = ckpt_lib.model_name_for(ae, "ts")
+    assert name == "target_bpp0.02_AE_only_ts"

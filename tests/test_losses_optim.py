@@ -1,0 +1,163 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.train import losses as loss_lib
+from dsin_tpu.train import optim as optim_lib
+
+
+def test_rate_loss_below_target_is_zero():
+    bc = jnp.full((1, 2, 2, 2), 0.01)
+    r = loss_lib.rate_loss(bc, heatmap=None, H_target=0.04, beta=500.0)
+    assert float(r.pc_loss) == 0.0
+    assert float(r.H_real) == pytest.approx(0.01)
+    assert float(r.H_soft) == pytest.approx(0.01)
+
+
+def test_rate_loss_above_target_penalized():
+    bc = jnp.full((1, 2, 2, 2), 1.0)
+    heat = jnp.full((1, 2, 2, 2), 0.5)
+    r = loss_lib.rate_loss(bc, heat, H_target=0.04, beta=500.0)
+    assert float(r.H_mask) == pytest.approx(0.5)
+    assert float(r.H_soft) == pytest.approx(0.75)
+    assert float(r.pc_loss) == pytest.approx(500.0 * (0.75 - 0.04))
+
+
+def test_regularization_only_kernels():
+    params = {
+        "encoder": {"conv": {"kernel": jnp.asarray([2.0]),
+                             "bias": jnp.asarray([100.0])}},
+        "decoder": {"conv": {"kernel": jnp.asarray([1.0, 1.0])},
+                    "bn": {"scale": jnp.asarray([50.0])}},
+        "centers": jnp.asarray([2.0]),
+        "probclass": {"c": {"kernel": jnp.asarray([3.0])}},
+    }
+    ae_cfg = parse_config(
+        "regularization_factor = 0.5\nregularization_factor_centers = 1.0\n")
+    pc_cfg = parse_config("regularization_factor = None\n")
+    regs = loss_lib.regularization_losses(params, ae_cfg, pc_cfg)
+    assert float(regs["enc"]) == pytest.approx(0.5 * 0.5 * 4.0)   # kernel only
+    assert float(regs["dec"]) == pytest.approx(0.5 * 0.5 * 2.0)   # no bn scale
+    assert float(regs["centers"]) == pytest.approx(0.5 * 4.0)
+    assert float(regs["pc"]) == 0.0
+    pc_cfg2 = parse_config("regularization_factor = 0.1\n")
+    regs2 = loss_lib.regularization_losses(params, ae_cfg, pc_cfg2)
+    assert float(regs2["pc"]) == pytest.approx(0.1 * 0.5 * 9.0)
+
+
+def test_iterations_per_epoch():
+    # reference semantics incl. the AE_only 1,281,000-image epoch
+    assert optim_lib.iterations_per_epoch(1, 1, 100, ae_only=False) == 100
+    assert optim_lib.iterations_per_epoch(1, 1, 100, ae_only=True) == 1281000
+    assert optim_lib.iterations_per_epoch(2, 4, 100, ae_only=False) == 50
+
+
+def test_lr_schedule_staircase():
+    cfg = parse_config(
+        """
+        lr_initial = 1e-2
+        lr_schedule = 'DECAY'
+        lr_schedule_decay_interval = 2
+        lr_schedule_decay_rate = 0.1
+        lr_schedule_decay_staircase = True
+        """)
+    sched = optim_lib.learning_rate_schedule(cfg, 1, 5, 1, ae_only=False)
+    # itr/epoch = 5, interval 2 -> decay every 10 steps
+    assert float(sched(0)) == pytest.approx(1e-2)
+    assert float(sched(9)) == pytest.approx(1e-2)
+    assert float(sched(10)) == pytest.approx(1e-3)
+    assert float(sched(25)) == pytest.approx(1e-4)
+
+
+def test_lr_schedule_fixed():
+    cfg = parse_config("lr_initial = 3e-4\nlr_schedule = 'FIXED'\n")
+    sched = optim_lib.learning_rate_schedule(cfg, 1, 5, 1, ae_only=False)
+    assert float(sched(12345)) == pytest.approx(3e-4)
+
+
+def _opt_cfgs(**ae_over):
+    ae = parse_config(
+        """
+        batch_size = 1
+        num_crops_per_img = 1
+        AE_only = True
+        optimizer = 'ADAM'
+        lr_initial = 0.1
+        lr_schedule = 'FIXED'
+        train_autoencoder = True
+        train_probclass = True
+        lr_centers_factor = None
+        """)
+    pc = parse_config(
+        "optimizer = 'ADAM'\nlr_initial = 0.001\nlr_schedule = 'FIXED'\n")
+    return (ae.replace(**ae_over) if ae_over else ae), pc
+
+
+def test_multi_lr_partitions():
+    params = {
+        "encoder": {"kernel": jnp.ones((2,))},
+        "decoder": {"kernel": jnp.ones((2,))},
+        "centers": jnp.ones((2,)),
+        "probclass": {"kernel": jnp.ones((2,))},
+    }
+    ae, pc = _opt_cfgs()
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    # adam normalizes: first-step update magnitude == lr
+    assert float(jnp.abs(updates["encoder"]["kernel"][0])) == pytest.approx(0.1, rel=1e-3)
+    assert float(jnp.abs(updates["probclass"]["kernel"][0])) == pytest.approx(0.001, rel=1e-3)
+
+
+def test_frozen_partitions():
+    params = {
+        "encoder": {"kernel": jnp.ones((2,))},
+        "decoder": {"kernel": jnp.ones((2,))},
+        "centers": jnp.ones((2,)),
+        "probclass": {"kernel": jnp.ones((2,))},
+    }
+    ae, pc = _opt_cfgs(train_probclass=False, train_autoencoder=False)
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    assert float(jnp.sum(jnp.abs(updates["probclass"]["kernel"]))) == 0.0
+    assert float(jnp.sum(jnp.abs(updates["encoder"]["kernel"]))) == 0.0
+    assert float(jnp.sum(jnp.abs(updates["centers"]))) == 0.0
+
+
+def test_frozen_ae_freezes_centers_even_with_lr_factor():
+    """train_autoencoder=False must freeze the centers too, even when the
+    centers have their own LR group (the frozen-AE SI phase must not drift
+    the quantization grid)."""
+    params = {
+        "encoder": {"kernel": jnp.ones((2,))},
+        "decoder": {"kernel": jnp.ones((2,))},
+        "centers": jnp.ones((2,)),
+        "probclass": {"kernel": jnp.ones((2,))},
+    }
+    ae, pc = _opt_cfgs(train_autoencoder=False, lr_centers_factor=0.5)
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    assert float(jnp.sum(jnp.abs(updates["centers"]))) == 0.0
+    assert float(jnp.sum(jnp.abs(updates["encoder"]["kernel"]))) == 0.0
+
+
+def test_centers_lr_factor():
+    params = {
+        "encoder": {"kernel": jnp.ones((2,))},
+        "decoder": {"kernel": jnp.ones((2,))},
+        "centers": jnp.ones((2,)),
+        "probclass": {"kernel": jnp.ones((2,))},
+    }
+    ae, pc = _opt_cfgs(lr_centers_factor=0.5)
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    assert float(jnp.abs(updates["centers"][0])) == pytest.approx(0.05, rel=1e-3)

@@ -1,0 +1,131 @@
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.ops import metrics
+from dsin_tpu.ops.msssim import multiscale_ssim
+
+
+# ---------------------------------------------------------------------------
+# Independent numpy MS-SSIM oracle (written from the Wang 2003 spec;
+# behaviorally matches the reference eval oracle ms_ssim_np_imgcomp.py).
+# ---------------------------------------------------------------------------
+
+def _np_gauss2d(size, sigma):
+    ax = np.arange(size) - (size - 1) / 2.0
+    xx, yy = np.meshgrid(ax, ax)
+    g = np.exp(-(xx ** 2 + yy ** 2) / (2.0 * sigma ** 2))
+    return g / g.sum()
+
+
+def _np_ssim_cs(a, b, max_val=255.0, filter_size=11, filter_sigma=1.5,
+                k1=0.01, k2=0.03):
+    from scipy.signal import fftconvolve
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    _, h, w, _ = a.shape
+    size = min(filter_size, h, w)
+    sigma = size * filter_sigma / filter_size
+    win = _np_gauss2d(size, sigma).reshape(1, size, size, 1)
+    mu_a = fftconvolve(a, win, mode="valid")
+    mu_b = fftconvolve(b, win, mode="valid")
+    s_aa = fftconvolve(a * a, win, mode="valid") - mu_a * mu_a
+    s_bb = fftconvolve(b * b, win, mode="valid") - mu_b * mu_b
+    s_ab = fftconvolve(a * b, win, mode="valid") - mu_a * mu_b
+    c1 = (k1 * max_val) ** 2
+    c2 = (k2 * max_val) ** 2
+    v1 = 2.0 * s_ab + c2
+    v2 = s_aa + s_bb + c2
+    ssim = np.mean(((2.0 * mu_a * mu_b + c1) * v1) /
+                   ((mu_a ** 2 + mu_b ** 2 + c1) * v2))
+    cs = np.mean(v1 / v2)
+    return ssim, cs
+
+
+def _np_downsample(x):
+    from scipy.ndimage import convolve
+    k = np.ones((1, 2, 2, 1)) / 4.0
+    return convolve(x, k, mode="reflect")[:, ::2, ::2, :]
+
+
+def _np_msssim(a, b, levels=5):
+    w = np.array([0.0448, 0.2856, 0.3001, 0.2363, 0.1333])
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    mssim, mcs = [], []
+    for _ in range(levels):
+        s, c = _np_ssim_cs(a, b)
+        mssim.append(s)
+        mcs.append(c)
+        a, b = _np_downsample(a), _np_downsample(b)
+    mssim, mcs = np.array(mssim), np.array(mcs)
+    return np.prod(mcs[:-1] ** w[:-1]) * mssim[-1] ** w[-1]
+
+
+# ---------------------------------------------------------------------------
+
+
+def _rand_pair(shape, seed=0, noise=8.0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 255, size=shape).astype(np.float32)
+    y = np.clip(x + rng.normal(0, noise, size=shape), 0, 255).astype(np.float32)
+    return x, y
+
+
+def test_mae_mse_psnr_int_cast():
+    x = np.array([[[[10.6, 20.2]]]], dtype=np.float32)  # NHWC (1,1,1,2)
+    y = np.array([[[[12.0, 19.0]]]], dtype=np.float32)
+    # float: |12-10.6|=1.4, |19-20.2|=1.2 -> mae 1.3
+    assert float(metrics.mae_per_image(x, y, cast_to_int=False)[0]) == pytest.approx(1.3, abs=1e-5)
+    # int: |12-10|=2, |19-20|=1 -> mae 1.5 (truncation toward zero)
+    assert float(metrics.mae_per_image(x, y, cast_to_int=True)[0]) == pytest.approx(1.5)
+    mse_f = float(metrics.mse_per_image(x, y, cast_to_int=False)[0])
+    assert mse_f == pytest.approx((1.4 ** 2 + 1.2 ** 2) / 2, abs=1e-4)
+    psnr = float(metrics.psnr_per_image(x, y, cast_to_int=True)[0])
+    assert psnr == pytest.approx(10 * np.log10(255 ** 2 / 2.5), abs=5e-3)
+
+
+def test_psnr_identical_is_inf():
+    x, _ = _rand_pair((1, 8, 8, 3))
+    assert np.isinf(float(metrics.psnr_per_image(x, x, cast_to_int=True)[0]))
+
+
+def test_distortions_selector():
+    cfg = parse_config("distortion_to_minimize = 'mae'\nK_psnr = 100\nK_ms_ssim = 5000\n")
+    x, y = _rand_pair((2, 16, 16, 3))
+    d = metrics.compute_distortions(cfg, x, y, is_training=True)
+    # training on mae -> mae is computed in float (no cast)
+    assert float(d.d_loss_scaled) == pytest.approx(
+        float(np.mean(np.abs(y - x))), rel=1e-5)
+    d_eval = metrics.compute_distortions(cfg, x, y, is_training=False)
+    assert float(d_eval.mae) == pytest.approx(
+        float(np.mean(np.abs(y.astype(np.int32) - x.astype(np.int32)))), rel=1e-5)
+    cfg_psnr = cfg.replace(distortion_to_minimize="psnr")
+    d2 = metrics.compute_distortions(cfg_psnr, x, y, is_training=True)
+    assert float(d2.d_loss_scaled) == pytest.approx(100.0 - float(d2.psnr), rel=1e-5)
+
+
+def test_msssim_matches_numpy_oracle_even_dims():
+    x, y = _rand_pair((1, 192, 192, 3), seed=1)
+    ours = float(multiscale_ssim(x, y))
+    ref = _np_msssim(x, y)
+    assert ours == pytest.approx(ref, abs=2e-4)
+
+
+def test_msssim_matches_numpy_oracle_odd_dims():
+    # 180 -> 90 -> 45 (odd) -> 23 (odd) -> 12: exercises the reflect boundary
+    x, y = _rand_pair((1, 180, 184, 3), seed=2, noise=20.0)
+    ours = float(multiscale_ssim(x, y))
+    ref = _np_msssim(x, y)
+    assert ours == pytest.approx(ref, abs=2e-4)
+
+
+def test_msssim_identity_close_to_one():
+    x, _ = _rand_pair((1, 176, 176, 3), seed=3)
+    assert float(multiscale_ssim(x, x)) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_msssim_degrades_with_noise():
+    x, y1 = _rand_pair((1, 176, 176, 3), seed=4, noise=4.0)
+    _, y2 = _rand_pair((1, 176, 176, 3), seed=4, noise=40.0)
+    assert float(multiscale_ssim(x, y1)) > float(multiscale_ssim(x, y2))

@@ -1,0 +1,110 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.models import probclass as pc_lib
+
+
+def pc_cfg(**over):
+    cfg = parse_config(
+        """
+        arch = res_shallow
+        kernel_size = 3
+        arch_param__k = 4
+        use_centers_for_padding = True
+        """)
+    return cfg.replace(**over) if over else cfg
+
+
+def test_context_and_filter_shapes():
+    assert pc_lib.context_size(3) == 9
+    assert pc_lib.context_shape(3) == (5, 9, 9)
+    assert pc_lib.filter_shape(3) == (2, 3, 3)
+
+
+def test_masks():
+    first = pc_lib.make_mask(3, include_center=False)
+    other = pc_lib.make_mask(3, include_center=True)
+    assert first.shape == (2, 3, 3)
+    # earlier depth slice fully visible
+    np.testing.assert_array_equal(first[0], np.ones((3, 3)))
+    np.testing.assert_array_equal(other[0], np.ones((3, 3)))
+    # last depth slice: causal raster mask
+    np.testing.assert_array_equal(first[1], [[1, 1, 1], [1, 0, 0], [0, 0, 0]])
+    np.testing.assert_array_equal(other[1], [[1, 1, 1], [1, 1, 0], [0, 0, 0]])
+
+
+@pytest.fixture(scope="module")
+def pc_setup():
+    cfg = pc_cfg()
+    model = pc_lib.ResShallow(cfg, num_centers=6)
+    q = jnp.asarray(np.random.default_rng(0).normal(
+        size=(1, 4, 5, 3)).astype(np.float32))  # NHWC, C=3 -> depth 3
+    vol = pc_lib.pad_volume(jnp.transpose(q, (0, 3, 1, 2))[..., None], 3, 0.0)
+    variables = model.init(jax.random.PRNGKey(0), vol)
+    return cfg, model, variables, q
+
+
+def test_logits_shape(pc_setup):
+    cfg, model, variables, q = pc_setup
+    logits = pc_lib.logits_from_q(model, variables, q, pad_value=0.0)
+    assert logits.shape == (1, 4, 5, 3, 6)
+
+
+def test_bitcost_uniform_when_weights_zero(pc_setup):
+    cfg, model, variables, q = pc_setup
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, variables)
+    symbols = jnp.zeros(q.shape, jnp.int32)
+    bc = pc_lib.bitcost(model, zeros, q, symbols, pad_value=0.0)
+    np.testing.assert_allclose(np.asarray(bc), np.log2(6), rtol=1e-4)
+
+
+def test_causality_gradient_probe(pc_setup):
+    """d bitcost[p] / d q[j] must vanish for every j at or after p in
+    (C, H, W) raster order — the core correctness property of the model."""
+    cfg, model, variables, q = pc_setup
+    n, h, w, c = q.shape
+    symbols = jnp.zeros(q.shape, jnp.int32)
+
+    def bc_flat(q_in):
+        bc = pc_lib.bitcost(model, variables, q_in, symbols, pad_value=0.0)
+        # flatten in (C, H, W) raster order to match the causal ordering
+        return jnp.transpose(bc, (0, 3, 1, 2)).reshape(-1)
+
+    jac = jax.jacobian(bc_flat)(q)                       # (P, N, H, W, C)
+    jac = jnp.transpose(jac, (0, 1, 4, 2, 3)).reshape(c * h * w, c * h * w)
+    jac = np.asarray(jac)
+    future = np.triu(np.ones_like(jac), k=0)             # incl. diagonal
+    leak = np.abs(jac * future).max()
+    assert leak == 0.0, f"causality violated: max |d bc/d future q| = {leak}"
+    # and the past must actually be used
+    assert np.abs(jac * (1 - future)).max() > 0.0
+
+
+def test_pad_value_is_traced(pc_setup):
+    """Padding with centers[0] must flow gradients to the centers."""
+    cfg, model, variables, q = pc_setup
+    symbols = jnp.zeros(q.shape, jnp.int32)
+
+    def f(center0):
+        bc = pc_lib.bitcost(model, variables, q, symbols, pad_value=center0)
+        return jnp.sum(bc)
+
+    g = jax.grad(f)(jnp.float32(0.5))
+    assert np.isfinite(float(g))
+    assert float(jnp.abs(g)) > 0.0
+
+
+def test_bitcost_to_bpp():
+    bc = jnp.ones((1, 2, 2, 4))  # 16 bits
+    x = jnp.zeros((1, 8, 8, 3))  # 64 pixels
+    assert float(pc_lib.bitcost_to_bpp(bc, x)) == pytest.approx(16 / 64)
+
+
+def test_auto_pad_value():
+    centers = jnp.asarray([0.7, -1.0])
+    assert float(pc_lib.auto_pad_value(pc_cfg(), centers)) == pytest.approx(0.7)
+    assert pc_lib.auto_pad_value(pc_cfg(use_centers_for_padding=False),
+                                 centers) == 0.0

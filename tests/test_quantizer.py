@@ -1,0 +1,69 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.models.quantizer import (centers_regularization, init_centers,
+                                       quantize)
+
+
+def test_hard_assignment_is_nearest_center():
+    centers = jnp.asarray([-1.0, 0.0, 2.0])
+    x = jnp.asarray([[-2.0, -0.4, 0.9, 1.1, 5.0]])
+    out = quantize(x, centers)
+    np.testing.assert_array_equal(np.asarray(out.symbols),
+                                  [[0, 1, 1, 2, 2]])
+    np.testing.assert_allclose(np.asarray(out.qhard),
+                               [[-1.0, 0.0, 0.0, 2.0, 2.0]])
+
+
+def test_qbar_forward_equals_qhard():
+    centers = init_centers(jax.random.PRNGKey(0), 6)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 4, 3))
+    out = quantize(x, centers)
+    np.testing.assert_allclose(np.asarray(out.qbar), np.asarray(out.qhard),
+                               rtol=1e-6)
+
+
+def test_qbar_gradient_flows_through_soft_path():
+    centers = jnp.asarray([-1.0, 0.0, 1.0])
+    x = jnp.asarray([0.3])
+
+    def f_bar(x):
+        return jnp.sum(quantize(x, centers).qbar)
+
+    def f_soft(x):
+        return jnp.sum(quantize(x, centers).qsoft)
+
+    g_bar = jax.grad(f_bar)(x)
+    g_soft = jax.grad(f_soft)(x)
+    np.testing.assert_allclose(np.asarray(g_bar), np.asarray(g_soft),
+                               rtol=1e-6)
+    assert float(jnp.abs(g_bar[0])) > 0.0  # STE: gradient not blocked
+
+
+def test_gradient_flows_to_centers():
+    centers = jnp.asarray([-1.0, 0.0, 1.0])
+    x = jnp.asarray([0.3, -0.7])
+    g = jax.grad(lambda c: jnp.sum(quantize(x, c).qbar))(centers)
+    assert float(jnp.sum(jnp.abs(g))) > 0.0
+
+
+def test_soft_converges_to_hard_with_large_sigma():
+    centers = jnp.asarray([-1.0, 0.0, 1.0])
+    x = jnp.asarray([0.3, -0.7, 0.9])
+    out = quantize(x, centers, sigma=1e6)
+    np.testing.assert_allclose(np.asarray(out.qsoft), np.asarray(out.qhard),
+                               atol=1e-5)
+
+
+def test_init_centers_range_and_determinism():
+    c1 = init_centers(jax.random.PRNGKey(666), 6, (-2, 2))
+    c2 = init_centers(jax.random.PRNGKey(666), 6, (-2, 2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    assert float(jnp.min(c1)) >= -2.0 and float(jnp.max(c1)) <= 2.0
+
+
+def test_centers_regularization():
+    c = jnp.asarray([1.0, 2.0])
+    assert float(centers_regularization(c, 0.1)) == pytest.approx(0.25)

@@ -1,0 +1,142 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.ops import sifinder as sf
+from dsin_tpu.ops.patches import assemble_patches, extract_patches
+
+
+def si_cfg(**over):
+    cfg = parse_config("use_L2andLAB = False\n")
+    return cfg.replace(**over) if over else cfg
+
+
+def test_patch_extract_assemble_roundtrip():
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.uniform(0, 255, (12, 16, 3)).astype(np.float32))
+    patches = extract_patches(img, 4, 8)
+    assert patches.shape == (6, 4, 8, 3)
+    back = assemble_patches(patches, 12, 16)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(img))
+    # grid order: patch 1 is the second column of the first row
+    np.testing.assert_array_equal(np.asarray(patches[1]),
+                                  np.asarray(img[0:4, 8:16]))
+
+
+def test_gaussian_mask_shape_and_peak():
+    m = sf.gaussian_position_mask(40, 48, 8, 12)  # grid 5x4 -> 20 patches
+    assert m.shape == (40 - 8 + 1, 48 - 12 + 1, 20)
+    assert m.max() <= 1.0 and m.min() > 0.0
+    # each patch's mask peaks near its own patch center
+    for p in [0, 7, 19]:
+        r, c = np.unravel_index(np.argmax(m[:, :, p]), m.shape[:2])
+        gh, gw = p // 4, p % 4
+        # mask is cropped by (patch//2 - 1); centers land at
+        # (gh+0.5)*8 - 3, (gw+0.5)*12 - 5 up to 1px discretization
+        assert abs(r - ((gh + 0.5) * 8 - 3)) <= 1.0
+        assert abs(c - ((gw + 0.5) * 12 - 5)) <= 1.0
+
+
+def test_pearson_scores_match_numpy():
+    rng = np.random.default_rng(1)
+    patches = rng.normal(size=(3, 4, 6, 2)).astype(np.float32)
+    img = rng.normal(size=(10, 12, 2)).astype(np.float32)
+    scores = np.asarray(sf.match_scores(jnp.asarray(patches),
+                                        jnp.asarray(img), use_l2=False))
+    assert scores.shape == (7, 7, 3)
+    for p in range(3):
+        for i in range(7):
+            for j in range(7):
+                win = img[i:i + 4, j:j + 6, :].ravel()
+                x = patches[p].ravel()
+                expect = np.corrcoef(x, win)[0, 1]
+                assert scores[i, j, p] == pytest.approx(expect, abs=2e-4)
+
+
+def test_l2_scores_match_numpy():
+    rng = np.random.default_rng(2)
+    patches = rng.normal(size=(2, 3, 3, 1)).astype(np.float32)
+    img = rng.normal(size=(6, 6, 1)).astype(np.float32)
+    scores = np.asarray(sf.match_scores(jnp.asarray(patches),
+                                        jnp.asarray(img), use_l2=True))
+    for p in range(2):
+        for i in range(4):
+            for j in range(4):
+                win = img[i:i + 3, j:j + 3, :]
+                expect = np.sum((win - patches[p]) ** 2)
+                assert scores[i, j, p] == pytest.approx(expect, abs=1e-3)
+
+
+def test_planted_patch_found_exactly():
+    """If y contains an exact (shifted) copy of an x patch, the search must
+    find it at the right offset and reproduce the pixels."""
+    rng = np.random.default_rng(3)
+    h, w, ph, pw = 24, 36, 8, 12
+    x = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    y = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    # plant x's patch (1, 2) into y at offset (11, 7)
+    src = x[8:16, 24:36, :]
+    y[11:19, 7:19, :] = src
+    res = sf.search_single(jnp.asarray(x), jnp.asarray(y), jnp.asarray(y),
+                           mask=None, patch_h=ph, patch_w=pw, use_l2=False)
+    p_idx = (8 // ph) * (w // pw) + 24 // pw  # grid (1, 2) -> index 5
+    assert int(res.row[p_idx]) == 11
+    assert int(res.col[p_idx]) == 7
+    y_syn = np.asarray(res.y_syn)
+    np.testing.assert_allclose(y_syn[8:16, 24:36, :], src, atol=1e-5)
+
+
+def test_planted_patch_found_l2_lab():
+    rng = np.random.default_rng(4)
+    h, w, ph, pw = 16, 24, 8, 12
+    x = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    y = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    src = x[0:8, 12:24, :]
+    y[5, 3, :] = 0  # noise
+    y[8:16, 6:18, :] = src
+    res = sf.search_single(jnp.asarray(x), jnp.asarray(y), jnp.asarray(y),
+                           mask=None, patch_h=ph, patch_w=pw, use_l2=True)
+    assert int(res.row[1]) == 8
+    assert int(res.col[1]) == 6
+
+
+def test_batched_synthesis_vmap():
+    rng = np.random.default_rng(5)
+    x = rng.uniform(0, 255, (2, 16, 24, 3)).astype(np.float32)
+    y = rng.uniform(0, 255, (2, 16, 24, 3)).astype(np.float32)
+    mask = jnp.asarray(sf.gaussian_position_mask(16, 24, 8, 12))
+    out = sf.synthesize_side_image(jnp.asarray(x), jnp.asarray(y),
+                                   jnp.asarray(y), mask, 8, 12, si_cfg())
+    assert out.shape == (2, 16, 24, 3)
+    # every output pixel comes from y (patches are gathered, not blended)
+    for n in range(2):
+        for patch in range(2 * 2):
+            r0 = (patch // 2) * 8
+            c0 = (patch % 2) * 12
+            block = np.asarray(out[n, r0:r0 + 8, c0:c0 + 12])
+            # block must appear somewhere in y[n]
+            found = False
+            for i in range(9):
+                for j in range(13):
+                    if np.allclose(y[n, i:i + 8, j:j + 12], block, atol=1e-5):
+                        found = True
+                        break
+                if found:
+                    break
+            assert found, f"block {patch} of batch {n} not a window of y"
+
+
+def test_identity_pair_with_mask_prefers_own_position():
+    """x == y: with the Gaussian prior, each patch should match itself."""
+    rng = np.random.default_rng(6)
+    h, w, ph, pw = 24, 24, 8, 12
+    x = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    mask = jnp.asarray(sf.gaussian_position_mask(h, w, ph, pw))
+    res = sf.search_single(jnp.asarray(x), jnp.asarray(x), jnp.asarray(x),
+                           mask=mask, patch_h=ph, patch_w=pw, use_l2=False)
+    for p in range((h // ph) * (w // pw)):
+        assert int(res.row[p]) == (p // 2) * ph
+        assert int(res.col[p]) == (p % 2) * pw
+    np.testing.assert_allclose(np.asarray(res.y_syn), x, atol=1e-5)

@@ -1,0 +1,170 @@
+"""End-to-end train-step tests on tiny shapes (CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.models.dsin import DSIN
+from dsin_tpu.train import optim as optim_lib
+from dsin_tpu.train import step as step_lib
+
+
+def tiny_ae_cfg(**over):
+    cfg = parse_config(
+        """
+        arch = CVPR
+        arch_param_B = 1
+        num_chan_bn = 4
+        heatmap = True
+        num_centers = 6
+        centers_initial_range = (-2, 2)
+        normalization = 'FIXED'
+        AE_only = True
+        si_weight = 0.7
+        y_patch_size = (8, 12)
+        use_gauss_mask = True
+        use_L2andLAB = False
+        batch_size = 2
+        num_crops_per_img = 1
+        H_target = 0.08
+        beta = 500
+        distortion_to_minimize = 'mae'
+        K_psnr = 100
+        K_ms_ssim = 5000
+        regularization_factor = 0.0005
+        regularization_factor_centers = 0.01
+        optimizer = 'ADAM'
+        lr_initial = 3e-4
+        lr_schedule = 'FIXED'
+        train_autoencoder = True
+        train_probclass = True
+        lr_centers_factor = None
+        bn_stats = 'update'
+        """)
+    return cfg.replace(**over) if over else cfg
+
+
+def tiny_pc_cfg():
+    return parse_config(
+        """
+        arch = res_shallow
+        kernel_size = 3
+        arch_param__k = 6
+        use_centers_for_padding = True
+        regularization_factor = None
+        optimizer = 'ADAM'
+        lr_initial = 3e-4
+        lr_schedule = 'FIXED'
+        """)
+
+
+def synthetic_batch(rng, n, h, w):
+    """Correlated (x, y): y is a shifted, slightly noised copy of x."""
+    base = rng.uniform(0, 255, (n, h, w + 8, 3)).astype(np.float32)
+    x = base[:, :, :w, :]
+    y = np.clip(base[:, :, 8:, :] + rng.normal(0, 4, (n, h, w, 3)), 0, 255)
+    return jnp.asarray(x), jnp.asarray(y.astype(np.float32))
+
+
+def test_ae_only_train_loss_descends():
+    ae_cfg, pc_cfg = tiny_ae_cfg(), tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    tx = optim_lib.build_optimizer(
+        model.init_variables(jax.random.PRNGKey(0),
+                             (2, 16, 24, 3)).params,
+        ae_cfg, pc_cfg, num_training_imgs=10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        (2, 16, 24, 3), tx)
+    train_step = step_lib.make_train_step(model, tx, donate=False)
+
+    rng = np.random.default_rng(0)
+    x, y = synthetic_batch(rng, 2, 16, 24)
+    losses = []
+    for _ in range(12):
+        state, metrics = train_step(state, x, y)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 12
+
+
+def test_ae_only_eval_step_runs():
+    ae_cfg, pc_cfg = tiny_ae_cfg(), tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    params = model.init_variables(jax.random.PRNGKey(0), (1, 16, 24, 3)).params
+    tx = optim_lib.build_optimizer(params, ae_cfg, pc_cfg, 10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        (1, 16, 24, 3), tx)
+    eval_step = step_lib.make_eval_step(model)
+    rng = np.random.default_rng(1)
+    x, y = synthetic_batch(rng, 1, 16, 24)
+    m = eval_step(state, x, y)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["si_l1"]) == 0.0
+    assert float(m["bpp"]) > 0.0
+
+
+def test_frozen_bn_stats_mode():
+    ae_cfg, pc_cfg = tiny_ae_cfg(bn_stats="frozen"), tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    params = model.init_variables(jax.random.PRNGKey(0), (2, 16, 24, 3)).params
+    tx = optim_lib.build_optimizer(params, ae_cfg, pc_cfg, 10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        (2, 16, 24, 3), tx)
+    train_step = step_lib.make_train_step(model, tx, donate=False)
+    rng = np.random.default_rng(2)
+    x, y = synthetic_batch(rng, 2, 16, 24)
+    before = jax.tree_util.tree_leaves(state.batch_stats)
+    state, _ = train_step(state, x, y)
+    after = jax.tree_util.tree_leaves(state.batch_stats)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_full_dsin_train_step_descends():
+    """Full pipeline: AE + probclass + siFinder + siNet."""
+    ae_cfg = tiny_ae_cfg(AE_only=False, crop_size=(16, 24))
+    pc_cfg = tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    assert model.si_weight == pytest.approx(0.7)
+    params = model.init_variables(jax.random.PRNGKey(0), (2, 16, 24, 3)).params
+    assert "sinet" in params
+    tx = optim_lib.build_optimizer(params, ae_cfg, pc_cfg, 10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        (2, 16, 24, 3), tx)
+
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    mask = jnp.asarray(gaussian_position_mask(16, 24, 8, 12))
+    train_step = step_lib.make_train_step(model, tx, si_mask=mask,
+                                          donate=False)
+    rng = np.random.default_rng(3)
+    x, y = synthetic_batch(rng, 2, 16, 24)
+    losses = []
+    for _ in range(8):
+        state, metrics = train_step(state, x, y)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert float(metrics["si_l1"]) > 0.0
+    assert losses[-1] < losses[0], losses
+
+
+def test_full_dsin_inference_step():
+    ae_cfg = tiny_ae_cfg(AE_only=False, crop_size=(16, 24))
+    pc_cfg = tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    params = model.init_variables(jax.random.PRNGKey(0), (1, 16, 24, 3)).params
+    tx = optim_lib.build_optimizer(params, ae_cfg, pc_cfg, 10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        (1, 16, 24, 3), tx)
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    mask = jnp.asarray(gaussian_position_mask(16, 24, 8, 12))
+    infer = step_lib.make_inference_step(model, si_mask=mask)
+    rng = np.random.default_rng(4)
+    x, y = synthetic_batch(rng, 1, 16, 24)
+    out = infer(state, x, y)
+    assert out["x_dec"].shape == x.shape
+    assert out["x_with_si"].shape == x.shape
+    assert out["y_syn"].shape == x.shape
+    assert np.isfinite(float(out["bpp"]))
