@@ -1,0 +1,9 @@
+"""Host-side evaluation: numpy oracles, score lists, image dumps, plots."""
+
+from dsin_tpu.eval.msssim_np import multiscale_ssim_np
+from dsin_tpu.eval.reporting import (ScoreLists, l1_np, mse_np,
+                                     pearson_per_patch, psnr_np, save_image,
+                                     image_output_path)
+
+__all__ = ["multiscale_ssim_np", "ScoreLists", "l1_np", "mse_np", "psnr_np",
+           "pearson_per_patch", "save_image", "image_output_path"]

@@ -1,0 +1,65 @@
+"""Optional matplotlib figures: loss curves + inference panels.
+
+Capability parity with the reference's plotting helpers
+(reference utils.py:12-79: `plot_loss`, `plot_inference`). Matplotlib is
+imported lazily with the Agg backend so headless training never needs a
+display and the dependency stays optional.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+def _plt():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def plot_loss(train_losses: Sequence[float], val_losses: Sequence[float],
+              val_every: int, out_path: str,
+              title: str = "loss") -> None:
+    """Train/val loss curves on one axis (reference utils.py:12-32)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.plot(range(len(train_losses)), train_losses, label="train")
+    if val_losses:
+        xs = [min((i + 1) * val_every, len(train_losses))
+              for i in range(len(val_losses))]
+        ax.plot(xs, val_losses, label="val", marker="o", markersize=3)
+    ax.set_xlabel("iteration")
+    ax.set_ylabel("loss")
+    ax.set_title(title)
+    ax.legend()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, bbox_inches="tight")
+    plt.close(fig)
+
+
+def plot_inference(x, x_dec, x_with_si, y, y_syn, out_path: str,
+                   bpp: Optional[float] = None) -> None:
+    """5-panel inference figure: x, x̂ (AE), x+SI, y, y_syn
+    (reference utils.py:35-79)."""
+    import numpy as np
+    plt = _plt()
+    panels = [("x (input)", x), ("x_dec (AE)", x_dec),
+              ("x_with_si (final)", x_with_si), ("y (side info)", y),
+              ("y_syn (matched)", y_syn)]
+    fig, axes = plt.subplots(len(panels), 1,
+                             figsize=(10, 2.2 * len(panels)))
+    for ax, (name, img) in zip(axes, panels):
+        if img is None:
+            ax.axis("off")
+            continue
+        arr = np.clip(np.asarray(img), 0, 255).astype(np.uint8)
+        ax.imshow(arr)
+        ax.set_title(name, fontsize=9)
+        ax.axis("off")
+    if bpp is not None:
+        fig.suptitle(f"{bpp:.4f} bpp")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, bbox_inches="tight", dpi=120)
+    plt.close(fig)

@@ -111,11 +111,15 @@ class ResShallow(nn.Module):
         ks = self.config.kernel_size
         net = _MaskedConv3D(k, ks, include_center=False)(vol)
         net = nn.relu(net)
-        # residual block (2 masked convs, relu between, cropped skip)
+        # residual block (2 masked convs, relu between, cropped skip);
+        # the skip crop undoes two VALID convs' shrinkage: depth loses K//2
+        # per conv (all from the front — padding sits there), H/W lose
+        # (K-1)//2 per side per conv (reference :196 hardcodes K=3's 2/2/2)
         inp = net
         net = nn.relu(_MaskedConv3D(k, ks, include_center=True)(net))
         net = _MaskedConv3D(k, ks, include_center=True)(net)
-        net = net + inp[:, 2:, 2:-2, 2:-2, :]
+        dd, hw = 2 * (ks // 2), ks - 1
+        net = net + inp[:, dd:, hw:-hw, hw:-hw, :]
         net = _MaskedConv3D(self.num_centers, ks, include_center=True)(net)
         # the reference's conv3d applies its default ReLU even to this final
         # logits layer (probclass_imgcomp.py:220,234,260) — logits are >= 0

@@ -150,7 +150,11 @@ def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
 
     scores = match_scores(q, r, use_l2)
     if mask is not None:
-        scores = scores * mask
+        # Pearson (argmax): multiply — distant positions are damped.
+        # L2 (argmin): divide — the reference multiplies here too
+        # (siFinder.py:20-29), which INVERTS the prior (shrinking distant
+        # distances toward 0 makes argmin prefer them); deliberate deviation.
+        scores = scores / jnp.maximum(mask, 1e-8) if use_l2 else scores * mask
     best, rows, cols = find_matches(scores, use_l2)
     y_patches = gather_patches(y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)

@@ -1,0 +1,124 @@
+import os
+
+import numpy as np
+import pytest
+
+from dsin_tpu.eval import (ScoreLists, l1_np, mse_np, multiscale_ssim_np,
+                           pearson_per_patch, psnr_np, save_image,
+                           image_output_path)
+
+
+def _rand_img(shape=(48, 64, 3), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0, 255, size=shape).astype(np.float32)
+
+
+def test_msssim_np_identity():
+    x = _rand_img((192, 192, 3))
+    assert multiscale_ssim_np(x, x) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_msssim_np_monotone_in_noise():
+    rng = np.random.default_rng(1)
+    x = _rand_img((192, 192, 3), seed=1)
+    light = np.clip(x + rng.normal(0, 4, x.shape), 0, 255)
+    heavy = np.clip(x + rng.normal(0, 40, x.shape), 0, 255)
+    assert multiscale_ssim_np(x, light) > multiscale_ssim_np(x, heavy)
+
+
+def test_msssim_np_matches_jax_path():
+    from dsin_tpu.ops.msssim import multiscale_ssim
+    rng = np.random.default_rng(2)
+    x = _rand_img((1, 180, 184, 3), seed=2)
+    y = np.clip(x + rng.normal(0, 12, x.shape), 0, 255).astype(np.float32)
+    assert multiscale_ssim_np(x, y) == pytest.approx(
+        float(multiscale_ssim(x, y)), abs=2e-4)
+
+
+def test_l1_psnr_int_truncation():
+    x = np.array([[[10.6, 20.2, 0.0]]], dtype=np.float32)
+    y = np.array([[[12.0, 19.0, 0.0]]], dtype=np.float32)
+    # int-truncated: |12-10|=2, |19-20|=1, 0 -> mean 1.0
+    assert l1_np(x, y) == pytest.approx(1.0)
+    assert mse_np(x, y) == pytest.approx((4 + 1 + 0) / 3)
+    assert psnr_np(x, y) == pytest.approx(10 * np.log10(255 ** 2 / (5 / 3)))
+
+
+def test_pearson_per_patch_signs():
+    x = _rand_img((40, 48, 3), seed=3)
+    gain = 2.0 * x + 5.0       # affine -> corr 1
+    neg = 255.0 - x            # negation -> corr -1
+    const = np.full_like(x, 7)  # constant -> corr 0
+    p_gain = pearson_per_patch(x, gain, 20, 24)
+    p_neg = pearson_per_patch(x, neg, 20, 24)
+    p_const = pearson_per_patch(x, const, 20, 24)
+    assert p_gain.shape == (4,)
+    np.testing.assert_allclose(p_gain, 1.0, atol=1e-10)
+    np.testing.assert_allclose(p_neg, -1.0, atol=1e-10)
+    np.testing.assert_allclose(p_const, 0.0, atol=1e-12)
+
+
+def test_save_image_roundtrip(tmp_path):
+    from PIL import Image
+    img = _rand_img((16, 20, 3), seed=4)
+    path = image_output_path(str(tmp_path / "imgs"), 3, 0.0213)
+    assert path.endswith("3_0.0213bpp.png")
+    save_image(img, path)
+    back = np.asarray(Image.open(path))
+    np.testing.assert_array_equal(back, np.clip(img, 0, 255).astype(np.uint8))
+
+
+def test_score_lists_accumulate_save_load(tmp_path):
+    out = str(tmp_path)
+    lists = ScoreLists(out, "modelA")
+    x = _rand_img((40, 48, 3), seed=5)
+    rng = np.random.default_rng(6)
+    x_out = np.clip(x + rng.normal(0, 6, x.shape), 0, 255).astype(np.float32)
+    y_syn = np.clip(x + rng.normal(0, 30, x.shape), 0, 255).astype(np.float32)
+
+    s1 = lists.add_image(x, x_out, bpp=0.02, y_syn=y_syn, patch_size=(20, 24))
+    s2 = lists.add_image(x, x_out, bpp=0.03)
+    assert set(s1) == set(ScoreLists.METRICS)
+    assert "mse_x_ysyn" not in s2
+    lists.save()
+
+    bpps = ScoreLists.load_list(out, "bpp", "modelA")
+    np.testing.assert_allclose(bpps, [0.02, 0.03])
+    # row i of every file refers to image i: missing metrics become nan
+    pears = ScoreLists.load_list(out, "pearson_x_ysyn", "modelA")
+    assert pears.shape == (2,)
+    assert np.isnan(pears[1])
+    means = lists.means()
+    assert means["bpp"] == pytest.approx(0.025)
+    assert not np.isnan(means["pearson_x_ysyn"])  # nan-ignoring
+
+    # save() is idempotent / incremental: re-saving appends nothing
+    lists.save()
+    assert len(ScoreLists.load_list(out, "bpp", "modelA")) == 2
+    lists.add_image(x, x_out, bpp=0.05)
+    lists.save()
+    np.testing.assert_allclose(ScoreLists.load_list(out, "bpp", "modelA"),
+                               [0.02, 0.03, 0.05])
+
+    # append semantics: a second run extends the lists
+    lists2 = ScoreLists(out, "modelA")
+    lists2.add_image(x, x_out, bpp=0.04)
+    lists2.save()
+    assert len(ScoreLists.load_list(out, "bpp", "modelA")) == 4
+
+
+def test_psnr_identical_images_is_inf():
+    x = _rand_img((8, 8, 3), seed=9)
+    assert np.isinf(psnr_np(x, x))
+
+
+def test_plots_smoke(tmp_path):
+    from dsin_tpu.eval.plots import plot_inference, plot_loss
+    loss_path = str(tmp_path / "loss.png")
+    plot_loss([3.0, 2.0, 1.5, 1.2], [2.5, 1.4], val_every=2,
+              out_path=loss_path)
+    assert os.path.getsize(loss_path) > 0
+    x = _rand_img((20, 48, 3), seed=7)
+    inf_path = str(tmp_path / "inf.png")
+    plot_inference(x, x, x, x, None, inf_path, bpp=0.02)
+    assert os.path.getsize(inf_path) > 0

@@ -108,3 +108,15 @@ def test_auto_pad_value():
     assert float(pc_lib.auto_pad_value(pc_cfg(), centers)) == pytest.approx(0.7)
     assert pc_lib.auto_pad_value(pc_cfg(use_centers_for_padding=False),
                                  centers) == 0.0
+
+
+def test_kernel_size_5_shapes():
+    """The residual skip crop must track kernel_size, not hardcode K=3."""
+    cfg = pc_cfg(kernel_size=5, use_centers_for_padding=False)
+    net = pc_lib.get_network_cls(cfg)(cfg, num_centers=6)
+    q = jnp.zeros((1, 12, 16, 4), jnp.float32)
+    vol = jnp.transpose(q, (0, 3, 1, 2))[..., None]
+    vol = pc_lib.pad_volume(vol, 5, 0.0)
+    variables = net.init(jax.random.PRNGKey(0), vol)
+    logits = pc_lib.logits_from_q(net, variables, q, 0.0)
+    assert logits.shape == (1, 12, 16, 4, 6)

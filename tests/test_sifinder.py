@@ -140,3 +140,19 @@ def test_identity_pair_with_mask_prefers_own_position():
         assert int(res.row[p]) == (p // 2) * ph
         assert int(res.col[p]) == (p % 2) * pw
     np.testing.assert_allclose(np.asarray(res.y_syn), x, atol=1e-5)
+
+
+def test_l2_mode_mask_keeps_exact_match():
+    """L2 + Gaussian prior: an exact copy must win even far from center —
+    the prior divides distances (masking by multiplication would invert
+    the prior and is a known reference bug we deliberately fix)."""
+    rng = np.random.default_rng(7)
+    h, w, ph, pw = 24, 24, 8, 12
+    x = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    mask = jnp.asarray(sf.gaussian_position_mask(h, w, ph, pw))
+    res = sf.search_single(jnp.asarray(x), jnp.asarray(x), jnp.asarray(x),
+                           mask=mask, patch_h=ph, patch_w=pw, use_l2=True)
+    for p in range((h // ph) * (w // pw)):
+        assert int(res.row[p]) == (p // 2) * ph
+        assert int(res.col[p]) == (p % 2) * pw
+    np.testing.assert_allclose(np.asarray(res.y_syn), x, atol=1e-4)
