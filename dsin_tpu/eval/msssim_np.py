@@ -89,5 +89,9 @@ def multiscale_ssim_np(img1: np.ndarray, img2: np.ndarray, *,
         if lvl < levels - 1:
             a, b = _downsample_2x(a), _downsample_2x(b)
 
+    # clamp to >= 0 before the fractional powers (negative mean cs from an
+    # anti-correlated scale would give NaN); mirrors the device path
+    mcs = np.maximum(mcs, 0.0)
+    mssim = np.maximum(mssim, 0.0)
     w = _WEIGHTS[:levels]
     return float(np.prod(mcs[:-1] ** w[:-1]) * (mssim[-1] ** w[-1]))

@@ -120,11 +120,13 @@ class ScoreLists:
         return scores
 
     def means(self) -> Dict[str, float]:
-        """Per-metric nan-ignoring means over the images seen so far."""
+        """Per-metric means over the finite values seen so far (nan rows mark
+        metrics not computed; inf PSNR from an exact reconstruction must not
+        make the whole run's mean inf)."""
         out = {}
         for k, v in self.values.items():
             arr = np.asarray(v, dtype=np.float64)
-            arr = arr[~np.isnan(arr)]
+            arr = arr[np.isfinite(arr)]
             if arr.size:
                 out[k] = float(arr.mean())
         return out

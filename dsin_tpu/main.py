@@ -1,0 +1,311 @@
+"""Train / validate / test orchestration + CLI.
+
+Capability parity with the reference driver (reference main.py): parse the
+two config files, build the model + datasets, run the fetch→step training
+loop with periodic validation (the validation interval shrinks in the last
+half of training), keep the best-val checkpoint with config/last-saved
+sidecars, and on `test_model` run the test split through full inference,
+dumping reconstruction PNGs and per-image score lists.
+
+TPU-first differences from the reference:
+  * one jitted train step (no 3x sess.run round trips), donated state;
+  * data-parallel over every local device via a `jax.sharding.Mesh` when
+    the batch is shardable (the reference is strictly single-GPU);
+  * observability the reference lacks: images/sec, JSONL scalar logs,
+    device memory stats (dsin_tpu.utils).
+
+CLI (reference main.py:214-224):
+    python -m dsin_tpu.main -ae_config <path> -pc_config <path> \
+        [--out_root DIR] [--data_root DIR] [--max_steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsin_tpu.config import Config, parse_config_file
+from dsin_tpu.data.loader import PairDataset, Prefetcher
+from dsin_tpu.data.manifest import read_pair_manifest
+from dsin_tpu.models.dsin import DSIN
+from dsin_tpu.ops.sifinder import gaussian_position_mask
+from dsin_tpu.train import checkpoint as ckpt_lib
+from dsin_tpu.train import optim as optim_lib
+from dsin_tpu.train import step as step_lib
+from dsin_tpu.utils import JsonlLogger, StepTimer, color_print
+
+
+def get_validate_every(iteration: int, total_iterations: int,
+                       validate_every: int,
+                       decrease_val_steps: bool) -> int:
+    """Validation interval shrinks as training converges: /2 after half the
+    iterations, /4 after three quarters (reference main.py:129-138) — late
+    improvements are rarer, so best-val checkpointing needs finer sampling."""
+    if not decrease_val_steps:
+        return validate_every
+    if iteration >= (3 * total_iterations) // 4:
+        return max(validate_every // 4, 1)
+    if iteration >= total_iterations // 2:
+        return max(validate_every // 2, 1)
+    return validate_every
+
+
+class Experiment:
+    """Owns model, train state, jitted steps, and datasets for one run."""
+
+    def __init__(self, ae_config: Config, pc_config: Config,
+                 out_root: str = ".", seed: int = 0,
+                 use_mesh: Optional[bool] = None):
+        self.ae_config = ae_config
+        self.pc_config = pc_config
+        self.out_root = out_root
+        self.model = DSIN(ae_config, pc_config)
+
+        train_manifest = os.path.join(ae_config.root_data,
+                                      ae_config.file_path_train)
+        self.num_train_imgs = (
+            len(read_pair_manifest(train_manifest, root=ae_config.root_data))
+            if os.path.exists(train_manifest) else 1576)
+
+        ch, cw = ae_config.crop_size
+        shape = (ae_config.batch_size, ch, cw, 3)
+        self.tx = optim_lib.build_optimizer(
+            None, ae_config, pc_config, num_training_imgs=self.num_train_imgs)
+        self.state = step_lib.create_train_state(
+            self.model, jax.random.PRNGKey(seed), shape, self.tx)
+
+        ph, pw = ae_config.y_patch_size
+        self.train_mask = (jnp.asarray(gaussian_position_mask(ch, cw, ph, pw))
+                           if ae_config.use_gauss_mask else None)
+        eh, ew = ae_config.get("eval_crop_size", ae_config.crop_size)
+        self.eval_mask = (jnp.asarray(gaussian_position_mask(eh, ew, ph, pw))
+                          if ae_config.use_gauss_mask else None)
+
+        n_dev = jax.local_device_count()
+        if use_mesh is None:
+            use_mesh = n_dev > 1 and ae_config.batch_size % n_dev == 0
+        self.mesh = None
+        if use_mesh:
+            from dsin_tpu.parallel import data_parallel as dp
+            from dsin_tpu.parallel import mesh as mesh_lib
+            self.mesh = mesh_lib.make_mesh()
+            self.state = mesh_lib.replicate_state(self.mesh, self.state)
+            self.train_step = dp.make_sharded_train_step(
+                self.model, self.tx, self.mesh, si_mask=self.train_mask)
+            self.val_step = dp.make_sharded_eval_step(
+                self.model, self.mesh, si_mask=self.train_mask)
+            self._put = lambda x, y: mesh_lib.shard_batch(self.mesh, x, y)
+        else:
+            self.train_step = step_lib.make_train_step(
+                self.model, self.tx, si_mask=self.train_mask)
+            self.val_step = step_lib.make_eval_step(
+                self.model, si_mask=self.train_mask)
+            self._put = lambda x, y: (jnp.asarray(x), jnp.asarray(y))
+        self.infer_step = step_lib.make_inference_step(
+            self.model, si_mask=self.eval_mask)
+
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        self.model_name = ckpt_lib.model_name_for(ae_config, stamp)
+        self.weights_root = os.path.join(out_root, "weights")
+        self.ckpt_dir = os.path.join(self.weights_root, self.model_name)
+        self.images_dir = os.path.join(out_root, "images", self.model_name)
+
+    # -- data ---------------------------------------------------------------
+
+    def _dataset(self, split: str, train: bool) -> PairDataset:
+        cfg = self.ae_config
+        manifest = os.path.join(cfg.root_data,
+                                getattr(cfg, f"file_path_{split}"))
+        pairs = read_pair_manifest(manifest, root=cfg.root_data)
+        crop = (cfg.crop_size if train or split == "val"
+                else cfg.get("eval_crop_size", cfg.crop_size))
+        return PairDataset(
+            pairs, crop_size=crop,
+            batch_size=cfg.batch_size if train or split == "val" else 1,
+            train=train, num_crops_per_img=cfg.num_crops_per_img,
+            do_flips=cfg.get("do_flips", True),
+            host_id=jax.process_index(), num_hosts=jax.process_count())
+
+    # -- restore ------------------------------------------------------------
+
+    def maybe_restore(self) -> None:
+        cfg = self.ae_config
+        if not cfg.load_model:
+            return
+        load_dir = os.path.join(self.weights_root, cfg.load_model_name)
+        self.state = ckpt_lib.restore_for_mode(load_dir, self.state, cfg)
+        color_print(f"restored from {load_dir} "
+                    f"(step {int(self.state.step)})", "green")
+
+    # -- train --------------------------------------------------------------
+
+    def validate(self, val_batches: Iterator, max_batches: Optional[int] = None
+                 ) -> float:
+        losses = []
+        for i, (x, y) in enumerate(val_batches):
+            if max_batches is not None and i >= max_batches:
+                break
+            metrics = self.val_step(self.state, *self._put(x, y))
+            losses.append(float(metrics["loss"]))
+        return float(np.mean(losses)) if losses else float("inf")
+
+    def train(self, max_steps: Optional[int] = None,
+              max_val_batches: Optional[int] = None,
+              log_path: Optional[str] = None) -> Dict[str, float]:
+        """The fetch→step→validate loop (reference main.py:49-91). Returns
+        summary stats. `max_steps`/`max_val_batches` bound the run (tests,
+        smoke runs); None = full config iterations."""
+        cfg = self.ae_config
+        iterations = min(cfg.iterations, max_steps or cfg.iterations)
+        train_it = Prefetcher(self._dataset("train", train=True).batches())
+        logger = JsonlLogger(log_path or os.path.join(
+            self.out_root, "logs", f"{self.model_name}.jsonl"))
+        timer = StepTimer()
+        best_val = float("inf")
+        accum: Dict[str, float] = {}
+        n_accum = 0
+        val_losses = []
+
+        try:
+            from tqdm import trange
+            rng_iter = trange(iterations, desc="train", dynamic_ncols=True)
+        except ImportError:
+            rng_iter = range(iterations)
+
+        for i in rng_iter:
+            x, y = next(train_it)
+            self.state, metrics = self.train_step(self.state,
+                                                  *self._put(x, y))
+            loss = float(metrics["loss"])  # blocks; keeps timer honest
+            timer.tick()
+            for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
+                accum[k] = accum.get(k, 0.0) + float(metrics[k])
+            n_accum += 1
+
+            if (i + 1) % cfg.show_every == 0 or i + 1 == iterations:
+                means = {k: v / n_accum for k, v in accum.items()}
+                accum, n_accum = {}, 0
+                ips = timer.images_per_sec(cfg.batch_size)
+                color_print(
+                    f"[{i + 1}/{iterations}] loss={means['loss']:.4f} "
+                    f"bpp={means['bpp']:.4f} d={means['d_loss']:.4f} "
+                    f"{ips:.2f} img/s", "cyan")
+                logger.log(i + 1, means, images_per_sec=ips)
+
+            ve = get_validate_every(i, iterations, cfg.validate_every,
+                                    cfg.get("decrease_val_steps", True))
+            if (i + 1) % ve == 0 or i + 1 == iterations:
+                val_loss = self.validate(
+                    self._dataset("val", train=False).batches(loop=False),
+                    max_batches=max_val_batches)
+                val_losses.append(val_loss)
+                improved = val_loss < best_val
+                color_print(f"[{i + 1}] val_loss={val_loss:.4f} "
+                            f"(best {min(best_val, val_loss):.4f})",
+                            "green" if improved else "yellow")
+                logger.log(i + 1, {"val_loss": val_loss})
+                if improved and cfg.get("save_model", True):
+                    best_val = val_loss
+                    ckpt_lib.save_checkpoint(self.ckpt_dir, self.state,
+                                             best_val=best_val)
+                    ckpt_lib.write_sidecars(
+                        self.weights_root, self.model_name, cfg,
+                        self.pc_config, iteration=i + 1,
+                        total_iterations=iterations, best_val=best_val)
+
+        logger.close()
+        return {"steps": timer.total_steps, "best_val": best_val,
+                "last_val": val_losses[-1] if val_losses else float("inf"),
+                "images_per_sec": timer.images_per_sec(cfg.batch_size)}
+
+    # -- test ---------------------------------------------------------------
+
+    def test(self, max_images: Optional[int] = None,
+             save_images: bool = True,
+             save_plots: bool = False) -> Dict[str, float]:
+        """Test-split inference: reconstruction PNGs + per-image score lists
+        (reference main.py:101-126)."""
+        from dsin_tpu.eval import ScoreLists, image_output_path, save_image
+        cfg = self.ae_config
+        lists = ScoreLists(self.images_dir, self.model_name)
+        for idx, (x, y) in enumerate(
+                self._dataset("test", train=False).batches(loop=False)):
+            if max_images is not None and idx >= max_images:
+                break
+            out = self.infer_step(self.state, jnp.asarray(x), jnp.asarray(y))
+            x_np = np.asarray(x[0])
+            xsi = np.clip(np.asarray(
+                out["x_with_si"] if not self.model.ae_only
+                else out["x_dec"])[0], 0, 255)
+            y_syn = (np.clip(np.asarray(out["y_syn"])[0], 0, 255)
+                     if out["y_syn"] is not None else None)
+            bpp = float(out["bpp"])
+            scores = lists.add_image(x_np, xsi, bpp=bpp, y_syn=y_syn,
+                                     patch_size=cfg.y_patch_size)
+            if save_images:
+                save_image(xsi, image_output_path(self.images_dir, idx, bpp))
+            if save_plots:
+                from dsin_tpu.eval.plots import plot_inference
+                plot_inference(
+                    x_np, np.asarray(out["x_dec"])[0], xsi, np.asarray(y[0]),
+                    y_syn, os.path.join(self.images_dir, f"{idx}_panels.png"),
+                    bpp=bpp)
+            lists.save()
+            color_print(f"test[{idx}] bpp={bpp:.4f} "
+                        f"psnr={scores['psnr']:.2f} "
+                        f"msssim={scores['ms_ssim']:.4f}", "blue")
+        means = lists.means()
+        if means:
+            color_print(f"test means: {means}", "magenta", bold=True)
+        return means
+
+
+def run(ae_config: Config, pc_config: Config, out_root: str = ".",
+        max_steps: Optional[int] = None,
+        max_val_batches: Optional[int] = None,
+        max_test_images: Optional[int] = None) -> Dict[str, float]:
+    """Config-driven orchestration (reference main.py:21-126)."""
+    exp = Experiment(ae_config, pc_config, out_root=out_root)
+    exp.maybe_restore()
+    results: Dict[str, float] = {}
+    if ae_config.train_model:
+        results.update(exp.train(max_steps=max_steps,
+                                 max_val_batches=max_val_batches))
+    if ae_config.test_model:
+        results.update(exp.test(max_images=max_test_images))
+    return results
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="dsin_tpu trainer")
+    base = os.path.join(os.path.dirname(__file__), "configs")
+    p.add_argument("-ae_config", default=os.path.join(base, "ae_kitti_stereo"))
+    p.add_argument("-pc_config", default=os.path.join(base, "pc_default"))
+    p.add_argument("--out_root", default=".")
+    p.add_argument("--data_root", default=None,
+                   help="override ae config root_data")
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--max_test_images", type=int, default=None)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    ae_config = parse_config_file(args.ae_config)
+    pc_config = parse_config_file(args.pc_config)
+    if args.data_root:
+        ae_config = ae_config.replace(root_data=args.data_root)
+    results = run(ae_config, pc_config, out_root=args.out_root,
+                  max_steps=args.max_steps,
+                  max_test_images=args.max_test_images)
+    color_print(f"done: {results}", "green", bold=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -140,7 +140,10 @@ def multiscale_ssim(img1: jnp.ndarray, img2: jnp.ndarray,
         im1 = _downsample_2x(im1)
         im2 = _downsample_2x(im2)
 
-    mcs_v = jnp.stack(mcs)
-    mssim_v = jnp.stack(mssim)
+    # clamp to >= 0 before the fractional powers: an anti-correlated scale
+    # makes mean cs negative and negative ** 0.0448 is NaN (which would halt
+    # training when MS-SSIM is the loss); same guard TF's ssim_multiscale uses
+    mcs_v = jnp.maximum(jnp.stack(mcs), 0.0)
+    mssim_v = jnp.maximum(jnp.stack(mssim), 0.0)
     return (jnp.prod(mcs_v[:levels - 1] ** weights[:levels - 1]) *
             (mssim_v[levels - 1] ** weights[levels - 1]))

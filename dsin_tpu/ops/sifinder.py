@@ -149,12 +149,24 @@ def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
     r = color_lib.search_transform(y_dec, use_l2)
 
     scores = match_scores(q, r, use_l2)
+    if use_l2:
+        # the conv-form distance |x|^2 - 2<x,y> + |y|^2 cancels
+        # catastrophically in float32 at near-matches (terms ~1e9, true
+        # distance ~0): clamp to the mathematical lower bound
+        scores = jnp.maximum(scores, 0.0)
     if mask is not None:
-        # Pearson (argmax): multiply — distant positions are damped.
-        # L2 (argmin): divide — the reference multiplies here too
-        # (siFinder.py:20-29), which INVERTS the prior (shrinking distant
-        # distances toward 0 makes argmin prefer them); deliberate deviation.
-        scores = scores / jnp.maximum(mask, 1e-8) if use_l2 else scores * mask
+        if use_l2:
+            # L2 (argmin): additive discount that grows with the prior —
+            # nearby positions get up to mean-distance knocked off, which
+            # dominates cancellation noise at exact-duplicate ties. The
+            # reference multiplies the mask here too (siFinder.py:20-29),
+            # INVERTING the prior (shrinking distant distances toward 0
+            # makes argmin prefer them); deliberate deviation. Dividing by
+            # the mask instead would re-amplify the float32 noise.
+            scores = scores - jnp.mean(scores) * mask
+        else:
+            # Pearson (argmax): multiply — distant positions are damped
+            scores = scores * mask
     best, rows, cols = find_matches(scores, use_l2)
     y_patches = gather_patches(y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)

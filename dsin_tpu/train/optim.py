@@ -13,7 +13,7 @@ over labeled partitions — a single fused update inside the jitted step.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import optax
@@ -79,8 +79,9 @@ def _label_tree(params: Dict[str, Any], ae_config) -> Dict[str, Any]:
             for part, sub in params.items()}
 
 
-def build_optimizer(params: Dict[str, Any], ae_config, pc_config,
+def build_optimizer(params: Optional[Dict[str, Any]], ae_config, pc_config,
                     num_training_imgs: int) -> optax.GradientTransformation:
+    """`params` may be None: labels are then computed lazily at tx.init."""
     batch = ae_config.batch_size
     crops = ae_config.num_crops_per_img
     ae_only = ae_config.AE_only
@@ -100,4 +101,6 @@ def build_optimizer(params: Dict[str, Any], ae_config, pc_config,
         centers_sched = lambda step: ae_sched(step) * factor  # noqa: E731
         transforms["centers"] = _base_optimizer(ae_config, centers_sched)
 
-    return optax.multi_transform(transforms, _label_tree(params, ae_config))
+    labels = (_label_tree(params, ae_config) if params is not None
+              else lambda p: _label_tree(p, ae_config))
+    return optax.multi_transform(transforms, labels)

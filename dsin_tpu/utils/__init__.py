@@ -1,0 +1,6 @@
+"""Observability: step timing, scalar logging, device memory stats."""
+
+from dsin_tpu.utils.logging import (JsonlLogger, StepTimer, color_print,
+                                    device_memory_stats)
+
+__all__ = ["JsonlLogger", "StepTimer", "color_print", "device_memory_stats"]

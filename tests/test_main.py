@@ -1,0 +1,161 @@
+"""End-to-end orchestration test: synthetic dataset -> train/val/test loop."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.main import Experiment, get_validate_every, run
+
+
+def _make_dataset(root, n_pairs=3, h=40, w=56, seed=0):
+    """Write n_pairs correlated PNG pairs + train/val/test manifests."""
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    os.makedirs(os.path.join(root, "imgs"), exist_ok=True)
+    lines = []
+    for i in range(n_pairs):
+        x = rng.uniform(0, 255, (h, w, 3)).astype(np.uint8)
+        y = np.clip(x.astype(np.int32) + rng.integers(-6, 6, x.shape), 0,
+                    255).astype(np.uint8)
+        xp, yp = f"imgs/x_{i}.png", f"imgs/y_{i}.png"
+        Image.fromarray(x).save(os.path.join(root, xp))
+        Image.fromarray(y).save(os.path.join(root, yp))
+        lines += [xp, yp]
+    for split in ("train", "val", "test"):
+        with open(os.path.join(root, f"{split}.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+def _configs(root, ae_only=False):
+    ae = parse_config(f"""
+        iterations = 4
+        crop_size = (32, 48)
+        eval_crop_size = (32, 48)
+        batch_size = 1
+        num_crops_per_img = 1
+        do_flips = True
+        show_every = 2
+        validate_every = 2
+        decrease_val_steps = False
+        arch = CVPR
+        arch_param_B = 1
+        num_chan_bn = 8
+        heatmap = True
+        num_centers = 6
+        centers_initial_range = (-2, 2)
+        AE_only = {ae_only}
+        si_weight = 0.7
+        y_patch_size = (8, 12)
+        use_gauss_mask = True
+        use_L2andLAB = False
+        H_target = 0.08
+        beta = 500
+        distortion_to_minimize = 'mae'
+        K_psnr = 100
+        K_ms_ssim = 5000
+        regularization_factor = 0.0005
+        regularization_factor_centers = 0.01
+        normalization = 'FIXED'
+        bn_stats = 'update'
+        optimizer = 'ADAM'
+        optimizer_momentum = 0.9
+        lr_initial = 1e-4
+        lr_schedule = 'FIXED'
+        lr_centers_factor = None
+        train_autoencoder = True
+        train_probclass = True
+        load_model = False
+        load_train_step = False
+        train_model = True
+        test_model = True
+        save_model = True
+        load_model_name = ''
+        root_data = '{root}'
+        file_path_train = 'train.txt'
+        file_path_val = 'val.txt'
+        file_path_test = 'test.txt'
+        """)
+    pc = parse_config("""
+        arch = res_shallow
+        kernel_size = 3
+        arch_param__k = 8
+        use_centers_for_padding = True
+        regularization_factor = None
+        optimizer = 'ADAM'
+        optimizer_momentum = 0.9
+        lr_initial = 1e-4
+        lr_schedule = 'FIXED'
+        """)
+    return ae, pc
+
+
+def test_get_validate_every_schedule():
+    assert get_validate_every(0, 1000, 100, True) == 100
+    assert get_validate_every(499, 1000, 100, True) == 100
+    assert get_validate_every(500, 1000, 100, True) == 50
+    assert get_validate_every(750, 1000, 100, True) == 25
+    assert get_validate_every(900, 1000, 100, False) == 100
+
+
+@pytest.mark.slow
+def test_full_run_train_val_test(tmp_path):
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root)
+
+    results = run(ae, pc, out_root=out, max_steps=4, max_val_batches=2,
+                  max_test_images=2)
+
+    assert results["steps"] == 4
+    assert np.isfinite(results["best_val"])
+    assert "bpp" in results and "psnr" in results  # test-split means
+
+    # best-val checkpoint + sidecars exist
+    weights = os.path.join(out, "weights")
+    names = [d for d in os.listdir(weights)
+             if os.path.isdir(os.path.join(weights, d))]
+    assert len(names) == 1
+    ckpt = os.path.join(weights, names[0])
+    assert os.path.exists(os.path.join(ckpt, "params_encoder.msgpack"))
+    assert os.path.exists(os.path.join(ckpt, "meta.json"))
+    assert os.path.exists(os.path.join(weights, f"last_saved_{names[0]}.txt"))
+    assert os.path.exists(os.path.join(weights, f"configs_{names[0]}.txt"))
+
+    # test images + score lists were dumped
+    images = os.path.join(out, "images", names[0])
+    pngs = [f for f in os.listdir(images) if f.endswith("bpp.png")]
+    assert len(pngs) == 2
+    assert any(f.startswith("bpp_list") for f in os.listdir(images))
+
+    # jsonl scalar log has train + val records
+    logs = os.path.join(out, "logs", f"{names[0]}.jsonl")
+    with open(logs) as f:
+        recs = [json.loads(line) for line in f]
+    assert any("val_loss" in r for r in recs)
+    assert any("images_per_sec" in r for r in recs)
+
+
+@pytest.mark.slow
+def test_restore_roundtrip(tmp_path):
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root)
+
+    exp = Experiment(ae, pc, out_root=out)
+    exp.train(max_steps=2, max_val_batches=1)
+    name = exp.model_name
+
+    # second experiment restores AE+siNet+opt (resume semantics)
+    ae2 = ae.replace(load_model=True, load_train_step=True,
+                     load_model_name=name)
+    exp2 = Experiment(ae2, pc, out_root=out)
+    exp2.maybe_restore()
+    assert int(exp2.state.step) == int(exp.state.step)
+    np.testing.assert_allclose(
+        np.asarray(exp2.state.params["centers"]),
+        np.asarray(exp.state.params["centers"]))
