@@ -1,0 +1,13 @@
+"""Real entropy coding: rANS range coder + autoregressive bottleneck codec.
+
+The reference never produces a bitstream (its arithmetic-coding hooks are
+vestigial, reference probclass_imgcomp.py:361-482); this package does.
+"""
+
+from dsin_tpu.coding.codec import (BottleneckCodec, decode_batch,
+                                   encode_batch)
+from dsin_tpu.coding.rans import (Decoder, cum_from_freqs, encode,
+                                  native_available, quantize_pmf)
+
+__all__ = ["BottleneckCodec", "encode_batch", "decode_batch", "Decoder",
+           "encode", "quantize_pmf", "cum_from_freqs", "native_available"]
