@@ -1,0 +1,82 @@
+"""Rate-distortion sweep: train/evaluate one model per target bitrate.
+
+The reference ships a single operating point (0.02 bpp — reference
+ae_run_configs:21, pretrained `KITTI_stereo_target_bpp0.02`) and the paper's
+RD curves were produced by re-running training with different `H_target`s.
+This runner automates that: for each target bpp it derives
+`H_target = bpp * 64 / num_chan_bn` (inverting the reference's back-formula
+`bpp = H_target / (64 / C)`, reference main.py:143), runs the full
+train+test pipeline, and collects the per-point test means into
+`rd_curve.json` — the artifact to plot against the paper's curves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from dsin_tpu.config import Config
+from dsin_tpu.utils import color_print
+
+DEFAULT_TARGETS = (0.01, 0.02, 0.04, 0.08)
+
+
+def h_target_for_bpp(bpp: float, num_chan_bn: int) -> float:
+    """Invert reference main.py:143: bpp = H_target / (64 / C)."""
+    return bpp * 64.0 / num_chan_bn
+
+
+def sweep(ae_config: Config, pc_config: Config, out_root: str = ".",
+          targets: Sequence[float] = DEFAULT_TARGETS,
+          max_steps: Optional[int] = None,
+          max_val_batches: Optional[int] = None,
+          max_test_images: Optional[int] = None) -> List[Dict[str, float]]:
+    """Run the pipeline once per target bpp; returns one result dict per
+    point and writes `<out_root>/rd_curve.json`."""
+    from dsin_tpu.main import run
+
+    out_path = os.path.join(out_root, "rd_curve.json")
+    os.makedirs(out_root or ".", exist_ok=True)
+    points = []
+    for bpp in targets:
+        h_t = h_target_for_bpp(bpp, ae_config.num_chan_bn)
+        color_print(f"RD point: target_bpp={bpp} (H_target={h_t})", "cyan",
+                    bold=True)
+        cfg = ae_config.replace(H_target=h_t)
+        results = run(cfg, pc_config, out_root=out_root,
+                      max_steps=max_steps, max_val_batches=max_val_batches,
+                      max_test_images=max_test_images)
+        points.append({"target_bpp": bpp, "H_target": h_t, **results})
+        # each point is a full training run — persist incrementally so a
+        # late-point crash doesn't discard finished points
+        with open(out_path, "w") as f:
+            json.dump(points, f, indent=2)
+
+    color_print(f"RD curve written to {out_path}", "green", bold=True)
+    return points
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    from dsin_tpu.config import parse_config_file
+
+    p = argparse.ArgumentParser(description="dsin_tpu RD sweep")
+    base = os.path.join(os.path.dirname(__file__), os.pardir, "configs")
+    p.add_argument("-ae_config", default=os.path.join(base, "ae_kitti_stereo"))
+    p.add_argument("-pc_config", default=os.path.join(base, "pc_default"))
+    p.add_argument("--out_root", default=".")
+    p.add_argument("--targets", type=float, nargs="+",
+                   default=list(DEFAULT_TARGETS))
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--max_test_images", type=int, default=None)
+    args = p.parse_args(argv)
+
+    sweep(parse_config_file(args.ae_config), parse_config_file(args.pc_config),
+          out_root=args.out_root, targets=args.targets,
+          max_steps=args.max_steps, max_test_images=args.max_test_images)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,8 @@ from dsin_tpu.ops.sifinder import gaussian_position_mask
 from dsin_tpu.train import checkpoint as ckpt_lib
 from dsin_tpu.train import optim as optim_lib
 from dsin_tpu.train import step as step_lib
-from dsin_tpu.utils import JsonlLogger, StepTimer, color_print
+from dsin_tpu.utils import (JsonlLogger, StepProfiler, StepTimer,
+                            color_print)
 
 
 def get_validate_every(iteration: int, total_iterations: int,
@@ -157,16 +158,22 @@ class Experiment:
 
     def train(self, max_steps: Optional[int] = None,
               max_val_batches: Optional[int] = None,
-              log_path: Optional[str] = None) -> Dict[str, float]:
+              log_path: Optional[str] = None,
+              profile_dir: Optional[str] = None) -> Dict[str, float]:
         """The fetch→step→validate loop (reference main.py:49-91). Returns
         summary stats. `max_steps`/`max_val_batches` bound the run (tests,
-        smoke runs); None = full config iterations."""
+        smoke runs); None = full config iterations. `profile_dir` captures
+        an XLA trace of a few warm steps there."""
         cfg = self.ae_config
         iterations = min(cfg.iterations, max_steps or cfg.iterations)
         train_it = Prefetcher(self._dataset("train", train=True).batches())
         logger = JsonlLogger(log_path or os.path.join(
             self.out_root, "logs", f"{self.model_name}.jsonl"))
         timer = StepTimer()
+        # clamp the trace window into short runs so --profile_dir always
+        # captures something (still skipping compile steps when possible)
+        profiler = StepProfiler(profile_dir,
+                                start_step=min(5, max(iterations - 3, 0)))
         best_val = float("inf")
         accum: Dict[str, float] = {}
         n_accum = 0
@@ -180,9 +187,11 @@ class Experiment:
 
         for i in rng_iter:
             x, y = next(train_it)
-            self.state, metrics = self.train_step(self.state,
-                                                  *self._put(x, y))
-            loss = float(metrics["loss"])  # blocks; keeps timer honest
+            profiler.step(i)
+            with profiler.annotation(i):
+                self.state, metrics = self.train_step(self.state,
+                                                      *self._put(x, y))
+                loss = float(metrics["loss"])  # blocks; keeps timer honest
             timer.tick()
             for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
                 accum[k] = accum.get(k, 0.0) + float(metrics[k])
@@ -219,6 +228,7 @@ class Experiment:
                         self.pc_config, iteration=i + 1,
                         total_iterations=iterations, best_val=best_val)
 
+        profiler.stop()
         logger.close()
         return {"steps": timer.total_steps, "best_val": best_val,
                 "last_val": val_losses[-1] if val_losses else float("inf"),
@@ -269,14 +279,16 @@ class Experiment:
 def run(ae_config: Config, pc_config: Config, out_root: str = ".",
         max_steps: Optional[int] = None,
         max_val_batches: Optional[int] = None,
-        max_test_images: Optional[int] = None) -> Dict[str, float]:
+        max_test_images: Optional[int] = None,
+        profile_dir: Optional[str] = None) -> Dict[str, float]:
     """Config-driven orchestration (reference main.py:21-126)."""
     exp = Experiment(ae_config, pc_config, out_root=out_root)
     exp.maybe_restore()
     results: Dict[str, float] = {}
     if ae_config.train_model:
         results.update(exp.train(max_steps=max_steps,
-                                 max_val_batches=max_val_batches))
+                                 max_val_batches=max_val_batches,
+                                 profile_dir=profile_dir))
     if ae_config.test_model:
         results.update(exp.test(max_images=max_test_images))
     return results
@@ -292,6 +304,8 @@ def parse_args(argv=None):
                    help="override ae config root_data")
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--max_test_images", type=int, default=None)
+    p.add_argument("--profile_dir", default=None,
+                   help="capture an XLA trace of a few warm train steps")
     return p.parse_args(argv)
 
 
@@ -303,7 +317,8 @@ def main(argv=None) -> None:
         ae_config = ae_config.replace(root_data=args.data_root)
     results = run(ae_config, pc_config, out_root=args.out_root,
                   max_steps=args.max_steps,
-                  max_test_images=args.max_test_images)
+                  max_test_images=args.max_test_images,
+                  profile_dir=args.profile_dir)
     color_print(f"done: {results}", "green", bold=True)
 
 

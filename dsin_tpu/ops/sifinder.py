@@ -50,11 +50,12 @@ class SearchResult(NamedTuple):
     col: jnp.ndarray         # (P,) match cols
 
 
-def gaussian_position_mask(img_h: int, img_w: int, patch_h: int,
-                           patch_w: int) -> np.ndarray:
-    """Gaussian position prior, one map per x-patch, centered on that patch
-    (reference AE.py:193-220). Returns (img_h - patch_h + 1,
-    img_w - patch_w + 1, P) float32, matching the VALID correlation map."""
+def _gaussian_mask_factors_f64(img_h: int, img_w: int, patch_h: int,
+                               patch_w: int):
+    """Separable 1-D factors of the 2-D Gaussian position prior
+    (reference AE.py:193-220), float64, cropped to the VALID
+    correlation-map extent (reference AE.py:216-218). Single source of
+    truth for both the combined mask and the streamed factor form."""
     grid_w = img_w // patch_w
     num_patches = (img_h // patch_h) * grid_w
     p = np.arange(num_patches)
@@ -62,15 +63,32 @@ def gaussian_position_mask(img_h: int, img_w: int, patch_h: int,
     center_w = (p % grid_w + 0.5) * patch_w               # (P,)
     sigma_h = 0.5 * img_h
     sigma_w = 0.5 * img_w
-    hh = np.arange(img_h, dtype=np.float64)[:, None, None]    # (H,1,1)
-    ww = np.arange(img_w, dtype=np.float64)[None, :, None]    # (1,W,1)
-    g = np.exp(-4 * np.log(2) * (
-        (hh - center_h[None, None, :]) ** 2 / sigma_h ** 2 +
-        (ww - center_w[None, None, :]) ** 2 / sigma_w ** 2))  # (H, W, P)
-    # crop to the VALID correlation-map extent (reference AE.py:216-218)
-    g = g[patch_h // 2 - 1: img_h - patch_h // 2,
-          patch_w // 2 - 1: img_w - patch_w // 2, :]
-    return g.astype(np.float32)
+    hh = np.arange(img_h, dtype=np.float64)[:, None]
+    ww = np.arange(img_w, dtype=np.float64)[:, None]
+    gh = np.exp(-4 * np.log(2) * (hh - center_h[None, :]) ** 2 / sigma_h ** 2)
+    gw = np.exp(-4 * np.log(2) * (ww - center_w[None, :]) ** 2 / sigma_w ** 2)
+    gh = gh[patch_h // 2 - 1: img_h - patch_h // 2, :]
+    gw = gw[patch_w // 2 - 1: img_w - patch_w // 2, :]
+    return gh, gw
+
+
+def gaussian_position_mask(img_h: int, img_w: int, patch_h: int,
+                           patch_w: int) -> np.ndarray:
+    """Gaussian position prior, one map per x-patch, centered on that patch
+    (reference AE.py:193-220). Returns (img_h - patch_h + 1,
+    img_w - patch_w + 1, P) float32, matching the VALID correlation map."""
+    gh, gw = _gaussian_mask_factors_f64(img_h, img_w, patch_h, patch_w)
+    return (gh[:, None, :] * gw[None, :, :]).astype(np.float32)
+
+
+def gaussian_position_mask_factors(img_h: int, img_w: int, patch_h: int,
+                                   patch_w: int):
+    """Separable factorization of `gaussian_position_mask`: returns
+    gh (Hc, P), gw (Wc, P) float32 with gh[h, p] * gw[w, p] == mask[h, w, p]
+    (the 2-D Gaussian is a product of 1-D Gaussians). Lets the fused
+    Pallas kernel stream the prior without building the (Hc, Wc, P) tensor."""
+    gh, gw = _gaussian_mask_factors_f64(img_h, img_w, patch_h, patch_w)
+    return gh.astype(np.float32), gw.astype(np.float32)
 
 
 def _window_sums(img: jnp.ndarray, win_h: int, win_w: int):
@@ -177,8 +195,40 @@ def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
 def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
                           y_dec: jnp.ndarray, mask: Optional[jnp.ndarray],
                           patch_h: int, patch_w: int, config) -> jnp.ndarray:
-    """Batched y_syn (N, H, W, 3) from batched inputs (vmap over N)."""
+    """Batched y_syn (N, H, W, 3) from batched inputs (vmap over N).
+
+    Implementation dispatch via `config.sifinder_impl` (default 'auto'):
+      * 'xla'    — conv + materialized score map (this module);
+      * 'pallas' — fused streaming kernel (ops/sifinder_pallas.py), Pearson
+        mode only. Assumes `mask` is either None or the standard
+        `gaussian_position_mask` for these shapes (the kernel rebuilds it in
+        separable form from the static shapes; a custom mask array would be
+        silently ignored — only this module's XLA path honors arbitrary
+        masks);
+      * 'pallas_interpret' — same kernel, Pallas interpreter (tests on CPU);
+      * 'auto'   — 'pallas' on TPU backends when Pearson, else 'xla'.
+    """
     use_l2 = bool(config.use_L2andLAB)
+    impl = getattr(config, "sifinder_impl", "auto")
+    if impl == "auto":
+        impl = ("pallas" if (not use_l2 and
+                             jax.default_backend() == "tpu") else "xla")
+    if impl in ("pallas", "pallas_interpret"):
+        assert not use_l2, "fused siFinder kernel is Pearson-only"
+        from dsin_tpu.ops import sifinder_pallas
+        h, w = x_dec.shape[1], x_dec.shape[2]
+        if mask is None:
+            hc, wc = h - patch_h + 1, w - patch_w + 1
+            p_count = (h // patch_h) * (w // patch_w)
+            gh = np.ones((hc, p_count), np.float32)
+            gw = np.ones((wc, p_count), np.float32)
+        else:
+            gh, gw = gaussian_position_mask_factors(h, w, patch_h, patch_w)
+        dtype = jnp.dtype(getattr(config, "sifinder_dtype", "bfloat16"))
+        return sifinder_pallas.fused_synthesize_side_image(
+            x_dec, y_img, y_dec, jnp.asarray(gh), jnp.asarray(gw),
+            patch_h, patch_w, compute_dtype=dtype,
+            interpret=(impl == "pallas_interpret"))
     fn = partial(search_single, mask=mask, patch_h=patch_h, patch_w=patch_w,
                  use_l2=use_l2)
     return jax.vmap(lambda a, b, c: fn(a, b, c).y_syn)(x_dec, y_img, y_dec)

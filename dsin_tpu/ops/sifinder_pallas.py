@@ -1,0 +1,244 @@
+"""Pallas TPU kernel: fused siFinder correlation + mask + argmax.
+
+The patch search is DSIN's hottest op (SURVEY §3.2; reference siFinder.py:91).
+The XLA path (`ops/sifinder.py`) expresses it as one big VALID conv, but the
+resulting score map is (Hc, Wc, P) — ~301*1201*640 floats ≈ 0.9 GB per image
+at the reference inference crop — which XLA materializes in HBM before the
+mask multiply and argmax reduce it to P integers. This kernel streams the map
+instead: the correlation matmul, the Gaussian position prior, the Pearson
+denominator, and a running per-patch arg-extremum are fused into one pass, so
+HBM never sees a score. That removes the ~2x score-map write+read traffic and
+the O(Hc*Wc*P) peak-memory term (which is what stops batched SI training from
+scaling in the XLA path).
+
+Layout / schedule:
+  * grid = (B, row_groups_of_8, col_tiles); row-major iteration keeps the
+    running argmax scratch valid (col tiles innermost, batch outermost).
+  * The transformed side image rides along whole (C, Hpad, Wpad) in VMEM
+    (~2.8 MB bf16 at 320x1224). Each step does ONE dynamic slice with
+    provably-aligned starts (rows 8q, lanes j*tile_w — Mosaic requires
+    sublane starts % 8 and lane starts % 128); everything below that is
+    static: an unrolled (row-in-group s, patch-col-offset dc) loop builds the
+    im2col tile M[(dc, ch, dr), c] = y[ch, 8q+s+dr, j*tw+c+dc] in VMEM
+    scratch, 60-row chunk by chunk.
+  * One MXU matmul per row: patches_mat (P, ph*pw*C) @ M (K, tile_w) -> f32.
+  * Pearson = num * inv_window_std(y); the Gaussian prior is separable
+    (mask[h, w, p] = gh[h, p] * gw[w, p] — see gaussian_position_mask_factors)
+    so the (Hc, Wc, P) mask tensor is never built either: the kernel reads
+    8-row blocks of gh / inv_std and per-tile blocks of gw.
+  * Running (best_value, flat_index) per patch lives in VMEM scratch;
+    strict ">" with ascending (row, col) visit order keeps the first (lowest
+    flat index) position on ties, matching jnp.argmax in the XLA path.
+    Rows >= Hc (group padding) and cols >= Wc (tile padding) are forced to
+    -inf before the update.
+
+Pearson mode only (the reference's default operating point,
+ae_run_configs: use_L2andLAB=False). The L2+LAB variant needs a global mean
+for its additive mask discount (sifinder.py) and falls back to XLA.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dsin_tpu.ops import color as color_lib
+from dsin_tpu.ops import sifinder as sifinder_lib
+from dsin_tpu.ops.patches import assemble_patches, extract_patches
+
+_NEG_INF = float("-inf")
+_GROUP = 8          # correlation rows per grid step (sublane alignment unit)
+_LANE = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _kernel(y_ref, pk_ref, dnm_ref, gh_ref, gw_ref,
+            val_out, idx_out, m_ref, bv_ref, bi_ref,
+            *, ph: int, pw: int, chans: int, tile_w: int, wc: int, hc: int):
+    q = pl.program_id(1)
+    j = pl.program_id(2)
+    last = (q == pl.num_programs(1) - 1) & (j == pl.num_programs(2) - 1)
+
+    @pl.when((q == 0) & (j == 0))
+    def _init():
+        bv_ref[:] = jnp.full_like(bv_ref, _NEG_INF)
+        bi_ref[:] = jnp.zeros_like(bi_ref)
+
+    cph = chans * ph
+    r0 = pl.multiple_of(q * _GROUP, _GROUP)
+    c0 = pl.multiple_of(j * tile_w, _LANE)
+    # the only dynamic slice: aligned starts, static size
+    yblk = y_ref[0, :, pl.ds(r0, _GROUP + ph - 1), pl.ds(c0, tile_w + _LANE)]
+
+    gwf = gw_ref[:].astype(jnp.float32)                      # (P, TW)
+    cols = c0 + jax.lax.broadcasted_iota(jnp.int32, gwf.shape, 1)
+    col_ok = cols < wc
+
+    for s in range(_GROUP):
+        for dc in range(pw):
+            v = yblk[:, s:s + ph, dc:dc + tile_w]            # (C, ph, TW)
+            m_ref[dc * cph:(dc + 1) * cph, :] = v.reshape(cph, tile_w)
+        num = jnp.dot(pk_ref[0], m_ref[:],
+                      preferred_element_type=jnp.float32)    # (P, TW)
+        score = (num
+                 * dnm_ref[0, s, :][None, :]
+                 * gh_ref[s, :][:, None]
+                 * gwf)
+        valid = col_ok & ((r0 + s) < hc)
+        score = jnp.where(valid, score, _NEG_INF)
+
+        row_best = jnp.max(score, axis=1)                    # (P,)
+        row_arg = jnp.argmax(score, axis=1).astype(jnp.int32)
+        flat = (r0 + s) * wc + c0 + row_arg
+        # lexicographic (value, -flat) update: the visit order is column-tile
+        # major, NOT flat row-major, so ties must resolve by flat index
+        # explicitly to match jnp.argmax's first-maximum rule
+        better = (row_best > bv_ref[0]) | (
+            (row_best == bv_ref[0]) & (flat < bi_ref[0]))
+        bv_ref[0] = jnp.where(better, row_best, bv_ref[0])
+        bi_ref[0] = jnp.where(better, flat, bi_ref[0])
+
+    @pl.when(last)
+    def _flush():
+        val_out[0, 0] = bv_ref[0]
+        idx_out[0, 0] = bi_ref[0]
+
+
+@partial(jax.jit, static_argnames=("ph", "pw", "tile_w", "interpret"))
+def fused_pearson_argmax(y_t: jnp.ndarray, patches_mat: jnp.ndarray,
+                         inv_denom: jnp.ndarray, gh: jnp.ndarray,
+                         gw_t: jnp.ndarray, *, ph: int, pw: int,
+                         tile_w: int = 512, interpret: bool = False):
+    """Streamed masked-Pearson arg-max over all positions.
+
+    y_t:         (B, C, H, W) transformed side image, compute dtype
+                 (padded internally).
+    patches_mat: (B, P, pw*C*ph) normalized patches in (dc, ch, dr) k-order.
+    inv_denom:   (B, Hc, Wc) f32 reciprocal window-std of y_t.
+    gh, gw_t:    (Hc, P) f32 and (P, Wc) f32 separable Gaussian prior.
+    Returns (best_val (B, P) f32, best_idx (B, P) int32) with
+    best_idx = row * Wc + col, matching jnp.argmax of the flattened map.
+    """
+    b, chans, h, w = y_t.shape
+    _, p_count, k = patches_mat.shape
+    _, hc, wc = inv_denom.shape
+    assert k == ph * pw * chans, (k, ph, pw, chans)
+    assert hc == h - ph + 1 and wc == w - pw + 1, (hc, wc, h, w, ph, pw)
+
+    tile_w = min(tile_w, _round_up(wc, _LANE))
+    n_tiles = -(-wc // tile_w)
+    n_groups = -(-hc // _GROUP)
+
+    hpad = (n_groups - 1) * _GROUP + _GROUP + ph - 1
+    wpad = n_tiles * tile_w + _LANE
+    y_t = jnp.pad(y_t, ((0, 0), (0, 0), (0, max(0, hpad - h)),
+                        (0, max(0, wpad - w))))
+
+    hg = n_groups * _GROUP
+    wt = n_tiles * tile_w
+    inv_denom = jnp.pad(inv_denom, ((0, 0), (0, hg - hc), (0, wt - wc)))
+    gh = jnp.pad(gh, ((0, hg - hc), (0, 0)))
+    gw_t = jnp.pad(gw_t, ((0, 0), (0, wt - wc)))
+
+    grid = (b, n_groups, n_tiles)
+    kernel = partial(_kernel, ph=ph, pw=pw, chans=chans, tile_w=tile_w,
+                     wc=wc, hc=hc)
+    out_val, out_idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, chans, hpad, wpad),
+                         lambda b_, q, j: (b_, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p_count, k), lambda b_, q, j: (b_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _GROUP, tile_w), lambda b_, q, j: (b_, q, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_GROUP, p_count), lambda b_, q, j: (q, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((p_count, tile_w), lambda b_, q, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, p_count), lambda b_, q, j: (b_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, p_count), lambda b_, q, j: (b_, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, p_count), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, p_count), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, tile_w), y_t.dtype),
+            pltpu.VMEM((1, p_count), jnp.float32),
+            pltpu.VMEM((1, p_count), jnp.int32),
+        ],
+        interpret=interpret,
+    )(y_t, patches_mat, inv_denom, gh, gw_t)
+    return out_val[:, 0], out_idx[:, 0]
+
+
+def _prepare_single(x_dec, y_dec, ph: int, pw: int, eps: float):
+    """Host-of-kernel prep for one pair: transforms, patch normalization in
+    the kernel's (dc, ch, dr) k-order, and the Pearson denominator map."""
+    x_patches = extract_patches(x_dec, ph, pw)                 # (P, ph, pw, C)
+    q = color_lib.search_transform(x_patches, False)
+    r_img = color_lib.search_transform(y_dec, False)           # (H, W, C)
+
+    mean_x = jnp.mean(q, axis=(1, 2, 3), keepdims=True)
+    xc = q - mean_x
+    norm_x = jnp.sqrt(jnp.sum(xc * xc, axis=(1, 2, 3), keepdims=True) + eps)
+    xn = xc / norm_x
+    p_count = xn.shape[0]
+    pk = jnp.transpose(xn, (0, 2, 3, 1)).reshape(p_count, -1)  # (P, pw*C*ph)
+
+    sum_y, sum_y2 = sifinder_lib._window_sums(r_img, ph, pw)
+    patch_size = ph * pw * r_img.shape[-1]
+    var_y = sum_y2 - (sum_y * sum_y) / patch_size
+    inv_denom = jax.lax.rsqrt(jnp.maximum(var_y, 0.0) + eps)   # (Hc, Wc)
+
+    y_t = jnp.transpose(r_img, (2, 0, 1))                      # (C, H, W)
+    return y_t, pk, inv_denom
+
+
+def fused_synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
+                                y_dec: jnp.ndarray, gh: jnp.ndarray,
+                                gw: jnp.ndarray, patch_h: int, patch_w: int,
+                                *, compute_dtype=jnp.bfloat16,
+                                tile_w: int = 512, interpret: bool = False,
+                                eps: float = 1e-12) -> jnp.ndarray:
+    """Batched y_syn via the fused kernel. All image tensors (N, H, W, 3);
+    gh (Hc, P) / gw (Wc, P) from `gaussian_position_mask_factors`.
+    Semantics match `ops.sifinder.synthesize_side_image` (Pearson mode)."""
+    n, h, w, _ = x_dec.shape
+    hc, wc = h - patch_h + 1, w - patch_w + 1
+    assert gh.shape[0] == hc and gw.shape[0] == wc, (gh.shape, gw.shape)
+
+    y_t, pk, inv_denom = jax.vmap(
+        lambda a, b: _prepare_single(a, b, patch_h, patch_w, eps)
+    )(x_dec, y_dec)
+
+    _, best = fused_pearson_argmax(
+        y_t.astype(compute_dtype), pk.astype(compute_dtype),
+        inv_denom, gh.astype(jnp.float32),
+        jnp.transpose(gw, (1, 0)).astype(jnp.float32),
+        ph=patch_h, pw=patch_w, tile_w=tile_w, interpret=interpret)
+
+    rows = best // wc
+    cols = best % wc
+
+    def gather_one(y_one, r_one, c_one):
+        pats = sifinder_lib.gather_patches(y_one, r_one, c_one,
+                                           patch_h, patch_w)
+        return assemble_patches(pats, h, w)
+
+    return jax.vmap(gather_one)(y_img, rows, cols)

@@ -1,0 +1,173 @@
+"""Spatial (width-axis) sharding for the SI patch search.
+
+DSIN's long-extent axis is image resolution, not sequence length (SURVEY §5):
+the analog of sequence/context parallelism here is sharding the *side-image
+width* over the mesh's 'spatial' axis, so each device correlates every
+x-patch against only its slice of y and the per-device score-map memory drops
+from O(Hc*Wc*P) to O(Hc*Wc*P / S). Every x-patch must still see all of y —
+the classic all-gather-or-ring situation — but only the *reductions* cross
+devices, never the score map:
+
+  1. halo exchange (`lax.ppermute` from the right neighbor) gives each shard
+     the patch_w-1 boundary columns its last correlation windows need — the
+     same halo pattern a sharded conv uses, sized for the search window;
+  2. each shard computes its local masked score map and reduces it to P
+     (value, flat-index) candidates + the P candidate patches gathered from
+     its haloed ORIGINAL y slice;
+  3. one `all_gather` over 'spatial' moves S*P scalars + S*P patches
+     (~a few MB) over ICI; an argmax over the shard axis picks winners.
+
+Ties resolve to the lowest global flat index (shards cover ascending column
+ranges and local argmax picks the first maximum), so results are bit-identical
+to the unsharded XLA path. Pearson mode only: the L2 variant's additive mask
+discount needs a score-map global mean (see ops/sifinder.py) — supportable
+via psum but not worth it for a non-default mode.
+
+The autoencoder/siNet convs need no hand-written halo logic: under
+jit-with-shardings GSPMD inserts halo exchanges for spatially-sharded convs
+on its own. This module exists because the search's argmax+gather does NOT
+shard well under GSPMD (it would all-gather the score map); the reduction
+structure here is hand-picked instead.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dsin_tpu.ops import color as color_lib
+from dsin_tpu.ops import sifinder
+from dsin_tpu.ops.patches import assemble_patches, extract_patches
+from dsin_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+
+def _halo_from_right(z: jnp.ndarray, halo: int, axis_name: str):
+    """Append the first `halo` width-columns of the right neighbor's shard.
+    z: (H, Wl, C) -> (H, Wl + halo, C); the last shard gets zeros (those
+    columns correspond to out-of-range global positions)."""
+    n = jax.lax.psum(1, axis_name)
+    left_edge = z[:, :halo, :]
+    # shift shard s+1 -> s
+    perm = [(src, dst) for dst, src in
+            [(i, (i + 1) % n) for i in range(n)]]
+    recv = jax.lax.ppermute(left_edge, axis_name, perm)
+    idx = jax.lax.axis_index(axis_name)
+    recv = jnp.where(idx == n - 1, jnp.zeros_like(recv), recv)
+    return jnp.concatenate([z, recv], axis=1)
+
+
+def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
+                  eps=1e-12):
+    """Per-shard search for ONE pair. x_dec (H, W, 3) replicated;
+    y_img/y_dec (H, Wl, 3) width shards. Returns y_syn (H, W, 3)."""
+    axis = SPATIAL_AXIS
+    h, w_local = y_dec.shape[0], y_dec.shape[1]
+    wc = img_w - patch_w + 1
+    halo = patch_w - 1
+    shard = jax.lax.axis_index(axis)
+    col0 = shard * w_local
+
+    y_dec_h = _halo_from_right(y_dec, halo, axis)
+    y_img_h = _halo_from_right(y_img, halo, axis)
+
+    x_patches = extract_patches(x_dec, patch_h, patch_w)
+    q = color_lib.search_transform(x_patches, False)
+    r_img = color_lib.search_transform(y_dec_h, False)
+
+    scores = sifinder.match_scores(q, r_img, use_l2=False, eps=eps)
+    # scores: (Hc, Wl, P) — local slice of the global map's columns
+    hc, wl, p_count = scores.shape
+
+    # global Gaussian prior, sliced to this shard's columns
+    gh_t = gh[:, None, :]                                   # (Hc, 1, P)
+    gw_l = jax.lax.dynamic_slice(gw, (col0, 0), (wl, p_count))
+    scores = scores * gh_t * gw_l[None, :, :]
+
+    # mask out-of-range global columns (right edge of the last shard)
+    cols = col0 + jnp.arange(wl)
+    scores = jnp.where((cols < wc)[None, :, None], scores, -jnp.inf)
+
+    flat = scores.reshape(hc * wl, p_count)
+    best_local = jnp.argmax(flat, axis=0).astype(jnp.int32)   # (P,)
+    best_val = jnp.max(flat, axis=0)                          # (P,)
+    rows = best_local // wl
+    cols_l = best_local % wl
+    flat_global = rows * wc + col0 + cols_l                   # (P,)
+
+    cand = sifinder.gather_patches(y_img_h, rows, cols_l,
+                                   patch_h, patch_w)          # (P, ph, pw, 3)
+
+    # cross-shard reduction: S*(2P scalars + P patches) over ICI
+    vals_g = jax.lax.all_gather(best_val, axis)               # (S, P)
+    flat_g = jax.lax.all_gather(flat_global, axis)            # (S, P)
+    cand_g = jax.lax.all_gather(cand, axis)                   # (S, P, ...)
+    # winner = lowest global flat index among max-valued shards — exactly
+    # jnp.argmax's first-maximum rule on the unsharded row-major map
+    is_max = vals_g == jnp.max(vals_g, axis=0, keepdims=True)
+    winner = jnp.argmin(jnp.where(is_max, flat_g, jnp.iinfo(jnp.int32).max),
+                        axis=0)                               # (P,)
+    y_patches = jnp.take_along_axis(
+        cand_g, winner[None, :, None, None, None], axis=0)[0]
+    return assemble_patches(y_patches, x_dec.shape[0], img_w)
+
+
+def make_spatial_synthesize(mesh, patch_h: int, patch_w: int,
+                            img_h: int, img_w: int,
+                            use_mask: bool = True):
+    """Jitted (x_dec, y_img, y_dec) -> y_syn with batch sharded over 'data'
+    and y width sharded over 'spatial'. All arguments (N, H, W, 3); output
+    replicated over 'spatial', sharded over 'data'.
+
+    Bit-parity with `ops.sifinder.synthesize_side_image` (Pearson mode with
+    the standard Gaussian prior, or no mask)."""
+    hc, wc = img_h - patch_h + 1, img_w - patch_w + 1
+    p_count = (img_h // patch_h) * (img_w // patch_w)
+    if use_mask:
+        gh_np, gw_np = sifinder.gaussian_position_mask_factors(
+            img_h, img_w, patch_h, patch_w)
+    else:
+        gh_np = np.ones((hc, p_count), np.float32)
+        gw_np = np.ones((wc, p_count), np.float32)
+    # pad gw rows to the sharded width so dynamic_slice at the last shard's
+    # offset stays in range (padded rows are masked by the cols<wc test)
+    gw_np = np.pad(gw_np, ((0, img_w - wc), (0, 0)))
+    gh = jnp.asarray(gh_np)
+    gw = jnp.asarray(gw_np)
+
+    spatial = mesh.shape[SPATIAL_AXIS]
+    assert img_w % spatial == 0 and img_w % patch_w == 0, (
+        f"width {img_w} must divide evenly into {spatial} shards and "
+        f"{patch_w}-wide patches")
+    assert img_w // spatial >= patch_w - 1, (
+        f"shard width {img_w // spatial} narrower than the search halo "
+        f"{patch_w - 1}: windows could straddle >2 shards (halo exchange "
+        f"only reaches the immediate right neighbor)")
+
+    def per_shard(x_dec, y_img, y_dec, gh_, gw_):
+        fn = partial(_local_search, gh=gh_, gw=gw_, patch_h=patch_h,
+                     patch_w=patch_w, img_w=img_w)
+        return jax.vmap(fn)(x_dec, y_img, y_dec)
+
+    shmap = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None, None, None),
+                  P(DATA_AXIS, None, SPATIAL_AXIS, None),
+                  P(DATA_AXIS, None, SPATIAL_AXIS, None),
+                  P(), P()),
+        out_specs=P(DATA_AXIS, None, None, None),
+        check_vma=False)
+
+    x_sh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
+    y_sh = NamedSharding(mesh, P(DATA_AXIS, None, SPATIAL_AXIS, None))
+
+    @partial(jax.jit, in_shardings=(x_sh, y_sh, y_sh),
+             out_shardings=x_sh)
+    def run(x_dec, y_img, y_dec):
+        return shmap(x_dec, y_img, y_dec, gh, gw)
+
+    return run

@@ -1,6 +1,9 @@
-"""Observability: step timing, scalar logging, device memory stats."""
+"""Observability: step timing, scalar logging, device memory stats,
+XLA trace capture."""
 
 from dsin_tpu.utils.logging import (JsonlLogger, StepTimer, color_print,
                                     device_memory_stats)
+from dsin_tpu.utils.profiling import StepProfiler
 
-__all__ = ["JsonlLogger", "StepTimer", "color_print", "device_memory_stats"]
+__all__ = ["JsonlLogger", "StepTimer", "color_print", "device_memory_stats",
+           "StepProfiler"]

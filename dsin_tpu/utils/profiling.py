@@ -1,0 +1,52 @@
+"""XLA profiler integration (a subsystem the reference lacks entirely —
+its only aid is `report_tensor_allocations_upon_oom`, reference AE.py:7).
+
+Captures a windowed device trace of the training loop viewable in
+TensorBoard / Perfetto: `StepProfiler` starts `jax.profiler` at a chosen
+step and stops it N steps later; `StepTraceAnnotation` marks step boundaries
+so per-step timelines line up in the viewer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+
+class StepProfiler:
+    """Trace steps [start_step, start_step + num_steps) into `trace_dir`.
+
+    Call `step(i)` once per loop iteration (before running the step).
+    No-ops entirely when trace_dir is None.
+    """
+
+    def __init__(self, trace_dir: Optional[str], start_step: int = 5,
+                 num_steps: int = 3):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def step(self, i: int) -> None:
+        if self.trace_dir is None:
+            return
+        if not self._active and i == self.start_step:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        elif self._active and i >= self.stop_step:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def annotation(self, i: int):
+        """Step-scoped trace annotation (no-op context when disabled)."""
+        if self.trace_dir is None:
+            return contextlib.nullcontext()
+        return jax.profiler.StepTraceAnnotation("train_step", step_num=i)

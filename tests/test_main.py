@@ -159,3 +159,27 @@ def test_restore_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(exp2.state.params["centers"]),
         np.asarray(exp.state.params["centers"]))
+
+
+def test_h_target_for_bpp_inverts_reference_formula():
+    from dsin_tpu.eval.rd_sweep import h_target_for_bpp
+    # reference main.py:143: bpp = H_target / (64 / C); C=32, H=0.04 -> 0.02
+    assert h_target_for_bpp(0.02, 32) == pytest.approx(0.04)
+    assert h_target_for_bpp(0.08, 8) == pytest.approx(0.64)
+
+
+@pytest.mark.slow
+def test_rd_sweep_smoke(tmp_path):
+    from dsin_tpu.eval.rd_sweep import sweep
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+
+    points = sweep(ae, pc, out_root=out, targets=(0.02, 0.08),
+                   max_steps=1, max_val_batches=1, max_test_images=1)
+
+    assert [p["target_bpp"] for p in points] == [0.02, 0.08]
+    assert all("psnr" in p and "bpp" in p for p in points)
+    with open(os.path.join(out, "rd_curve.json")) as f:
+        assert len(json.load(f)) == 2

@@ -1,0 +1,165 @@
+"""Fused Pallas siFinder kernel vs. the XLA reference path.
+
+Runs the kernel through the Pallas interpreter on the CPU test platform
+(float32 compute so score parity with the XLA path is tight). Shapes are
+small but exercise every structural feature: batch > 1, multiple column
+tiles (tile_w clamp), non-128-multiple map widths, mask / no-mask.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.ops import sifinder
+from dsin_tpu.ops import sifinder_pallas
+from dsin_tpu.ops.patches import extract_patches
+
+H, W = 24, 36
+PH, PW = 8, 12
+P = (H // PH) * (W // PW)          # 9 patches
+HC, WC = H - PH + 1, W - PW + 1    # 17 x 25 correlation map
+
+
+def _rand_pair(seed, batch=2):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 255, (batch, H, W, 3)).astype(np.float32)
+    # y: smoothed correlate of x so matches are non-trivial but not ties
+    y = np.clip(x[:, ::-1] * 0.6 + rng.uniform(0, 255, x.shape) * 0.4,
+                0, 255).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_mask_factors_product_matches_combined():
+    combined = sifinder.gaussian_position_mask(H, W, PH, PW)
+    gh, gw = sifinder.gaussian_position_mask_factors(H, W, PH, PW)
+    assert gh.shape == (HC, P) and gw.shape == (WC, P)
+    prod = gh[:, None, :] * gw[None, :, :]
+    np.testing.assert_allclose(prod, combined, rtol=1e-5, atol=1e-7)
+
+
+def test_fused_scores_match_xla_scores():
+    x, y = _rand_pair(0, batch=1)
+    gh, gw = sifinder.gaussian_position_mask_factors(H, W, PH, PW)
+
+    y_t, pk, inv_denom = sifinder_pallas._prepare_single(
+        x[0], y[0], PH, PW, 1e-12)
+    best_val, best_idx = sifinder_pallas.fused_pearson_argmax(
+        y_t[None].astype(jnp.float32), pk[None].astype(jnp.float32),
+        inv_denom[None], jnp.asarray(gh),
+        jnp.asarray(gw.T), ph=PH, pw=PW, interpret=True)
+
+    # XLA reference: full score map, multiplicative mask, flat argmax
+    mask = jnp.asarray(sifinder.gaussian_position_mask(H, W, PH, PW))
+    res = sifinder.search_single(x[0], y[0], y[0], mask, PH, PW, use_l2=False)
+    flat = res.score_map.reshape(HC * WC, P)
+    ref_best = jnp.max(flat, axis=0)
+
+    np.testing.assert_allclose(np.asarray(best_val[0]), np.asarray(ref_best),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(best_idx[0]),
+                                  np.asarray(res.best_flat))
+
+
+@pytest.mark.parametrize("use_mask", [True, False])
+def test_fused_y_syn_matches_xla(use_mask):
+    x, y = _rand_pair(1, batch=2)
+    cfg_mask = (jnp.asarray(sifinder.gaussian_position_mask(H, W, PH, PW))
+                if use_mask else None)
+
+    ref = sifinder.synthesize_side_image(
+        x, y, y, cfg_mask, PH, PW,
+        config=_cfg(impl="xla"))
+    fused = sifinder.synthesize_side_image(
+        x, y, y, cfg_mask, PH, PW,
+        config=_cfg(impl="pallas_interpret", dtype="float32"))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_fused_finds_planted_patch():
+    """y contains an exact copy of an x patch at a known offset; the fused
+    search must place that patch's match exactly there (no-mask mode)."""
+    rng = np.random.default_rng(7)
+    x = rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32)
+    y = rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32)
+    patch_idx, r0, c0 = 4, 5, 9
+    pr, pc = (patch_idx // (W // PW)) * PH, (patch_idx % (W // PW)) * PW
+    y[0, r0:r0 + PH, c0:c0 + PW] = x[0, pr:pr + PH, pc:pc + PW]
+
+    out = sifinder.synthesize_side_image(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(y), None, PH, PW,
+        config=_cfg(impl="pallas_interpret", dtype="float32"))
+    np.testing.assert_allclose(
+        np.asarray(out[0, pr:pr + PH, pc:pc + PW]),
+        x[0, pr:pr + PH, pc:pc + PW], atol=1e-3)
+
+
+def test_fused_multiple_column_tiles():
+    """A map wider than one 128-lane tile forces the multi-tile path and the
+    cross-tile running argmax; result must not depend on the tiling."""
+    h2, w2 = 16, 288                     # WC2 = 277 -> 3 tiles at tile_w=128
+    hc2, wc2 = h2 - PH + 1, w2 - PW + 1
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.uniform(0, 255, (h2, w2, 3)).astype(np.float32))
+    y = jnp.asarray(rng.uniform(0, 255, (h2, w2, 3)).astype(np.float32))
+    gh, gw = sifinder.gaussian_position_mask_factors(h2, w2, PH, PW)
+    y_t, pk, inv_denom = sifinder_pallas._prepare_single(x, y, PH, PW, 1e-12)
+
+    outs = []
+    for tile_w in (128, 640):
+        outs.append(sifinder_pallas.fused_pearson_argmax(
+            y_t[None].astype(jnp.float32), pk[None].astype(jnp.float32),
+            inv_denom[None], jnp.asarray(gh), jnp.asarray(gw.T),
+            ph=PH, pw=PW, tile_w=tile_w, interpret=True))
+    assert outs[0][0].shape == (1, (h2 // PH) * (w2 // PW))
+    np.testing.assert_array_equal(np.asarray(outs[0][1]),
+                                  np.asarray(outs[1][1]))
+    np.testing.assert_allclose(np.asarray(outs[0][0]),
+                               np.asarray(outs[1][0]), rtol=1e-6)
+
+
+def test_cross_tile_tie_resolves_to_lowest_flat_index():
+    """Two exact copies of the same x-patch planted so the better-by-flat-
+    order one (row 0) lands in column-tile 1 and the other (row 1) in tile 0:
+    the running argmax must still pick the lowest flat index, like
+    jnp.argmax on the unsharded map (regression: visit order is tile-major,
+    a strict '>' update kept the tile-0 candidate)."""
+    h2, w2 = 16, 288
+    wc2 = w2 - PW + 1
+    rng = np.random.default_rng(11)
+    x = rng.uniform(0, 255, (1, h2, w2, 3)).astype(np.float32)
+    y = rng.uniform(0, 255, (1, h2, w2, 3)).astype(np.float32)
+    patch_idx = 2
+    pr = (patch_idx // (w2 // PW)) * PH
+    pc = (patch_idx % (w2 // PW)) * PW
+    patch = x[0, pr:pr + PH, pc:pc + PW]
+    flat_a, flat_b = 200, wc2         # (row 0, col 200) and (row 1, col 0)
+    for flat in (flat_a, flat_b):
+        r0, c0 = flat // wc2, flat % wc2
+        y[0, r0:r0 + PH, c0:c0 + PW] = patch
+
+    x_j, y_j = jnp.asarray(x), jnp.asarray(y)
+    ref = sifinder.search_single(x_j[0], y_j[0], y_j[0], None, PH, PW,
+                                 use_l2=False)
+    assert int(ref.best_flat[patch_idx]) == flat_a
+
+    y_t, pk, inv_denom = sifinder_pallas._prepare_single(
+        x_j[0], y_j[0], PH, PW, 1e-12)
+    hc2 = h2 - PH + 1
+    p2 = (h2 // PH) * (w2 // PW)
+    ones_h = jnp.ones((hc2, p2), jnp.float32)
+    ones_w = jnp.ones((p2, wc2), jnp.float32)
+    _, best_idx = sifinder_pallas.fused_pearson_argmax(
+        y_t[None].astype(jnp.float32), pk[None].astype(jnp.float32),
+        inv_denom[None], ones_h, ones_w,
+        ph=PH, pw=PW, tile_w=128, interpret=True)  # col 200 -> tile 1
+    assert int(best_idx[0, patch_idx]) == flat_a
+
+
+class _cfg:
+    """Minimal config stand-in for synthesize_side_image dispatch."""
+
+    def __init__(self, impl="auto", dtype="bfloat16"):
+        self.use_L2andLAB = False
+        self.sifinder_impl = impl
+        self.sifinder_dtype = dtype
