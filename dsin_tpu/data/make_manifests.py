@@ -1,0 +1,133 @@
+"""Generate x/y pair manifests from a KITTI multiview directory tree.
+
+The reference ships frozen manifest files (reference data_paths/
+KITTI_stereo_{train,val,test}.txt — alternating lines, image_2 = encoder
+input x, image_3 = decoder side information y, both relative to the KITTI
+root). Those lists can't be redistributed meaningfully without the dataset,
+so this tool regenerates them from a local KITTI download:
+
+  * stereo mode: pair image_2/SEQ_FRAME.png with image_3/SEQ_FRAME.png —
+    the same instant seen by the left/right camera (the reference's
+    KITTI_stereo lists);
+  * general mode: pair frames of the same sequence at a small temporal
+    offset, cameras chosen at random — correlated but not co-instant, the
+    reference's KITTI_general lists (whose exact pairing is unpublished;
+    this is a seeded approximation with the same structure).
+
+Expected tree (any subset of the standard zips):
+    <kitti_root>/data_scene_flow_multiview/{training,testing}/image_{2,3}/
+    <kitti_root>/data_stereo_flow_multiview/{training,testing}/image_{2,3}/
+
+Usage:
+    python -m dsin_tpu.data.make_manifests --kitti_root /data/kitti \
+        --out_dir data_paths [--mode stereo] [--val_frac .2 --test_frac .2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+SUBSETS = ("data_scene_flow_multiview", "data_stereo_flow_multiview")
+SPLITS = ("training", "testing")
+_FRAME_RE = re.compile(r"^(\d+)_(\d+)\.png$")
+
+
+def _scan(kitti_root: str) -> Dict[Tuple[str, str, str], Dict[int, str]]:
+    """{(subset, split, seq): {frame: relpath-of-image_2}} for frames that
+    exist in BOTH cameras."""
+    out: Dict[Tuple[str, str, str], Dict[int, str]] = {}
+    for subset in SUBSETS:
+        for split in SPLITS:
+            d2 = os.path.join(kitti_root, subset, split, "image_2")
+            d3 = os.path.join(kitti_root, subset, split, "image_3")
+            if not (os.path.isdir(d2) and os.path.isdir(d3)):
+                continue
+            right = set(os.listdir(d3))
+            for name in sorted(os.listdir(d2)):
+                m = _FRAME_RE.match(name)
+                if not m or name not in right:
+                    continue
+                seq, frame = m.group(1), int(m.group(2))
+                rel = os.path.join(subset, split, "image_2", name)
+                out.setdefault((subset, split, seq), {})[frame] = rel
+    return out
+
+
+def stereo_pairs(kitti_root: str) -> List[Tuple[str, str]]:
+    """(x=image_2, y=image_3) same-frame stereo pairs, sorted."""
+    pairs = []
+    for (_, _, _), frames in sorted(_scan(kitti_root).items()):
+        for _, rel2 in sorted(frames.items()):
+            pairs.append((rel2, rel2.replace("image_2", "image_3")))
+    return pairs
+
+
+def general_pairs(kitti_root: str, max_offset: int = 2,
+                  seed: int = 0) -> List[Tuple[str, str]]:
+    """Same-sequence pairs at temporal offset 1..max_offset, random camera
+    per side (seeded) — the KITTI_general structure."""
+    rng = np.random.default_rng(seed)
+    pairs = []
+    for (_, _, _), frames in sorted(_scan(kitti_root).items()):
+        idx = sorted(frames)
+        for frame in idx:
+            offset = int(rng.integers(1, max_offset + 1))
+            if frame + offset not in frames:
+                continue
+            a, b = frames[frame], frames[frame + offset]
+            cam_a, cam_b = rng.choice(["image_2", "image_3"], size=2)
+            pairs.append((a.replace("image_2", cam_a),
+                          b.replace("image_2", cam_b)))
+    return pairs
+
+
+def split_pairs(pairs: List[Tuple[str, str]], val_frac: float,
+                test_frac: float, seed: int = 0):
+    """Deterministic shuffled split into train/val/test."""
+    order = np.random.default_rng(seed).permutation(len(pairs))
+    n_val = int(len(pairs) * val_frac)
+    n_test = int(len(pairs) * test_frac)
+    val = [pairs[i] for i in order[:n_val]]
+    test = [pairs[i] for i in order[n_val:n_val + n_test]]
+    train = [pairs[i] for i in order[n_val + n_test:]]
+    return {"train": train, "val": val, "test": test}
+
+
+def write_manifest(path: str, pairs: List[Tuple[str, str]]) -> None:
+    """Alternating x/y lines (reference DataProvider.py:119-126)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        for x, y in pairs:
+            f.write(x + "\n" + y + "\n")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="KITTI pair-manifest generator")
+    p.add_argument("--kitti_root", required=True)
+    p.add_argument("--out_dir", default="data_paths")
+    p.add_argument("--mode", choices=("stereo", "general"), default="stereo")
+    p.add_argument("--val_frac", type=float, default=0.2)
+    p.add_argument("--test_frac", type=float, default=0.2)
+    p.add_argument("--max_offset", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    pairs = (stereo_pairs(args.kitti_root) if args.mode == "stereo"
+             else general_pairs(args.kitti_root, args.max_offset, args.seed))
+    if not pairs:
+        raise SystemExit(f"no image_2/image_3 pairs under {args.kitti_root}")
+    splits = split_pairs(pairs, args.val_frac, args.test_frac, args.seed)
+    for split, split_list in splits.items():
+        out = os.path.join(args.out_dir,
+                           f"KITTI_{args.mode}_{split}.txt")
+        write_manifest(out, split_list)
+        print(f"{out}: {len(split_list)} pairs")
+
+
+if __name__ == "__main__":
+    main()

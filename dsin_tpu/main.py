@@ -165,15 +165,24 @@ class Experiment:
         smoke runs); None = full config iterations. `profile_dir` captures
         an XLA trace of a few warm steps there."""
         cfg = self.ae_config
-        iterations = min(cfg.iterations, max_steps or cfg.iterations)
+        # resume iteration numbering from a restored optimizer step — the
+        # reference restarts numbering on resume (SURVEY §5); here a resumed
+        # run continues the schedule and skips already-done work.
+        # `max_steps` counts steps to RUN from here (not a global cap), so
+        # smoke-running a restored checkpoint still does work.
+        start = min(int(self.state.step), cfg.iterations)
+        iterations = (min(cfg.iterations, start + max_steps)
+                      if max_steps else cfg.iterations)
         train_it = Prefetcher(self._dataset("train", train=True).batches())
         logger = JsonlLogger(log_path or os.path.join(
             self.out_root, "logs", f"{self.model_name}.jsonl"))
         timer = StepTimer()
-        # clamp the trace window into short runs so --profile_dir always
-        # captures something (still skipping compile steps when possible)
-        profiler = StepProfiler(profile_dir,
-                                start_step=min(5, max(iterations - 3, 0)))
+        # clamp the trace window into short/resumed runs so --profile_dir
+        # always captures something (still skipping compile steps if it can)
+        remaining = iterations - start
+        profiler = StepProfiler(
+            profile_dir, start_step=start + min(5, max(remaining - 3, 0)))
+        checkpoint_every = cfg.get("checkpoint_every", None)
         best_val = float("inf")
         accum: Dict[str, float] = {}
         n_accum = 0
@@ -181,55 +190,85 @@ class Experiment:
 
         try:
             from tqdm import trange
-            rng_iter = trange(iterations, desc="train", dynamic_ncols=True)
+            rng_iter = trange(start, iterations, desc="train",
+                              dynamic_ncols=True)
         except ImportError:
-            rng_iter = range(iterations)
+            rng_iter = range(start, iterations)
 
-        for i in rng_iter:
-            x, y = next(train_it)
-            profiler.step(i)
-            with profiler.annotation(i):
-                self.state, metrics = self.train_step(self.state,
-                                                      *self._put(x, y))
-                loss = float(metrics["loss"])  # blocks; keeps timer honest
-            timer.tick()
-            for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
-                accum[k] = accum.get(k, 0.0) + float(metrics[k])
-            n_accum += 1
+        try:
+            for i in rng_iter:
+                x, y = next(train_it)
+                profiler.step(i)
+                with profiler.annotation(i):
+                    self.state, metrics = self.train_step(self.state,
+                                                          *self._put(x, y))
+                    loss = float(metrics["loss"])  # blocks; honest timer
+                timer.tick()
+                for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
+                    accum[k] = accum.get(k, 0.0) + float(metrics[k])
+                n_accum += 1
 
-            if (i + 1) % cfg.show_every == 0 or i + 1 == iterations:
-                means = {k: v / n_accum for k, v in accum.items()}
-                accum, n_accum = {}, 0
-                ips = timer.images_per_sec(cfg.batch_size)
-                color_print(
-                    f"[{i + 1}/{iterations}] loss={means['loss']:.4f} "
-                    f"bpp={means['bpp']:.4f} d={means['d_loss']:.4f} "
-                    f"{ips:.2f} img/s", "cyan")
-                logger.log(i + 1, means, images_per_sec=ips)
+                if (i + 1) % cfg.show_every == 0 or i + 1 == iterations:
+                    means = {k: v / n_accum for k, v in accum.items()}
+                    accum, n_accum = {}, 0
+                    ips = timer.images_per_sec(cfg.batch_size)
+                    color_print(
+                        f"[{i + 1}/{iterations}] loss={means['loss']:.4f} "
+                        f"bpp={means['bpp']:.4f} d={means['d_loss']:.4f} "
+                        f"{ips:.2f} img/s", "cyan")
+                    logger.log(i + 1, means, images_per_sec=ips)
 
-            ve = get_validate_every(i, iterations, cfg.validate_every,
-                                    cfg.get("decrease_val_steps", True))
-            if (i + 1) % ve == 0 or i + 1 == iterations:
-                val_loss = self.validate(
-                    self._dataset("val", train=False).batches(loop=False),
-                    max_batches=max_val_batches)
-                val_losses.append(val_loss)
-                improved = val_loss < best_val
-                color_print(f"[{i + 1}] val_loss={val_loss:.4f} "
-                            f"(best {min(best_val, val_loss):.4f})",
-                            "green" if improved else "yellow")
-                logger.log(i + 1, {"val_loss": val_loss})
-                if improved and cfg.get("save_model", True):
-                    best_val = val_loss
-                    ckpt_lib.save_checkpoint(self.ckpt_dir, self.state,
-                                             best_val=best_val)
-                    ckpt_lib.write_sidecars(
-                        self.weights_root, self.model_name, cfg,
-                        self.pc_config, iteration=i + 1,
-                        total_iterations=iterations, best_val=best_val)
+                # periodic (non-best) checkpoint: bounds work lost to a
+                # crash — the reference loses everything since the last
+                # val improvement (SURVEY §5)
+                if checkpoint_every and (i + 1) % checkpoint_every == 0:
+                    ckpt_lib.save_checkpoint(
+                        os.path.join(self.ckpt_dir, "periodic"), self.state,
+                        extra_meta={"kind": "periodic"})
 
-        profiler.stop()
-        logger.close()
+                ve = get_validate_every(i, iterations, cfg.validate_every,
+                                        cfg.get("decrease_val_steps", True))
+                if (i + 1) % ve == 0 or i + 1 == iterations:
+                    val_loss = self.validate(
+                        self._dataset("val", train=False).batches(loop=False),
+                        max_batches=max_val_batches)
+                    val_losses.append(val_loss)
+                    improved = val_loss < best_val
+                    color_print(f"[{i + 1}] val_loss={val_loss:.4f} "
+                                f"(best {min(best_val, val_loss):.4f})",
+                                "green" if improved else "yellow")
+                    logger.log(i + 1, {"val_loss": val_loss})
+                    if improved and cfg.get("save_model", True):
+                        best_val = val_loss
+                        ckpt_lib.save_checkpoint(self.ckpt_dir, self.state,
+                                                 best_val=best_val)
+                        ckpt_lib.write_sidecars(
+                            self.weights_root, self.model_name, cfg,
+                            self.pc_config, iteration=i + 1,
+                            total_iterations=iterations, best_val=best_val)
+        except Exception as e:
+            # emergency save: preserve the in-flight state before dying.
+            # Guarded: device-side crashes can leave self.state donated or
+            # error-poisoned, in which case the save itself raises — never
+            # let that mask the original error.
+            if cfg.get("save_model", True) and timer.total_steps > 0:
+                emergency = os.path.join(self.ckpt_dir, "emergency")
+                try:
+                    ckpt_lib.save_checkpoint(
+                        emergency, self.state,
+                        extra_meta={"kind": "emergency", "error": repr(e)})
+                    color_print(f"crash at step {int(self.state.step)}; "
+                                f"state saved to {emergency}", "red",
+                                bold=True)
+                except Exception as save_err:  # noqa: BLE001
+                    color_print(f"crash AND emergency save failed "
+                                f"({save_err!r}); state lost", "red",
+                                bold=True)
+            raise
+        finally:
+            profiler.stop()
+            logger.close()
+
         return {"steps": timer.total_steps, "best_val": best_val,
                 "last_val": val_losses[-1] if val_losses else float("inf"),
                 "images_per_sec": timer.images_per_sec(cfg.batch_size)}

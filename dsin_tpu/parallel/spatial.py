@@ -116,15 +116,12 @@ def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
     return assemble_patches(y_patches, x_dec.shape[0], img_w)
 
 
-def make_spatial_synthesize(mesh, patch_h: int, patch_w: int,
-                            img_h: int, img_w: int,
-                            use_mask: bool = True):
-    """Jitted (x_dec, y_img, y_dec) -> y_syn with batch sharded over 'data'
-    and y width sharded over 'spatial'. All arguments (N, H, W, 3); output
-    replicated over 'spatial', sharded over 'data'.
-
-    Bit-parity with `ops.sifinder.synthesize_side_image` (Pearson mode with
-    the standard Gaussian prior, or no mask)."""
+def build_synthesize_shmap(mesh, patch_h: int, patch_w: int,
+                           img_h: int, img_w: int, use_mask: bool = True):
+    """Un-jitted shard_map'd (x_dec, y_img, y_dec) -> y_syn for composing
+    into larger jitted programs (e.g. the spatial inference step). Inputs
+    are interpreted as: batch over 'data', y width over 'spatial', x_dec
+    replicated over 'spatial'; output replicated over 'spatial'."""
     hc, wc = img_h - patch_h + 1, img_w - patch_w + 1
     p_count = (img_h // patch_h) * (img_w // patch_w)
     if use_mask:
@@ -162,12 +159,62 @@ def make_spatial_synthesize(mesh, patch_h: int, patch_w: int,
         out_specs=P(DATA_AXIS, None, None, None),
         check_vma=False)
 
+    return lambda x_dec, y_img, y_dec: shmap(x_dec, y_img, y_dec, gh, gw)
+
+
+def make_spatial_synthesize(mesh, patch_h: int, patch_w: int,
+                            img_h: int, img_w: int,
+                            use_mask: bool = True):
+    """Jitted (x_dec, y_img, y_dec) -> y_syn with batch sharded over 'data'
+    and y width sharded over 'spatial'. All arguments (N, H, W, 3); output
+    replicated over 'spatial', sharded over 'data'.
+
+    Bit-parity with `ops.sifinder.synthesize_side_image` (Pearson mode with
+    the standard Gaussian prior, or no mask)."""
+    fn = build_synthesize_shmap(mesh, patch_h, patch_w, img_h, img_w,
+                                use_mask)
     x_sh = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
     y_sh = NamedSharding(mesh, P(DATA_AXIS, None, SPATIAL_AXIS, None))
+    return jax.jit(fn, in_shardings=(x_sh, y_sh, y_sh), out_shardings=x_sh)
 
-    @partial(jax.jit, in_shardings=(x_sh, y_sh, y_sh),
-             out_shardings=x_sh)
-    def run(x_dec, y_img, y_dec):
-        return shmap(x_dec, y_img, y_dec, gh, gw)
 
-    return run
+def make_spatial_inference_step(model, mesh, img_h: int, img_w: int):
+    """Full-model inference with the image WIDTH sharded over 'spatial' —
+    the large-extent path (Cityscapes-and-beyond resolutions, SURVEY §5)
+    where one chip can't hold the score map or the activations:
+
+      * the conv stacks (encoder/decoder/probclass/siNet) run under
+        jit-with-shardings — GSPMD inserts the conv halo exchanges;
+      * the patch search runs through the hand-reduced shard_map
+        (build_synthesize_shmap) because GSPMD would all-gather its
+        score map.
+
+    Returns jitted (state, x, y) -> dict like step.make_inference_step;
+    x/y must be (N, img_h, img_w, 3), batch divisible by the 'data' axis.
+    """
+    from dsin_tpu.models.probclass import bitcost_to_bpp
+
+    cfg = model.ae_config
+    assert not model.ae_only, (
+        "make_spatial_inference_step is the SI path; AE_only models have "
+        "no siNet — use step.make_inference_step")
+    ph, pw = cfg.y_patch_size
+    use_mask = bool(cfg.use_gauss_mask)
+    syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w, use_mask)
+
+    repl = NamedSharding(mesh, P())
+    img_sh = NamedSharding(mesh, P(DATA_AXIS, None, SPATIAL_AXIS, None))
+
+    def infer(state, x, y):
+        params, bs = state.params, state.batch_stats
+        enc_out, _ = model.encode(params, bs, x, train=False)
+        x_dec, _ = model.decode(params, bs, enc_out.qbar, train=False)
+        y_enc, _ = model.encode(params, bs, y, train=False)
+        y_dec, _ = model.decode(params, bs, y_enc.qbar, train=False)
+        y_syn = syn(x_dec, y, y_dec)
+        x_with_si = model.apply_sinet(params, x_dec, y_syn)
+        bc = model.bitcost(params, enc_out.qbar, enc_out.symbols)
+        return {"x_dec": x_dec, "x_with_si": x_with_si, "y_syn": y_syn,
+                "bpp": bitcost_to_bpp(bc, x)}
+
+    return jax.jit(infer, in_shardings=(repl, img_sh, img_sh))

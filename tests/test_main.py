@@ -183,3 +183,59 @@ def test_rd_sweep_smoke(tmp_path):
     assert all("psnr" in p and "bpp" in p for p in points)
     with open(os.path.join(out, "rd_curve.json")) as f:
         assert len(json.load(f)) == 2
+
+
+@pytest.mark.slow
+def test_periodic_and_emergency_checkpoints(tmp_path):
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+    ae = ae.replace(checkpoint_every=2, validate_every=100)
+
+    exp = Experiment(ae, pc, out_root=out)
+    exp.train(max_steps=2, max_val_batches=1)
+    periodic = os.path.join(exp.ckpt_dir, "periodic")
+    assert os.path.exists(os.path.join(periodic, "meta.json"))
+
+    # crash mid-loop -> emergency checkpoint, exception propagates
+    calls = {"n": 0}
+    real_step = exp.train_step
+
+    def exploding_step(state, x, y):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("boom")
+        return real_step(state, x, y)
+
+    exp2 = Experiment(ae, pc, out_root=out)
+    exp2.train_step = exploding_step
+    with pytest.raises(RuntimeError, match="boom"):
+        exp2.train(max_steps=4, max_val_batches=1)
+    emergency = os.path.join(exp2.ckpt_dir, "emergency")
+    from dsin_tpu.train.checkpoint import load_meta
+    meta = load_meta(emergency)
+    assert meta["kind"] == "emergency" and "boom" in meta["error"]
+    assert meta["step"] == 1
+
+
+@pytest.mark.slow
+def test_resume_continues_iteration_numbering(tmp_path):
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+    ae = ae.replace(validate_every=2)
+
+    exp = Experiment(ae, pc, out_root=out)
+    r1 = exp.train(max_steps=2, max_val_batches=1)
+    assert r1["steps"] == 2
+
+    ae2 = ae.replace(load_model=True, load_model_name=exp.model_name,
+                     load_train_step=True)
+    exp2 = Experiment(ae2, pc, out_root=out)
+    exp2.maybe_restore()
+    assert int(exp2.state.step) == 2
+    r2 = exp2.train(max_steps=4, max_val_batches=1)
+    assert r2["steps"] == 2  # only steps 2..4, not a restart from 0
+    assert int(exp2.state.step) == 4

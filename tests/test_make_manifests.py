@@ -1,0 +1,69 @@
+"""Manifest generator -> manifest reader roundtrip on a fake KITTI tree."""
+
+import os
+
+import numpy as np
+
+from dsin_tpu.data.make_manifests import (general_pairs, main, split_pairs,
+                                          stereo_pairs, write_manifest)
+from dsin_tpu.data.manifest import read_pair_manifest
+
+
+def _fake_kitti(root, n_seq=3, n_frames=5):
+    made = []
+    base = os.path.join(root, "data_scene_flow_multiview", "training")
+    for cam in ("image_2", "image_3"):
+        os.makedirs(os.path.join(base, cam), exist_ok=True)
+    for s in range(n_seq):
+        for f in range(n_frames):
+            name = f"{s:06d}_{f:02d}.png"
+            for cam in ("image_2", "image_3"):
+                p = os.path.join(base, cam, name)
+                open(p, "wb").close()
+                made.append(p)
+    return made
+
+
+def test_stereo_pairs_same_frame_cross_camera(tmp_path):
+    root = str(tmp_path)
+    _fake_kitti(root)
+    pairs = stereo_pairs(root)
+    assert len(pairs) == 15
+    for x, y in pairs:
+        assert "image_2" in x and "image_3" in y
+        assert os.path.basename(x) == os.path.basename(y)
+
+
+def test_general_pairs_same_sequence_small_offset(tmp_path):
+    root = str(tmp_path)
+    _fake_kitti(root)
+    pairs = general_pairs(root, max_offset=2, seed=0)
+    assert pairs
+    for x, y in pairs:
+        sx, fx = os.path.basename(x)[:-4].split("_")
+        sy, fy = os.path.basename(y)[:-4].split("_")
+        assert sx == sy
+        assert 1 <= int(fy) - int(fx) <= 2
+
+
+def test_split_deterministic_and_disjoint():
+    pairs = [(f"x{i}", f"y{i}") for i in range(10)]
+    s1 = split_pairs(pairs, 0.2, 0.2, seed=1)
+    s2 = split_pairs(pairs, 0.2, 0.2, seed=1)
+    assert s1 == s2
+    assert len(s1["val"]) == 2 and len(s1["test"]) == 2
+    assert len(s1["train"]) == 6
+    all_items = s1["train"] + s1["val"] + s1["test"]
+    assert len({x for x, _ in all_items}) == 10
+
+
+def test_cli_roundtrip_with_reader(tmp_path):
+    root = str(tmp_path / "kitti")
+    out = str(tmp_path / "data_paths")
+    _fake_kitti(root)
+    main(["--kitti_root", root, "--out_dir", out, "--mode", "stereo"])
+    manifest = os.path.join(out, "KITTI_stereo_train.txt")
+    pairs = read_pair_manifest(manifest, root=root)
+    assert len(pairs) == 9   # 15 - 3 val - 3 test
+    for x, y in pairs:
+        assert os.path.exists(x) and os.path.exists(y)

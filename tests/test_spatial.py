@@ -71,6 +71,37 @@ def test_match_straddling_shard_boundary(mesh):
         x[0, pr:pr + PH, pc:pc + PW], atol=1e-3)
 
 
+def test_spatial_inference_step_matches_single_device(mesh):
+    """Full-model width-sharded inference == unsharded inference step."""
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    from dsin_tpu.parallel.spatial import make_spatial_inference_step
+    from dsin_tpu.train import step as step_lib
+    import optax
+
+    ae = tiny_ae_cfg(AE_only=False, crop_size=(H, W), batch_size=2)
+    model = DSIN(ae, tiny_pc_cfg())
+    variables = model.init_variables(jax.random.PRNGKey(0), (2, H, W, 3))
+    state = step_lib.TrainState(
+        params=variables.params, batch_stats=variables.batch_stats,
+        opt_state=(), step=jnp.int32(0))
+
+    x, y = _pair(9)
+    mask = jnp.asarray(gaussian_position_mask(H, W, PH, PW))
+    ref = step_lib.make_inference_step(model, si_mask=mask)(state, x, y)
+
+    out = make_spatial_inference_step(model, mesh, H, W)(state, x, y)
+    np.testing.assert_allclose(np.asarray(out["y_syn"]),
+                               np.asarray(ref["y_syn"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["x_with_si"]),
+                               np.asarray(ref["x_with_si"]),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(float(out["bpp"]), float(ref["bpp"]),
+                               rtol=1e-5)
+
+
 def test_output_sharding(mesh):
     x, y = _pair(2)
     fn = spatial.make_spatial_synthesize(mesh, PH, PW, H, W)
