@@ -1,0 +1,106 @@
+"""Direct tests of the host-side data pipeline (data/loader.py)."""
+
+import numpy as np
+import pytest
+
+from dsin_tpu.data.loader import (PairDataset, Prefetcher, center_pair_crop,
+                                  random_pair_crops)
+
+H, W = 24, 32
+CROP = (16, 20)
+
+
+def _fake_pairs(n):
+    """(x_path, y_path) placeholders + a decode_fn mapping path -> image
+    whose pixels encode the pair index (x side = i, y side = i + 100)."""
+    pairs = [(f"x{i}", f"y{i}") for i in range(n)]
+
+    def decode(path):
+        i = int(path[1:])
+        val = i if path[0] == "x" else i + 100
+        return np.full((H, W, 3), val % 256, dtype=np.uint8)
+
+    return pairs, decode
+
+
+def test_eval_batches_deterministic_center_crop_in_order():
+    pairs, decode = _fake_pairs(4)
+    ds = PairDataset(pairs, CROP, batch_size=1, train=False,
+                     decode_fn=decode)
+    got = [(int(x[0, 0, 0, 0]), int(y[0, 0, 0, 0]))
+           for x, y in ds.batches(loop=False)]
+    assert got == [(0, 100), (1, 101), (2, 102), (3, 103)]
+    x, y = next(ds.batches(loop=False))
+    assert x.shape == (1, *CROP, 3) and y.shape == (1, *CROP, 3)
+    assert x.dtype == np.float32 and y.dtype == np.float32
+
+
+def test_train_batches_loop_and_shapes():
+    pairs, decode = _fake_pairs(3)
+    ds = PairDataset(pairs, CROP, batch_size=2, train=True,
+                     num_crops_per_img=2, decode_fn=decode, seed=1)
+    it = ds.batches()
+    for _ in range(5):   # > one epoch (3*2//2 = 3 batches/epoch): must loop
+        x, y = next(it)
+        assert x.shape == (2, *CROP, 3)
+        # x/y sides of each item stay paired (y = x + 100)
+        np.testing.assert_array_equal(y[..., 0], x[..., 0] + 100)
+
+
+def test_crops_paired_and_flipped_together():
+    rng = np.random.default_rng(0)
+    # channels encode ABSOLUTE (row, col) so any independent shift or flip
+    # of one side is detectable (no wraparound/periodic pattern)
+    rr, cc = np.meshgrid(np.arange(H, dtype=np.uint8),
+                         np.arange(W, dtype=np.uint8), indexing="ij")
+    x_img = np.stack([rr, cc, np.zeros_like(rr)], axis=-1)
+    pair = np.concatenate([x_img, x_img + 7], axis=-1)
+    crops = random_pair_crops(pair, *CROP, num_crops=8, do_flip=True,
+                              rng=rng)
+    for c in crops:
+        assert c.shape == (*CROP, 6)
+        # same spatial window + same flip on both sides
+        np.testing.assert_array_equal(c[..., 3:], c[..., :3] + 7)
+
+
+def test_center_crop_is_centered():
+    img = np.zeros((H, W, 6), np.uint8)
+    img[4:20, 6:26, :] = 1   # exactly the centered 16x20 window
+    crop = center_pair_crop(img, *CROP)
+    assert crop.min() == 1
+
+
+def test_host_sharding_partitions_pairs():
+    pairs, decode = _fake_pairs(6)
+    seen = []
+    for host in range(2):
+        ds = PairDataset(pairs, CROP, batch_size=1, train=False,
+                         num_hosts=2, host_id=host, decode_fn=decode)
+        seen.append({int(x[0, 0, 0, 0])
+                     for x, _ in ds.batches(loop=False)})
+    assert seen[0] == {0, 2, 4} and seen[1] == {1, 3, 5}
+    with pytest.raises(ValueError, match="no pairs"):
+        PairDataset(pairs[:1], CROP, batch_size=1, train=False,
+                    num_hosts=2, host_id=1, decode_fn=decode)
+
+
+def test_drop_remainder():
+    pairs, decode = _fake_pairs(5)
+    ds = PairDataset(pairs, CROP, batch_size=2, train=False,
+                     decode_fn=decode)
+    assert len(list(ds.batches(loop=False))) == 2  # 5 -> 2 full batches
+
+
+def test_prefetcher_propagates_errors_and_stops():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("decode failed")
+
+    pf = Prefetcher(gen())
+    assert next(pf) == 1 and next(pf) == 2
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(pf)
+
+    pf2 = Prefetcher(iter([7]))
+    assert list(pf2) == [7]
