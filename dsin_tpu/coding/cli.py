@@ -1,0 +1,181 @@
+"""Image compress / decompress CLI — real files in, real files out.
+
+The reference never produces a bitstream (its "test" mode dumps
+reconstructions + estimated bpp; reference main.py:101-126, SURVEY §3.4);
+this tool completes the pipeline: PNG -> encoder -> quantized symbols ->
+context-model rANS stream on disk, and back. Decompression optionally takes
+the decoder-side information image to run the full DSIN path (patch search +
+siNet fusion) — the asymmetry that defines the method: the ENCODER never
+sees y, so the bitstream is identical with or without it.
+
+File format (little-endian):
+    b"DSIM" | u8 version | u16 img_h | u16 img_w | u32 payload_len | payload
+where payload is a BottleneckCodec stream (its own header carries the
+symbol-volume dims).
+
+Usage:
+    python -m dsin_tpu.coding.cli compress  x.png out.dsin --ckpt weights/m
+    python -m dsin_tpu.coding.cli decompress out.dsin rec.png \
+        --ckpt weights/m [--side y.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAGIC = b"DSIM"
+VERSION = 1
+
+
+def _load_model_state(ae_config_path: str, pc_config_path: str,
+                      ckpt_dir: Optional[str], img_shape,
+                      need_sinet: bool):
+    """Build DSIN (+ optional checkpoint restore) with a minimal state."""
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.train.step import TrainState
+
+    ae_cfg = parse_config_file(ae_config_path)
+    if not need_sinet:
+        ae_cfg = ae_cfg.replace(AE_only=True)
+    pc_cfg = parse_config_file(pc_config_path)
+    model = DSIN(ae_cfg, pc_cfg)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     (1, *img_shape, 3))
+    state = TrainState(params=variables.params,
+                       batch_stats=variables.batch_stats,
+                       opt_state=(), step=jnp.int32(0))
+    if ckpt_dir:
+        parts = list(ckpt_lib.AE_PARTITIONS)
+        if need_sinet:
+            parts.append("sinet")
+        state = ckpt_lib.restore_partitions(ckpt_dir, state, parts)
+    return model, state
+
+
+def _make_codec(model, state):
+    from dsin_tpu.coding.codec import BottleneckCodec
+    return BottleneckCodec(model.probclass, state.params["probclass"],
+                           state.params["centers"], model.pc_config)
+
+
+def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
+             ckpt: Optional[str] = None) -> dict:
+    from dsin_tpu.coding.codec import encode_batch
+    from dsin_tpu.data.loader import decode_image
+
+    x = decode_image(x_path).astype(np.float32)
+    h, w, _ = x.shape
+    if h % 8 or w % 8:
+        raise ValueError(
+            f"image {h}x{w} must be divisible by the subsampling factor 8")
+    model, state = _load_model_state(ae_config, pc_config, ckpt, (h, w),
+                                     need_sinet=False)
+    enc_out, _ = model.encode(state.params, state.batch_stats,
+                              jnp.asarray(x[None]), train=False)
+    symbols = np.asarray(enc_out.symbols[0])          # (h/8, w/8, C)
+    payload = encode_batch(_make_codec(model, state), symbols[None])[0]
+
+    with open(out_path, "wb") as f:
+        f.write(MAGIC + struct.pack("<BHHI", VERSION, h, w, len(payload)))
+        f.write(payload)
+    bpp = len(payload) * 8.0 / (h * w)
+    return {"bytes": len(payload), "bpp": bpp, "shape": (h, w)}
+
+
+def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
+               ckpt: Optional[str] = None,
+               side: Optional[str] = None) -> dict:
+    from dsin_tpu.coding.codec import decode_batch
+    from dsin_tpu.data.loader import decode_image
+    from dsin_tpu.models.quantizer import centers_lookup
+
+    with open(in_path, "rb") as f:
+        blob = f.read()
+    if len(blob) < 13 or blob[:4] != MAGIC:
+        raise ValueError("not a DSIM stream")
+    version, h, w, n = struct.unpack("<BHHI", blob[4:13])
+    if version != VERSION:
+        raise ValueError(f"unsupported version {version}")
+    payload = blob[13:13 + n]
+    if len(payload) != n:
+        # the rANS decoder cannot detect truncation itself — it would
+        # silently produce garbage symbols
+        raise ValueError(f"truncated stream: payload {len(payload)} of "
+                         f"{n} bytes")
+
+    model, state = _load_model_state(ae_config, pc_config, ckpt, (h, w),
+                                     need_sinet=side is not None)
+    codec = _make_codec(model, state)
+    symbols = decode_batch(codec, [payload])          # (1, h/8, w/8, C)
+    q = centers_lookup(jnp.asarray(state.params["centers"]),
+                       jnp.asarray(symbols))
+    x_dec, _ = model.decode(state.params, state.batch_stats, q, train=False)
+
+    if side is not None:
+        from dsin_tpu.ops.sifinder import (gaussian_position_mask,
+                                           synthesize_side_image)
+        y = decode_image(side).astype(np.float32)[None]
+        if y.shape[1:3] != (h, w):
+            raise ValueError(f"side image {y.shape[1:3]} != stream image "
+                             f"({h}, {w})")
+        y_enc, _ = model.encode(state.params, state.batch_stats,
+                                jnp.asarray(y), train=False)
+        y_dec, _ = model.decode(state.params, state.batch_stats,
+                                y_enc.qbar, train=False)
+        ph, pw = model.ae_config.y_patch_size
+        mask = (jnp.asarray(gaussian_position_mask(h, w, ph, pw))
+                if model.ae_config.use_gauss_mask else None)
+        y_syn = synthesize_side_image(x_dec, jnp.asarray(y), y_dec, mask,
+                                      ph, pw, model.ae_config)
+        out = model.apply_sinet(state.params, x_dec, y_syn)
+    else:
+        out = x_dec
+
+    img = np.clip(np.asarray(out[0]), 0, 255).astype(np.uint8)
+    from PIL import Image
+    Image.fromarray(img).save(out_path)
+    return {"shape": (h, w), "with_si": side is not None}
+
+
+def main(argv=None) -> None:
+    base = os.path.join(os.path.dirname(__file__), os.pardir, "configs")
+    p = argparse.ArgumentParser(description="dsin_tpu image codec")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("compress", "decompress"):
+        sp = sub.add_parser(name)
+        sp.add_argument("input")
+        sp.add_argument("output")
+        sp.add_argument("--ae_config",
+                        default=os.path.join(base, "ae_kitti_stereo"))
+        sp.add_argument("--pc_config",
+                        default=os.path.join(base, "pc_default"))
+        sp.add_argument("--ckpt", default=None,
+                        help="checkpoint dir (weights/<model_name>)")
+    sub.choices["decompress"].add_argument(
+        "--side", default=None,
+        help="decoder-side information image (enables the SI path)")
+    args = p.parse_args(argv)
+
+    if args.cmd == "compress":
+        info = compress(args.input, args.output, args.ae_config,
+                        args.pc_config, args.ckpt)
+        print(f"{args.output}: {info['bytes']} bytes, "
+              f"{info['bpp']:.4f} bpp @ {info['shape']}")
+    else:
+        info = decompress(args.input, args.output, args.ae_config,
+                          args.pc_config, args.ckpt, args.side)
+        print(f"{args.output}: reconstructed {info['shape']}"
+              f"{' with side information' if info['with_si'] else ''}")
+
+
+if __name__ == "__main__":
+    main()

@@ -345,11 +345,17 @@ def parse_args(argv=None):
     p.add_argument("--max_test_images", type=int, default=None)
     p.add_argument("--profile_dir", default=None,
                    help="capture an XLA trace of a few warm train steps")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host: call jax.distributed.initialize() "
+                        "(coordinator/host env per JAX docs); each host "
+                        "loads its own manifest shard automatically")
     return p.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.distributed:
+        jax.distributed.initialize()
     ae_config = parse_config_file(args.ae_config)
     pc_config = parse_config_file(args.pc_config)
     if args.data_root:

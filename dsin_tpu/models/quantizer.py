@@ -37,6 +37,13 @@ def init_centers(rng: jax.Array, num_centers: int,
                               float(minval), float(maxval))
 
 
+def centers_lookup(centers: jnp.ndarray,
+                   symbols: jnp.ndarray) -> jnp.ndarray:
+    """Map int symbols back to center values — the decoder-side inverse of
+    `quantize(...).symbols` (qhard == centers_lookup(centers, symbols))."""
+    return jnp.take(centers, symbols)
+
+
 def quantize(x: jnp.ndarray, centers: jnp.ndarray,
              sigma: float = 1.0) -> QuantizerOutput:
     """Quantize `x` (any shape) against `centers` (L,).

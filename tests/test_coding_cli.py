@@ -1,0 +1,91 @@
+"""End-to-end file codec: PNG -> .dsin bitstream -> reconstruction PNG."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dsin_tpu.coding import cli as codec_cli
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    """Config files small enough that the sequential codec scan is fast
+    (16x24 image -> 2x3x4 = 24 bottleneck symbols)."""
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("cfg")
+    ae = tiny_ae_cfg(AE_only=False, crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def _write_png(path, seed, h=16, w=24):
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8).astype("uint8")
+    Image.fromarray(img).save(path)
+    return img
+
+
+def test_compress_decompress_roundtrip(tmp_path, tiny_cfg_files):
+    ae_p, pc_p = tiny_cfg_files
+    x_png = str(tmp_path / "x.png")
+    stream = str(tmp_path / "x.dsin")
+    rec = str(tmp_path / "rec.png")
+    _write_png(x_png, 0)
+
+    info = codec_cli.compress(x_png, stream, ae_p, pc_p)
+    assert info["shape"] == (16, 24) and info["bytes"] > 0
+    assert os.path.getsize(stream) == 13 + info["bytes"]
+
+    out = codec_cli.decompress(stream, rec, ae_p, pc_p)
+    assert out["shape"] == (16, 24) and not out["with_si"]
+
+    # reconstruction must equal running the model forward directly: the
+    # stream carries the exact quantized symbols
+    import jax.numpy as jnp
+    from dsin_tpu.data.loader import decode_image
+    from dsin_tpu.models.quantizer import centers_lookup
+    model, state = codec_cli._load_model_state(ae_p, pc_p, None, (16, 24),
+                                               need_sinet=False)
+    x = decode_image(x_png).astype(np.float32)
+    enc_out, _ = model.encode(state.params, state.batch_stats,
+                              jnp.asarray(x[None]), train=False)
+    # expectation decodes exact qhard = centers[symbols], like the stream
+    # does (qbar = qsoft + (qhard - qsoft) is not bit-identical in fp32)
+    q = centers_lookup(jnp.asarray(state.params["centers"]),
+                       enc_out.symbols)
+    x_dec, _ = model.decode(state.params, state.batch_stats, q,
+                            train=False)
+    expect = np.clip(np.asarray(x_dec[0]), 0, 255).astype(np.uint8)
+    got = decode_image(rec)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_decompress_with_side_information(tmp_path, tiny_cfg_files):
+    ae_p, pc_p = tiny_cfg_files
+    x_png = str(tmp_path / "x.png")
+    y_png = str(tmp_path / "y.png")
+    stream = str(tmp_path / "x.dsin")
+    rec = str(tmp_path / "rec_si.png")
+    _write_png(x_png, 1)
+    _write_png(y_png, 2)
+
+    codec_cli.compress(x_png, stream, ae_p, pc_p)
+    out = codec_cli.decompress(stream, rec, ae_p, pc_p, side=y_png)
+    assert out["with_si"]
+    assert os.path.exists(rec)
+
+
+def test_cli_main_reports(tmp_path, tiny_cfg_files, capsys):
+    ae_p, pc_p = tiny_cfg_files
+    x_png = str(tmp_path / "x.png")
+    stream = str(tmp_path / "x.dsin")
+    _write_png(x_png, 3)
+    codec_cli.main(["compress", x_png, stream,
+                    "--ae_config", ae_p, "--pc_config", pc_p])
+    assert "bpp" in capsys.readouterr().out
