@@ -86,7 +86,10 @@ def main():
             for _ in range(WARMUP):
                 state, metrics = train_step(state, x, y)
             jax.block_until_ready(metrics["loss"])
-            used_impl = impl
+            # record the concrete kernel, not 'auto' (same dispatch rule
+            # as ops/sifinder.py)
+            used_impl = impl if impl != "auto" else (
+                "pallas" if jax.default_backend() == "tpu" else "xla")
             break
         except Exception as e:  # noqa: BLE001
             last_err = e

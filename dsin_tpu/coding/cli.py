@@ -114,6 +114,14 @@ def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
 
     model, state = _load_model_state(ae_config, pc_config, ckpt, (h, w),
                                      need_sinet=side is not None)
+    if side is not None:
+        # validate the SI path up front — the entropy decode below is the
+        # slow part and must not be wasted on a doomed reconstruction
+        ph, pw = model.ae_config.y_patch_size
+        if h % ph or w % pw:
+            raise ValueError(
+                f"image {h}x{w} not divisible by y_patch_size ({ph}, {pw});"
+                f" the side-information search needs whole patches")
     codec = _make_codec(model, state)
     symbols = decode_batch(codec, [payload])          # (1, h/8, w/8, C)
     q = centers_lookup(jnp.asarray(state.params["centers"]),

@@ -262,6 +262,43 @@ def quantize_pmf(pmf: np.ndarray,
     return freqs.astype(np.uint32)
 
 
+def quantize_pmf_batch(pmfs: np.ndarray,
+                       scale_bits: int = DEFAULT_SCALE_BITS) -> np.ndarray:
+    """Row-wise `quantize_pmf` over (B, L) PMFs, bit-identical results.
+
+    The common path (floor + clamp, positive drift onto the argmax bin —
+    a single step in the scalar routine too) is fully vectorized; rows
+    needing the rare negative-drift loop fall back to the scalar function.
+    """
+    total = 1 << scale_bits
+    pmfs = np.asarray(pmfs, dtype=np.float64)
+    pmfs = np.maximum(pmfs, 0.0)
+    norm = pmfs.sum(axis=1, keepdims=True)
+    bad = ~np.isfinite(norm[:, 0]) | (norm[:, 0] <= 0)
+    if bad.any():
+        pmfs = pmfs.copy()
+        pmfs[bad] = 1.0
+        norm = pmfs.sum(axis=1, keepdims=True)
+    freqs = np.floor(pmfs / norm * total).astype(np.int64)
+    freqs = np.maximum(freqs, 1)
+    diff = total - freqs.sum(axis=1)
+    pos = diff > 0
+    if pos.any():
+        rows = np.flatnonzero(pos)
+        freqs[rows, np.argmax(freqs[rows], axis=1)] += diff[rows]
+    for r in np.flatnonzero(diff < 0):
+        freqs[r] = quantize_pmf(pmfs[r], scale_bits)
+    return freqs.astype(np.uint32)
+
+
+def cum_from_freqs_batch(freqs: np.ndarray) -> np.ndarray:
+    """Row-wise `cum_from_freqs`: (B, L) -> (B, L+1) uint32."""
+    freqs = np.asarray(freqs, dtype=np.uint64)
+    out = np.zeros((freqs.shape[0], freqs.shape[1] + 1), dtype=np.uint64)
+    np.cumsum(freqs, axis=1, out=out[:, 1:])
+    return out.astype(np.uint32)
+
+
 def cum_from_freqs(freqs: np.ndarray) -> np.ndarray:
     """Cumulative table (L+1,) from frequencies (L,)."""
     cum = np.zeros(len(freqs) + 1, dtype=np.uint32)

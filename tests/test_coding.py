@@ -133,9 +133,41 @@ def test_codec_stream_size_matches_ideal(tiny_codec):
     symbols = rng.integers(0, codec.num_centers, (d, h, w))
     stream = codec.encode(symbols)
     ideal = codec.ideal_bits(symbols)
-    actual = 8 * (len(stream) - 12)  # strip the 12-byte frame header
+    actual = 8 * (len(stream) - 13)  # strip the 13-byte frame header
     assert actual >= ideal * 0.99
     assert actual <= ideal * 1.05 + 64, (actual, ideal)
+
+
+def test_codec_sequential_mode_roundtrip(tiny_codec):
+    codec, (d, h, w), _, _ = tiny_codec
+    rng = np.random.default_rng(12)
+    symbols = rng.integers(0, codec.num_centers, (d, h, w))
+    stream = codec.encode(symbols, mode="sequential")
+    np.testing.assert_array_equal(codec.decode(stream), symbols)
+    # wavefront stream decodes identically (mode travels in the header)
+    wf = codec.encode(symbols, mode="wavefront")
+    np.testing.assert_array_equal(codec.decode(wf), symbols)
+
+
+def test_wavefront_schedule_is_causal_and_complete(tiny_codec):
+    codec, (d, h, w), _, _ = tiny_codec
+    fronts = codec._wavefronts(d, h, w)
+    seen = {}
+    for t, front in enumerate(fronts):
+        for dd, hh, ww in front:
+            seen[(dd, hh, ww)] = t
+    assert len(seen) == d * h * w  # every position exactly once
+    p = codec.pad
+    # every causal dependency within the context window lies in a strictly
+    # earlier front
+    for (dd, hh, ww), t in seen.items():
+        for dd2 in range(max(0, dd - p), dd + 1):
+            for hh2 in range(max(0, hh - p), min(h, hh + p + 1)):
+                for ww2 in range(max(0, ww - p), min(w, ww + p + 1)):
+                    raster_earlier = ((dd2, hh2, ww2) < (dd, hh, ww))
+                    if raster_earlier:
+                        assert seen[(dd2, hh2, ww2)] < t, (
+                            (dd2, hh2, ww2), (dd, hh, ww))
 
 
 def test_codec_block_logits_match_full_conv(tiny_codec):
@@ -174,8 +206,7 @@ def test_codec_decode_sees_only_causal_context(tiny_codec):
     codec, (d, h, w), _, _ = tiny_codec
     rng = np.random.default_rng(8)
     symbols = rng.integers(0, codec.num_centers, (d, h, w))
-    # sequential encode (production path)
-    stream = codec.encode(symbols)
+    stream = codec.encode(symbols, mode="sequential")
     # full-buffer variant: pre-fill everything, freqs from complete volume
     buf = codec._make_buffer(d, h, w)
     p = codec.pad
@@ -189,7 +220,7 @@ def test_codec_decode_sees_only_causal_context(tiny_codec):
         freqs.append(f[s])
     alt = rans.encode(np.array(starts, np.uint32),
                       np.array(freqs, np.uint32), codec.scale_bits)
-    assert stream[12:] == alt
+    assert stream[13:] == alt
 
 
 def test_codec_batch_nhwc(tiny_codec):
