@@ -86,6 +86,48 @@ def general_pairs(kitti_root: str, max_offset: int = 2,
     return pairs
 
 
+def reference_stereo_splits(kitti_root: str) -> Dict[str, List[Tuple[str, str]]]:
+    """The reference's EXACT split rule, reverse-engineered from its frozen
+    lists (reference data_paths/KITTI_stereo_{train,val,test}.txt,
+    1576/790/790 pairs):
+
+      * only frames 10 and 11 of each sequence are used (the canonical
+        KITTI stereo-benchmark frames; the other multiview frames 0..20
+        are ignored);
+      * train = the `training` split of both subsets, frames 10 AND 11;
+      * val   = the `testing` split, frame 11 only;
+      * test  = the `testing` split, frame 10 only;
+      * every pair appears in BOTH directions — each subset contributes a
+        block of forward pairs (x=image_2, y=image_3) followed by the same
+        block swapped (x=image_3, y=image_2), doubling the data;
+      * ordering: subset alphabetical (data_scene_flow_multiview first),
+        then within a subset: forward block then swapped block, each in
+        sequence-then-frame ascending order.
+
+    On a standard KITTI multiview layout (scene_flow: 200 train + 200 test
+    sequences; stereo_flow: 194 train + 195 test) this reproduces the
+    reference's counts (1576/790/790) and line order exactly.
+    """
+    splits: Dict[str, List[Tuple[str, str]]] = {
+        "train": [], "val": [], "test": []}
+    by_subset: Dict[str, Dict[str, List[Tuple[str, str]]]] = {}
+    for (subset, split, _), frames in sorted(_scan(kitti_root).items()):
+        for frame in sorted(frames):
+            if frame not in (10, 11):
+                continue
+            rel2 = frames[frame]
+            pair = (rel2, rel2.replace("image_2", "image_3"))
+            name = ("train" if split == "training"
+                    else "val" if frame == 11 else "test")
+            by_subset.setdefault(subset, {"train": [], "val": [],
+                                          "test": []})[name].append(pair)
+    for subset in sorted(by_subset):
+        for name, fwd in by_subset[subset].items():
+            splits[name].extend(fwd)
+            splits[name].extend((y, x) for x, y in fwd)
+    return splits
+
+
 def split_pairs(pairs: List[Tuple[str, str]], val_frac: float,
                 test_frac: float, seed: int = 0):
     """Deterministic shuffled split into train/val/test."""
@@ -111,11 +153,28 @@ def main(argv=None) -> None:
     p.add_argument("--kitti_root", required=True)
     p.add_argument("--out_dir", default="data_paths")
     p.add_argument("--mode", choices=("stereo", "general"), default="stereo")
+    p.add_argument("--split_rule", choices=("reference", "random"),
+                   default="reference",
+                   help="'reference' (stereo mode only) reproduces the "
+                        "reference's frozen 1576/790/790 lists exactly; "
+                        "'random' is a seeded fractional split over all "
+                        "frames")
     p.add_argument("--val_frac", type=float, default=0.2)
     p.add_argument("--test_frac", type=float, default=0.2)
     p.add_argument("--max_offset", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+
+    if args.mode == "stereo" and args.split_rule == "reference":
+        splits = reference_stereo_splits(args.kitti_root)
+        if not any(splits.values()):
+            raise SystemExit(
+                f"no image_2/image_3 pairs under {args.kitti_root}")
+        for split, split_list in splits.items():
+            out = os.path.join(args.out_dir, f"KITTI_stereo_{split}.txt")
+            write_manifest(out, split_list)
+            print(f"{out}: {len(split_list)} pairs")
+        return
 
     pairs = (stereo_pairs(args.kitti_root) if args.mode == "stereo"
              else general_pairs(args.kitti_root, args.max_offset, args.seed))

@@ -137,12 +137,23 @@ class Experiment:
 
     def maybe_restore(self) -> None:
         cfg = self.ae_config
+        self.restored_best_val = float("inf")
         if not cfg.load_model:
             return
         load_dir = os.path.join(self.weights_root, cfg.load_model_name)
         self.state = ckpt_lib.restore_for_mode(load_dir, self.state, cfg)
+        if cfg.load_train_step:
+            # true resume of the same phase: seed best-val tracking from the
+            # checkpoint so the first validation isn't always "improved" and
+            # doesn't overwrite the true best with a regression. (A phase
+            # switch — e.g. AE_only weights warm-starting siNet training —
+            # changes the loss composition, so its old best_val is
+            # incomparable and stays unused.)
+            self.restored_best_val = float(
+                ckpt_lib.load_meta(load_dir).get("best_val", float("inf")))
         color_print(f"restored from {load_dir} "
-                    f"(step {int(self.state.step)})", "green")
+                    f"(step {int(self.state.step)}, "
+                    f"best_val {self.restored_best_val})", "green")
 
     # -- train --------------------------------------------------------------
 
@@ -183,7 +194,7 @@ class Experiment:
         profiler = StepProfiler(
             profile_dir, start_step=start + min(5, max(remaining - 3, 0)))
         checkpoint_every = cfg.get("checkpoint_every", None)
-        best_val = float("inf")
+        best_val = getattr(self, "restored_best_val", float("inf"))
         accum: Dict[str, float] = {}
         n_accum = 0
         val_losses = []
@@ -246,12 +257,18 @@ class Experiment:
                             self.weights_root, self.model_name, cfg,
                             self.pc_config, iteration=i + 1,
                             total_iterations=iterations, best_val=best_val)
-        except Exception as e:
+        except BaseException as e:
             # emergency save: preserve the in-flight state before dying.
+            # BaseException, not Exception: Ctrl-C / SIGINT-driven preemption
+            # (KeyboardInterrupt) and SystemExit are how long TPU runs most
+            # often die, and they must reach this save too. (SIGKILL/SIGTERM
+            # without a Python handler still can't — that's what
+            # checkpoint_every bounds.)
             # Guarded: device-side crashes can leave self.state donated or
             # error-poisoned, in which case the save itself raises — never
             # let that mask the original error.
-            if cfg.get("save_model", True) and timer.total_steps > 0:
+            if (cfg.get("save_model", True) and timer.total_steps > 0
+                    and not isinstance(e, GeneratorExit)):
                 emergency = os.path.join(self.ckpt_dir, "emergency")
                 try:
                     ckpt_lib.save_checkpoint(

@@ -76,9 +76,16 @@ def gaussian_position_mask(img_h: int, img_w: int, patch_h: int,
                            patch_w: int) -> np.ndarray:
     """Gaussian position prior, one map per x-patch, centered on that patch
     (reference AE.py:193-220). Returns (img_h - patch_h + 1,
-    img_w - patch_w + 1, P) float32, matching the VALID correlation map."""
+    img_w - patch_w + 1, P) float32, matching the VALID correlation map.
+
+    The product is taken in float32 over the float32 factors so that
+    mask[h, w, p] == f32(gh)[h, p] * f32(gw)[w, p] *exactly* — the
+    width-sharded search (parallel/spatial.py) applies the factors per
+    shard and stays bit-identical to this combined form."""
     gh, gw = _gaussian_mask_factors_f64(img_h, img_w, patch_h, patch_w)
-    return (gh[:, None, :] * gw[None, :, :]).astype(np.float32)
+    gh32 = gh.astype(np.float32)
+    gw32 = gw.astype(np.float32)
+    return gh32[:, None, :] * gw32[None, :, :]
 
 
 def gaussian_position_mask_factors(img_h: int, img_w: int, patch_h: int,
@@ -210,6 +217,10 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
     """
     use_l2 = bool(config.use_L2andLAB)
     impl = getattr(config, "sifinder_impl", "auto")
+    if impl not in ("auto", "xla", "pallas", "pallas_interpret"):
+        raise ValueError(
+            f"sifinder_impl={impl!r}: expected one of "
+            "'auto', 'xla', 'pallas', 'pallas_interpret'")
     if impl == "auto":
         impl = ("pallas" if (not use_l2 and
                              jax.default_backend() == "tpu") else "xla")
@@ -224,6 +235,23 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
             gw = np.ones((wc, p_count), np.float32)
         else:
             gh, gw = gaussian_position_mask_factors(h, w, patch_h, patch_w)
+            if not isinstance(mask, jax.core.Tracer):
+                # validate via thin slices, not the full (Hc, Wc, P)
+                # product — at the 320x960 operating point that would be
+                # ~722 MB of host temporaries per trace
+                hc, wc = gh.shape[0], gw.shape[0]
+                mask_np = np.asarray(mask)
+                ok = (mask_np.shape == (hc, wc, gh.shape[1])
+                      and np.allclose(mask_np[:, 0, :], gh * gw[0][None, :],
+                                      atol=1e-6)
+                      and np.allclose(mask_np[0, :, :], gh[0][None, :] * gw,
+                                      atol=1e-6))
+                if not ok:
+                    raise ValueError(
+                        "sifinder_impl='pallas' only supports the standard "
+                        "gaussian_position_mask (the kernel streams it in "
+                        "separable form); pass mask=None or use "
+                        "sifinder_impl='xla' for a custom mask")
         dtype = jnp.dtype(getattr(config, "sifinder_dtype", "bfloat16"))
         return sifinder_pallas.fused_synthesize_side_image(
             x_dec, y_img, y_dec, jnp.asarray(gh), jnp.asarray(gw),

@@ -15,6 +15,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dsin_tpu.models.dsin import DSIN
 from dsin_tpu.parallel import mesh as mesh_lib
@@ -43,3 +44,45 @@ def make_sharded_eval_step(model: DSIN, mesh,
     batch = mesh_lib.batch_sharding(mesh)
     return jax.jit(eval_fn, in_shardings=(repl, batch, batch),
                    out_shardings=repl)
+
+
+def make_spatial_train_step(model: DSIN, tx: optax.GradientTransformation,
+                            mesh, img_h: int, img_w: int,
+                            donate: bool = True):
+    """Width-sharded FULL training step over a (data, spatial) mesh — the
+    large-extent training path (SURVEY §5: Cityscapes-and-beyond crops whose
+    score map / activations exceed one chip):
+
+      * batch over 'data', image width over 'spatial' for both x and y;
+      * the conv stacks (encoder/decoder/probclass/siNet) and the backward
+        pass run under jit-with-shardings — GSPMD inserts the conv halo
+        exchanges and the gradient all-reduce;
+      * the patch search runs through the hand-reduced shard_map
+        (parallel/spatial.build_synthesize_shmap: ppermute halo +
+        all_gather argmax) because GSPMD would all-gather its score map.
+        The search is fully stop-gradiented (reference AE.py:67,74), so
+        the shard_map needs no VJP.
+
+    Gradient parity with the unsharded step is pinned by
+    tests/test_spatial.py. (state, x, y) -> (state, metrics); x and y must
+    be (N, img_h, img_w, 3)."""
+    from dsin_tpu.parallel.spatial import build_synthesize_shmap
+
+    cfg = model.ae_config
+    assert not model.ae_only, (
+        "spatial training is the SI path; AE_only needs no hand-sharded "
+        "search — use make_sharded_train_step (GSPMD shards its convs)")
+    ph, pw = cfg.y_patch_size
+    syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w,
+                                 use_mask=bool(cfg.use_gauss_mask))
+    fn = step_lib.build_train_step_fn(model, tx, si_mask=None,
+                                      synthesize_fn=syn)
+    repl = mesh_lib.replicated(mesh)
+    img_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None,
+                                   mesh_lib.SPATIAL_AXIS, None))
+    return jax.jit(
+        fn,
+        in_shardings=(repl, img_sh, img_sh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -48,13 +48,27 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, *arrays):
-    """Device-put arrays with the batch axis sharded over 'data'."""
+    """Device-put arrays with the batch axis sharded over 'data'.
+
+    Single-process: a plain sharded device_put. Multi-process
+    (jax.distributed): each host holds only ITS loader shard of the global
+    batch (loader.py `host_id::num_hosts`), so the local array is this
+    process's slice and the global batch is assembled across hosts —
+    device_put can't address other hosts' devices."""
     sh = batch_sharding(mesh)
-    out = tuple(jax.device_put(a, sh) for a in arrays)
+    if jax.process_count() > 1:
+        out = tuple(
+            jax.make_array_from_process_local_data(sh, np.asarray(a))
+            for a in arrays)
+    else:
+        out = tuple(jax.device_put(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
 
 
 def replicate_state(mesh: Mesh, state):
-    """Replicate a TrainState (or any pytree) across the mesh."""
+    """Replicate a TrainState (or any pytree) across the mesh. In
+    multi-process mode every host passes the same host-local values (same
+    init seed / restored checkpoint), which device_put broadcasts onto the
+    fully-replicated sharding."""
     sh = replicated(mesh)
     return jax.device_put(state, sh)

@@ -83,10 +83,14 @@ def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
     # scores: (Hc, Wl, P) — local slice of the global map's columns
     hc, wl, p_count = scores.shape
 
-    # global Gaussian prior, sliced to this shard's columns
+    # global Gaussian prior, sliced to this shard's columns; combine the
+    # factors FIRST so each masked score is scores * (gh*gw) — the exact
+    # multiply order of the unsharded path's combined mask
+    # (gaussian_position_mask builds the same f32 product), keeping
+    # near-tie argmax winners bit-identical
     gh_t = gh[:, None, :]                                   # (Hc, 1, P)
     gw_l = jax.lax.dynamic_slice(gw, (col0, 0), (wl, p_count))
-    scores = scores * gh_t * gw_l[None, :, :]
+    scores = scores * (gh_t * gw_l[None, :, :])
 
     # mask out-of-range global columns (right edge of the last shard)
     cols = col0 + jnp.arange(wl)

@@ -56,8 +56,14 @@ def create_train_state(model: DSIN, rng: jax.Array, input_shape,
 
 def _forward_losses(model: DSIN, params, batch_stats, x, y,
                     si_mask: Optional[jnp.ndarray], train: bool,
-                    collect_mutations: bool):
-    """Shared forward pass. Returns (loss, aux dict)."""
+                    collect_mutations: bool,
+                    synthesize_fn=None):
+    """Shared forward pass. Returns (loss, aux dict).
+
+    `synthesize_fn`: optional (x_dec, y_img, y_dec) -> y_syn override of the
+    default `ops.sifinder.synthesize_side_image` dispatch — the
+    width-sharded trainer injects its shard_map'd search here (the search
+    is fully stop-gradiented, so the override never needs a VJP)."""
     ae_cfg = model.ae_config
 
     enc_out, enc_mut = model.encode(params, batch_stats, x, train=train,
@@ -77,10 +83,13 @@ def _forward_losses(model: DSIN, params, batch_stats, x, y,
         y_enc, _ = model.encode(stop(params), batch_stats, y, train=False)
         y_dec, _ = model.decode(stop(params), batch_stats, y_enc.qbar,
                                 train=False)
-        y_syn = synthesize_side_image(
-            x_dec=stop(x_dec), y_img=y, y_dec=stop(y_dec), mask=si_mask,
-            patch_h=ae_cfg.y_patch_size[0], patch_w=ae_cfg.y_patch_size[1],
-            config=ae_cfg)
+        if synthesize_fn is not None:
+            y_syn = synthesize_fn(stop(x_dec), y, stop(y_dec))
+        else:
+            y_syn = synthesize_side_image(
+                x_dec=stop(x_dec), y_img=y, y_dec=stop(y_dec), mask=si_mask,
+                patch_h=ae_cfg.y_patch_size[0],
+                patch_w=ae_cfg.y_patch_size[1], config=ae_cfg)
         x_with_si = model.apply_sinet(params, x_dec, y_syn)
         si_l1 = loss_lib.si_l1_loss(x, x_with_si)
 
@@ -129,7 +138,8 @@ def _scalar_metrics(loss, aux):
 
 
 def build_train_step_fn(model: DSIN, tx: optax.GradientTransformation,
-                        si_mask: Optional[jnp.ndarray] = None):
+                        si_mask: Optional[jnp.ndarray] = None,
+                        synthesize_fn=None):
     """The un-jitted train step (state, x, y) -> (state, metrics) — callers
     wrap it in `jax.jit` (single chip) or jit-with-shardings (mesh)."""
     update_bn = model.ae_config.get("bn_stats", "update") == "update"
@@ -138,7 +148,8 @@ def build_train_step_fn(model: DSIN, tx: optax.GradientTransformation,
         def loss_fn(params):
             return _forward_losses(model, params, state.batch_stats, x, y,
                                    si_mask, train=True,
-                                   collect_mutations=update_bn)
+                                   collect_mutations=update_bn,
+                                   synthesize_fn=synthesize_fn)
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)

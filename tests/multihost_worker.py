@@ -1,0 +1,95 @@
+"""Subprocess worker for tests/test_multihost.py — one simulated host.
+
+Runs as `python multihost_worker.py <pid> <nproc> <port> <out_json>`:
+initializes jax.distributed on CPU (1 local device per process), shards the
+pair manifest with the loader's `host_id::num_hosts` rule, assembles the
+global batch across processes, runs one sharded AE_only train step over the
+global 2-device mesh, and dumps evidence (shard contents, loss, param
+checksum) for the parent to cross-check.
+
+NOT a test module (no `test_` prefix): pytest must not collect it.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)   # exactly 1 local device per process
+
+pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+port, out_json = sys.argv[3], sys.argv[4]
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nproc, process_id=pid)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_train_step import tiny_ae_cfg, tiny_pc_cfg  # noqa: E402
+
+from dsin_tpu.data.loader import PairDataset  # noqa: E402
+from dsin_tpu.models.dsin import DSIN  # noqa: E402
+from dsin_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from dsin_tpu.parallel.data_parallel import make_sharded_train_step  # noqa: E402
+from dsin_tpu.train import optim as optim_lib  # noqa: E402
+from dsin_tpu.train import step as step_lib  # noqa: E402
+
+H, W = 24, 32
+CROP = (16, 24)
+PER_HOST_BATCH = 2
+
+assert jax.process_index() == pid and jax.process_count() == nproc
+assert jax.local_device_count() == 1 and jax.device_count() == nproc
+
+# -- loader shard: the host_id::num_hosts rule over a shared manifest -------
+pairs = [(f"x{i}", f"y{i}") for i in range(8)]
+
+
+def decode(path):
+    i = int(path[1:])
+    val = i if path[0] == "x" else i + 100
+    return np.full((H, W, 3), val % 256, dtype=np.uint8)
+
+
+ds = PairDataset(pairs, CROP, batch_size=PER_HOST_BATCH, train=False,
+                 host_id=jax.process_index(), num_hosts=jax.process_count(),
+                 decode_fn=decode)
+
+# -- one sharded train step over the GLOBAL mesh ----------------------------
+ae_cfg = tiny_ae_cfg(batch_size=PER_HOST_BATCH * nproc, crop_size=CROP)
+pc_cfg = tiny_pc_cfg()
+model = DSIN(ae_cfg, pc_cfg)
+tx = optim_lib.build_optimizer(None, ae_cfg, pc_cfg, num_training_imgs=8)
+state = step_lib.create_train_state(
+    model, jax.random.PRNGKey(0), (PER_HOST_BATCH * nproc,) + CROP + (3,), tx)
+
+mesh = mesh_lib.make_mesh()
+state = mesh_lib.replicate_state(mesh, state)
+train_step = make_sharded_train_step(model, tx, mesh, donate=False)
+
+x, y = next(ds.batches(loop=False))
+xs, ys = mesh_lib.shard_batch(mesh, x, y)
+assert xs.shape == (PER_HOST_BATCH * nproc, CROP[0], CROP[1], 3), xs.shape
+
+state, metrics = train_step(state, xs, ys)
+loss = float(metrics["loss"])
+
+# param checksum over THIS host's addressable replica: must match across
+# hosts (the psum'd gradient keeps replicas identical)
+checksum = 0.0
+for leaf in jax.tree_util.tree_leaves(state.params):
+    local = np.asarray(leaf.addressable_data(0), np.float64)
+    checksum += float(np.sum(np.abs(local)))
+
+with open(out_json, "w") as f:
+    json.dump({"pid": pid, "shard": ds.pairs, "loss": loss,
+               "checksum": checksum,
+               "local_batch_x0": float(np.asarray(x)[0, 0, 0, 0])}, f)
+print(f"worker {pid}: ok loss={loss}", flush=True)

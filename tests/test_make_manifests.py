@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 from dsin_tpu.data.make_manifests import (general_pairs, main, split_pairs,
                                           stereo_pairs, write_manifest)
@@ -61,9 +62,87 @@ def test_cli_roundtrip_with_reader(tmp_path):
     root = str(tmp_path / "kitti")
     out = str(tmp_path / "data_paths")
     _fake_kitti(root)
-    main(["--kitti_root", root, "--out_dir", out, "--mode", "stereo"])
+    main(["--kitti_root", root, "--out_dir", out, "--mode", "stereo",
+          "--split_rule", "random"])
     manifest = os.path.join(out, "KITTI_stereo_train.txt")
     pairs = read_pair_manifest(manifest, root=root)
     assert len(pairs) == 9   # 15 - 3 val - 3 test
     for x, y in pairs:
         assert os.path.exists(x) and os.path.exists(y)
+
+
+def _fake_kitti_standard(root):
+    """Standard-layout KITTI multiview tree: scene_flow 200 training + 200
+    testing sequences, stereo_flow 194 training + 195 testing, frames
+    00..20 per sequence in both cameras (only 10/11 matter to the
+    reference split rule; the rest prove they get ignored)."""
+    layout = {("data_scene_flow_multiview", "training"): 200,
+              ("data_scene_flow_multiview", "testing"): 200,
+              ("data_stereo_flow_multiview", "training"): 194,
+              ("data_stereo_flow_multiview", "testing"): 195}
+    for (subset, split), n_seq in layout.items():
+        for cam in ("image_2", "image_3"):
+            d = os.path.join(root, subset, split, cam)
+            os.makedirs(d, exist_ok=True)
+            for s in range(n_seq):
+                for f in (9, 10, 11, 12):   # neighbors prove frame filter
+                    open(os.path.join(d, f"{s:06d}_{f:02d}.png"),
+                         "wb").close()
+
+
+def test_reference_split_reproduces_frozen_counts(tmp_path):
+    """The 'reference' split rule must reproduce the reference's frozen
+    list structure exactly: 1576/790/790 pairs (reference
+    data_paths/KITTI_stereo_*.txt), train = training-split frames 10+11,
+    val = testing-split frame 11, test = testing-split frame 10."""
+    from dsin_tpu.data.make_manifests import reference_stereo_splits
+    root = str(tmp_path)
+    _fake_kitti_standard(root)
+    splits = reference_stereo_splits(root)
+    assert len(splits["train"]) == 1576   # (200 + 194) seqs x 2 frames
+    assert len(splits["val"]) == 790      # (200 + 195) seqs x frame 11
+    assert len(splits["test"]) == 790     # (200 + 195) seqs x frame 10
+    assert all(x.endswith(("_10.png", "_11.png")) for x, _ in splits["train"])
+    assert all(x.endswith("_11.png") for x, _ in splits["val"])
+    assert all(x.endswith("_10.png") for x, _ in splits["test"])
+    # x/y are the same frame seen by opposite cameras; both directions
+    # appear (the frozen lists double each pair with a swapped block)
+    for split_list in splits.values():
+        for x, y in split_list:
+            cams = {x.split(os.sep)[-2], y.split(os.sep)[-2]}
+            assert cams == {"image_2", "image_3"}
+            assert os.path.basename(x) == os.path.basename(y)
+        n_fwd = sum("image_2" in x.split(os.sep)[-2] for x, _ in split_list)
+        assert n_fwd == len(split_list) // 2
+    # first train entry: lowest subset alphabetically, seq 0, frame 10
+    assert splits["train"][0][0] == os.path.join(
+        "data_scene_flow_multiview", "training", "image_2", "000000_10.png")
+
+
+REFERENCE_DATA_PATHS = "/root/reference/src/data_paths"
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_DATA_PATHS),
+                    reason="reference lists not available")
+def test_reference_split_matches_frozen_lists_exactly(tmp_path):
+    """Line-for-line equality against the reference's actual frozen lists:
+    generate from a fake tree with the standard KITTI layout and compare
+    every line of all three manifests."""
+    from dsin_tpu.data.make_manifests import reference_stereo_splits, \
+        write_manifest
+    root = str(tmp_path / "kitti")
+    _fake_kitti_standard(root)
+    splits = reference_stereo_splits(root)
+    for split in ("train", "val", "test"):
+        out = str(tmp_path / f"KITTI_stereo_{split}.txt")
+        write_manifest(out, splits[split])
+        with open(out) as f:
+            generated = [ln.strip() for ln in f if ln.strip()]
+        ref_path = os.path.join(REFERENCE_DATA_PATHS,
+                                f"KITTI_stereo_{split}.txt")
+        with open(ref_path) as f:
+            frozen = [ln.strip() for ln in f if ln.strip()]
+        first_diff = next(
+            (i for i, (a, b) in enumerate(zip(generated, frozen)) if a != b),
+            f"lengths {len(generated)} vs {len(frozen)}")
+        assert generated == frozen, f"{split}: first diff: {first_diff}"

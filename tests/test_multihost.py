@@ -1,0 +1,67 @@
+"""Multi-host evidence: a REAL 2-process `jax.distributed` run on CPU.
+
+The reference is strictly single-process (SURVEY §2: no distribution of any
+kind); multi-host data parallelism is a new capability of this framework,
+and this test is its proof: two OS processes, each with one local CPU
+device, coordinate through `jax.distributed.initialize`, shard one manifest
+with the loader's `host_id::num_hosts` rule, assemble a global batch with
+`make_array_from_process_local_data`, and take one jitted data-parallel
+train step whose gradient all-reduce crosses the process boundary.
+
+Would fail if: the loader shard rule broke (overlap/gap), shard_batch
+stopped assembling global arrays in multi-process mode, or the cross-process
+psum diverged replicas (checksum mismatch).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_DIR, "multihost_worker.py")
+
+
+def test_two_process_distributed_train_step(tmp_path):
+    outs = [str(tmp_path / f"worker{i}.json") for i in range(2)]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # workers get 1 local device each
+    env["JAX_PLATFORMS"] = "cpu"
+    port = "29653"
+
+    procs = [
+        subprocess.Popen([sys.executable, WORKER, str(i), "2", port, outs[i]],
+                         env=env, cwd=_DIR, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=570)
+        logs.append(out)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
+
+    results = []
+    for path in outs:
+        with open(path) as f:
+            results.append(json.load(f))
+
+    # loader shards partition the manifest exactly: pairs[i::2]
+    all_pairs = [[f"x{i}", f"y{i}"] for i in range(8)]
+    assert results[0]["shard"] == all_pairs[0::2]
+    assert results[1]["shard"] == all_pairs[1::2]
+
+    # the two hosts saw DIFFERENT data (global batch really is assembled
+    # from distinct per-host shards) ...
+    assert results[0]["local_batch_x0"] != results[1]["local_batch_x0"]
+
+    # ... yet computed the SAME global loss and kept replicas identical
+    # through the cross-process gradient all-reduce
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-6)
+    assert results[0]["checksum"] == pytest.approx(results[1]["checksum"],
+                                                   rel=1e-7)
+    import math
+    assert math.isfinite(results[0]["loss"])

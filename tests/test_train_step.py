@@ -204,3 +204,72 @@ def test_training_descends_loss_and_rate():
     # a falling bitrate rather than a specific convergence speed
     assert last < 0.85 * first, (first, last)
     assert np.mean(bpps[-5:]) < np.mean(bpps[:5]), (bpps[:5], bpps[-5:])
+
+
+def _expected_reference_loss(model, state, metrics, train):
+    """Recompute the reference total from its published formulas
+    (reference AE.py:80-99 + Distortions_imgcomp.py:113-146):
+
+        loss = (1 - w)*d_loss_scaled + beta*max(H_soft - H_target, 0)
+               + L2(enc) + L2(dec) + L2(centers) + L2(pc)  [+ w*L1(x, x_si)]
+        [/ batch_size if SI mode and batch > 1 and training]
+
+    with w = 0 in AE_only mode — the reference hard-sets si_weight to 0.0
+    there (reference AE.py:18-21), NOT the config's 0.7."""
+    cfg = model.ae_config
+    w = 0.0 if cfg.AE_only else cfg.si_weight
+
+    # independent L2 recomputation (conv kernels only + centers)
+    def l2_kernels(tree):
+        total = 0.0
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                if k == "kernel":
+                    total += 0.5 * float(np.sum(np.square(np.asarray(v))))
+                else:
+                    total += l2_kernels(v)
+        return total
+
+    p = state.params
+    regs = cfg.regularization_factor * (l2_kernels(p["encoder"]) +
+                                        l2_kernels(p["decoder"]))
+    regs += (cfg.regularization_factor_centers * 0.5 *
+             float(np.sum(np.square(np.asarray(p["centers"])))))
+    # tiny_pc_cfg has regularization_factor = None -> no pc term
+
+    pc_loss = cfg.beta * max(float(metrics["H_soft"]) - cfg.H_target, 0.0)
+    expected = ((1.0 - w) * float(metrics["d_loss"]) + pc_loss + regs
+                + w * float(metrics["si_l1"]))
+    if (not cfg.AE_only) and cfg.batch_size > 1 and train:
+        expected /= float(cfg.batch_size)
+    return expected
+
+
+@pytest.mark.parametrize("ae_only", [True, False])
+@pytest.mark.parametrize("train", [True, False])
+def test_loss_composition_matches_reference(ae_only, train):
+    """Pin the full loss composition against an independent recomputation of
+    the reference formulas, in all four (mode, phase) combinations —
+    including the w=0-when-AE_only rule (reference AE.py:18-21) and the
+    /batch_size rule that applies only to SI training (AE.py:93-99)."""
+    ae_cfg = tiny_ae_cfg(AE_only=ae_only, crop_size=(16, 24))
+    pc_cfg = tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    shape = (2, 16, 24, 3)
+    rng = np.random.default_rng(1)
+    x, y = synthetic_batch(rng, 2, 16, 24)
+
+    tx = optim_lib.build_optimizer(None, ae_cfg, pc_cfg, num_training_imgs=10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        shape, tx)
+    if train:
+        step = step_lib.make_train_step(model, tx, donate=False)
+        _, metrics = step(state, x, y)
+    else:
+        metrics = step_lib.make_eval_step(model)(state, x, y)
+
+    expected = _expected_reference_loss(model, state, metrics, train)
+    assert float(metrics["loss"]) == pytest.approx(expected, rel=1e-5), (
+        f"ae_only={ae_only} train={train}")
+    if ae_only:
+        assert float(metrics["si_l1"]) == 0.0
