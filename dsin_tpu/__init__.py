@@ -19,4 +19,15 @@ Design principles (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
-from dsin_tpu.config import Config, parse_config, parse_config_file  # noqa: F401
+import os as _os
+
+# Package-wide, not per-CLI: some environments install an import hook that
+# overrides `jax_platforms` at jax-import time; re-applying the documented
+# JAX_PLATFORMS env var here covers every dsin_tpu entry point. No-op when
+# the var is unset (does not even import jax).
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+from dsin_tpu.config import Config, parse_config, parse_config_file  # noqa: F401,E402

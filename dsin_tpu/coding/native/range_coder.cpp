@@ -106,15 +106,14 @@ void rans_decoder_free(void* handle) {
   delete static_cast<Decoder*>(handle);
 }
 
-// Batched decode of n symbols that all share one frequency table
-// (cum: scale-sorted cumulative array of length num_syms+1, cum[num_syms] =
-// 1<<scale_bits). Writes symbol indices to out. Used for header-less bulk
-// payloads with static tables; the adaptive path peeks/advances per symbol.
-void rans_decode_static(void* handle, const uint32_t* cum, int num_syms,
-                        long n, int scale_bits, int32_t* out) {
-  Decoder* d = static_cast<Decoder*>(handle);
+// Shared decode loop: n symbols, the i-th resolved against the cumulative
+// table at cums + i*cum_stride (stride 0 = one static table for all;
+// stride num_syms+1 = a fresh adaptive table per symbol).
+static void decode_n(Decoder* d, const uint32_t* cums, long cum_stride,
+                     int num_syms, long n, int scale_bits, int32_t* out) {
   uint32_t mask = (1u << scale_bits) - 1;
   for (long i = 0; i < n; ++i) {
+    const uint32_t* cum = cums + i * cum_stride;
     uint32_t cf = d->state & mask;
     // linear scan: num_syms is small (L=6 centers)
     int s = num_syms - 1;
@@ -130,6 +129,26 @@ void rans_decode_static(void* handle, const uint32_t* cum, int num_syms,
     }
     d->state = static_cast<uint32_t>(x);
   }
+}
+
+// Batched decode of n symbols that all share one frequency table
+// (cum: scale-sorted cumulative array of length num_syms+1, cum[num_syms] =
+// 1<<scale_bits). Writes symbol indices to out. Used for header-less bulk
+// payloads with static tables; the adaptive path peeks/advances per symbol.
+void rans_decode_static(void* handle, const uint32_t* cum, int num_syms,
+                        long n, int scale_bits, int32_t* out) {
+  decode_n(static_cast<Decoder*>(handle), cum, 0, num_syms, n, scale_bits,
+           out);
+}
+
+// Batched decode of n symbols where EVERY symbol has its own frequency
+// table (cums: n rows of num_syms+1 cumulative values, row-major) — the
+// adaptive-model hot path. One call replaces n Python-level peek/advance
+// round trips per wavefront.
+void rans_decode_front(void* handle, const uint32_t* cums, long n,
+                       int num_syms, int scale_bits, int32_t* out) {
+  decode_n(static_cast<Decoder*>(handle), cums, num_syms + 1, num_syms, n,
+           scale_bits, out);
 }
 
 }  // extern "C"

@@ -85,6 +85,10 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib.rans_decode_static.argtypes = [
             ctypes.c_void_p, u32p, ctypes.c_int, ctypes.c_long, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int32)]
+        lib.rans_decode_front.restype = None
+        lib.rans_decode_front.argtypes = [
+            ctypes.c_void_p, u32p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
         return _lib
 
@@ -195,6 +199,23 @@ class Decoder:
         s = int(np.searchsorted(cum, cf, side="right")) - 1
         self.advance(int(cum[s]), int(cum[s + 1] - cum[s]))
         return s
+
+    def decode_front(self, cums: np.ndarray) -> np.ndarray:
+        """Decode one symbol per row of `cums` ((n, L+1) cumulative tables,
+        one fresh adaptive table per symbol) — the wavefront hot path. One
+        native call instead of n peek/advance round trips."""
+        cums = np.ascontiguousarray(cums, dtype=np.uint32)
+        n = cums.shape[0]
+        if self._lib is not None:
+            out = np.empty(n, dtype=np.int32)
+            self._lib.rans_decode_front(
+                self._handle,
+                cums.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                n, cums.shape[1] - 1, self.scale_bits,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return out
+        return np.array([self.decode_symbol(cums[i]) for i in range(n)],
+                        dtype=np.int32)
 
     def decode_static(self, cum: np.ndarray, n: int) -> np.ndarray:
         """Decode n symbols sharing one cumulative table (bulk path)."""

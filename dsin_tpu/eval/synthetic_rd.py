@@ -1,0 +1,115 @@
+"""End-to-end 3-phase RD evidence on the synthetic stereo corpus.
+
+Drives the reference's full workflow (reference AE.py:158-175 +
+main.py:101-126) with no real dataset required:
+
+  phase 1  train AE_only                         -> best-val checkpoint
+  (test)   AE-only inference on the test split   -> RD point without SI
+  phase 2  warm-start AE weights, train +siNet   -> best-val checkpoint
+  (test)   full-SI inference on the test split   -> RD point with SI
+
+and writes `rd_synthetic.json` holding both points (bpp / PSNR / MS-SSIM
+means) plus run metadata. The side-information value proposition is the
+delta between the two points at (nearly) the same bpp.
+
+Usage:
+    python -m dsin_tpu.eval.synthetic_rd --out_root /tmp/rd_run \
+        [--data_dir /tmp/synth] [--phase1_steps N] [--phase2_steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from dsin_tpu.config import parse_config_file
+from dsin_tpu.utils import color_print
+
+
+def run_3phase(ae_config, pc_config, out_root: str,
+               phase1_steps=None, phase2_steps=None,
+               max_test_images=None) -> dict:
+    from dsin_tpu.main import Experiment
+
+    t0 = time.time()
+    results = {"config": "ae_synthetic_stereo",
+               "crop": list(ae_config.crop_size),
+               "eval_crop": list(ae_config.get("eval_crop_size",
+                                               ae_config.crop_size)),
+               "H_target": ae_config.H_target,
+               "target_bpp": ae_config.H_target /
+               (64.0 / ae_config.num_chan_bn)}
+
+    # -- phase 1: AE_only ---------------------------------------------------
+    cfg1 = ae_config.replace(AE_only=True, load_model=False,
+                             train_model=True, test_model=False)
+    exp1 = Experiment(cfg1, pc_config, out_root=out_root)
+    exp1.maybe_restore()
+    color_print(f"phase 1 (AE_only) -> {exp1.model_name}", "cyan", bold=True)
+    r1 = exp1.train(max_steps=phase1_steps)
+    t1 = exp1.test(max_images=max_test_images, save_images=True)
+    results["phase1"] = {"model_name": exp1.model_name, **r1}
+    results["ae_only_test"] = t1
+
+    # -- phase 2: warm-start AE, fresh siNet --------------------------------
+    cfg2 = ae_config.replace(AE_only=False, load_model=True,
+                             load_model_name=exp1.model_name,
+                             load_train_step=False,
+                             train_model=True, test_model=False)
+    exp2 = Experiment(cfg2, pc_config, out_root=out_root)
+    exp2.maybe_restore()
+    color_print(f"phase 2 (+siNet) -> {exp2.model_name}", "cyan", bold=True)
+    r2 = exp2.train(max_steps=phase2_steps)
+    t2 = exp2.test(max_images=max_test_images, save_images=True)
+    results["phase2"] = {"model_name": exp2.model_name, **r2}
+    results["with_si_test"] = t2
+    results["wall_clock_s"] = round(time.time() - t0, 1)
+
+    out_path = os.path.join(out_root, "rd_synthetic.json")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    color_print(f"3-phase RD evidence written to {out_path}", "green",
+                bold=True)
+    return results
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="synthetic 3-phase RD run")
+    base = os.path.join(os.path.dirname(__file__), os.pardir, "configs")
+    p.add_argument("-ae_config",
+                   default=os.path.join(base, "ae_synthetic_stereo"))
+    p.add_argument("-pc_config", default=os.path.join(base, "pc_default"))
+    p.add_argument("--out_root", required=True)
+    p.add_argument("--data_dir", default=None,
+                   help="synthetic corpus dir (generated if missing)")
+    p.add_argument("--phase1_steps", type=int, default=None)
+    p.add_argument("--phase2_steps", type=int, default=None)
+    p.add_argument("--max_test_images", type=int, default=None)
+    args = p.parse_args(argv)
+
+    ae_config = parse_config_file(args.ae_config)
+    pc_config = parse_config_file(args.pc_config)
+    if args.data_dir:
+        ae_config = ae_config.replace(root_data=args.data_dir)
+
+    manifest = os.path.join(ae_config.root_data,
+                            ae_config.file_path_train)
+    if not os.path.exists(manifest):
+        from dsin_tpu.data.synthetic import write_corpus
+        eh, ew = ae_config.get("eval_crop_size", ae_config.crop_size)
+        color_print(f"generating synthetic corpus in {ae_config.root_data}",
+                    "yellow")
+        write_corpus(ae_config.root_data, num_train=40, num_val=8,
+                     num_test=8, height=eh, width=ew)
+
+    os.makedirs(args.out_root, exist_ok=True)
+    run_3phase(ae_config, pc_config, args.out_root,
+               phase1_steps=args.phase1_steps,
+               phase2_steps=args.phase2_steps,
+               max_test_images=args.max_test_images)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,8 @@ import numpy as np
 
 from dsin_tpu.models import quantizer as quantizer_lib
 
-ARCH_PARAM_N = 128  # reference autoencoder_imgcomp.py:211
+ARCH_PARAM_N = 128  # reference autoencoder_imgcomp.py:211 (default; a
+# config may override with `arch_param_N` for reduced-scale corpora)
 
 # KITTI RGB statistics (reference autoencoder_imgcomp.py:160-170)
 KITTI_MEAN = np.array([93.70454143384742, 98.28243432206516,
@@ -136,8 +137,8 @@ class Encoder(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
-        n = ARCH_PARAM_N
         cfg = self.config
+        n = cfg.get("arch_param_N", ARCH_PARAM_N)
         x = normalize_image(x, cfg.normalization)
         x = _ConvBN(n // 2, 5, stride=2)(x, train)
         x = _ConvBN(n, 5, stride=2)(x, train)
@@ -153,8 +154,8 @@ class Decoder(nn.Module):
 
     @nn.compact
     def __call__(self, q, train: bool):
-        n = ARCH_PARAM_N
         cfg = self.config
+        n = cfg.get("arch_param_N", ARCH_PARAM_N)
         x = _ConvBN(n, 3, stride=2, transpose=True)(q, train)
         x = _ResGroupStack(n, cfg.arch_param_B)(x, train)
         x = _ConvBN(n // 2, 5, stride=2, transpose=True)(x, train)

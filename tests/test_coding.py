@@ -231,3 +231,25 @@ def test_codec_batch_nhwc(tiny_codec):
     assert len(streams) == 2
     out = codec_lib.decode_batch(codec, streams)
     np.testing.assert_array_equal(out, symbols)
+
+
+def test_decode_front_matches_decode_symbol():
+    """The batched per-front decode (one native call, one fresh cumulative
+    table per symbol) must consume the stream exactly like n sequential
+    decode_symbol calls."""
+    rng = np.random.default_rng(7)
+    n, L, scale_bits = 200, 6, 12
+    freqs = np.array([rans.quantize_pmf(rng.dirichlet(np.ones(L)), scale_bits)
+                      for _ in range(n)], dtype=np.uint32)
+    cums = rans.cum_from_freqs_batch(freqs)
+    syms = rng.integers(0, L, n)
+    starts = cums[np.arange(n), syms].astype(np.uint32)
+    fr = freqs[np.arange(n), syms].astype(np.uint32)
+    stream = rans.encode(starts, fr, scale_bits)
+
+    with rans.Decoder(stream, scale_bits) as dec:
+        out_front = dec.decode_front(cums)
+    with rans.Decoder(stream, scale_bits) as dec:
+        out_seq = np.array([dec.decode_symbol(cums[i]) for i in range(n)])
+    np.testing.assert_array_equal(out_front, out_seq)
+    np.testing.assert_array_equal(out_front, syms)
