@@ -96,6 +96,7 @@ def _init_backend_with_retry(jax, attempts=3, backoff_s=10.0):
     (round-1 BENCH died in backend init before any fallback could run)."""
     for i in range(attempts):
         try:
+            stage(f"initializing backend (attempt {i + 1}/{attempts})")
             devices = jax.devices()
             stage("backend up", f": {jax.default_backend()} {devices}")
             return devices

@@ -63,8 +63,7 @@ def _load_model_state(ae_config_path: str, pc_config_path: str,
 
 def _make_codec(model, state):
     from dsin_tpu.coding.codec import BottleneckCodec
-    return BottleneckCodec(model.probclass, state.params["probclass"],
-                           state.params["centers"], model.pc_config)
+    return BottleneckCodec.for_model(model, state.params)
 
 
 def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,

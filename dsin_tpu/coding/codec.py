@@ -77,6 +77,15 @@ class BottleneckCodec:
         For kernel_size / use_centers_for_padding.
     """
 
+    @classmethod
+    def for_model(cls, model, params,
+                  scale_bits: int = rans.DEFAULT_SCALE_BITS):
+        """Build from a DSIN model bundle + its params tree — the one
+        construction every call site (CLI, test-time real_bpp) shares, so
+        the probclass/centers partition wiring cannot drift."""
+        return cls(model.probclass, params["probclass"], params["centers"],
+                   model.pc_config, scale_bits=scale_bits)
+
     def __init__(self, probclass_model, pc_params, centers, pc_config,
                  scale_bits: int = rans.DEFAULT_SCALE_BITS):
         self.model = probclass_model

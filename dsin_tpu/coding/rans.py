@@ -60,13 +60,26 @@ def _load_native() -> Optional[ctypes.CDLL]:
         if _lib_tried:
             return _lib
         _lib_tried = True
-        path = _compile_native()
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
-            return None
+        lib = _load_and_bind()
+        if lib is None and os.path.exists(_LIB_PATH):
+            # a stale prebuilt .so (restored cache / copied tree with newer
+            # mtimes) can pass the mtime check yet miss newer symbols —
+            # rebuild once from source before giving up on the native path
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                return None
+            lib = _load_and_bind()
+        _lib = lib
+        return _lib
+
+
+def _load_and_bind() -> Optional[ctypes.CDLL]:
+    path = _compile_native()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         lib.rans_encode.restype = ctypes.c_long
@@ -89,8 +102,11 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib.rans_decode_front.argtypes = [
             ctypes.c_void_p, u32p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int32)]
-        _lib = lib
-        return _lib
+        return lib
+    except (OSError, AttributeError):
+        # OSError: dlopen failure; AttributeError: the .so predates a
+        # symbol — caller may retry after a forced rebuild
+        return None
 
 
 def native_available() -> bool:

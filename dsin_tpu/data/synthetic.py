@@ -72,7 +72,11 @@ def make_stereo_pair(rng: np.random.Generator, height: int, width: int,
         oh = int(rng.integers(height // 6, height // 2))
         ow = int(rng.integers(width // 8, width // 3))
         top = int(rng.integers(0, height - oh))
-        lft = int(rng.integers(int(disp), width - ow))
+        # narrow images: a disparity can exceed the placeable range
+        # (rng.integers needs low < high) — clamp to keep the object and
+        # its shifted twin inside both views
+        disp = min(int(disp), width - ow - 1)
+        lft = int(rng.integers(disp, width - ow))
         tex = _smooth_texture(rng, oh, ow, cells=4)
         left[top:top + oh, lft:lft + ow] = tex
         right[top:top + oh, lft - disp:lft - disp + ow] = tex

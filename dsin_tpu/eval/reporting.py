@@ -89,7 +89,8 @@ class ScoreLists:
     downstream RD-curve tooling consumes.
     """
 
-    METRICS = ("bpp", "l1", "psnr", "ms_ssim", "mse_x_ysyn", "pearson_x_ysyn")
+    METRICS = ("bpp", "real_bpp", "l1", "psnr", "ms_ssim",
+               "mse_x_ysyn", "pearson_x_ysyn")
 
     def __init__(self, out_dir: str, model_name: str):
         self.out_dir = out_dir
@@ -99,14 +100,20 @@ class ScoreLists:
 
     def add_image(self, x: np.ndarray, x_out: np.ndarray, bpp: float,
                   y_syn: Optional[np.ndarray] = None,
-                  patch_size: Optional[Sequence[int]] = None) -> Dict[str, float]:
-        """Score one test image; returns this image's metrics."""
+                  patch_size: Optional[Sequence[int]] = None,
+                  real_bpp: Optional[float] = None) -> Dict[str, float]:
+        """Score one test image; returns this image's metrics. `bpp` is the
+        cross-entropy estimate (all the reference ever reports); `real_bpp`,
+        when provided, is the measured size of an ACTUAL encoded bitstream
+        (dsin_tpu.coding) — the capability the reference stubbed."""
         scores = {
             "bpp": float(bpp),
             "l1": l1_np(x, x_out),
             "psnr": psnr_np(x, x_out),
             "ms_ssim": multiscale_ssim_np(x, x_out),
         }
+        if real_bpp is not None:
+            scores["real_bpp"] = float(real_bpp)
         if y_syn is not None:
             scores["mse_x_ysyn"] = mse_np(x, y_syn)
             if patch_size is not None:

@@ -62,7 +62,8 @@ def run_3phase(ae_config, pc_config, out_root: str,
     exp2.maybe_restore()
     color_print(f"phase 2 (+siNet) -> {exp2.model_name}", "cyan", bold=True)
     r2 = exp2.train(max_steps=phase2_steps)
-    t2 = exp2.test(max_images=max_test_images, save_images=True)
+    t2 = exp2.test(max_images=max_test_images, save_images=True,
+                   real_bpp=True)
     results["phase2"] = {"model_name": exp2.model_name, **r2}
     results["with_si_test"] = t2
     results["wall_clock_s"] = round(time.time() - t0, 1)

@@ -292,14 +292,27 @@ class Experiment:
 
     # -- test ---------------------------------------------------------------
 
+    def _bottleneck_codec(self):
+        """BottleneckCodec over the trained context model + centers — for
+        measured-bitstream bpp at test time (the reference's `--real_bpp`
+        hooks are vestigial, reference probclass_imgcomp.py:361-364; here
+        they work)."""
+        from dsin_tpu.coding.codec import BottleneckCodec
+        return BottleneckCodec.for_model(self.model,
+                                         jax.device_get(self.state.params))
+
     def test(self, max_images: Optional[int] = None,
              save_images: bool = True,
-             save_plots: bool = False) -> Dict[str, float]:
+             save_plots: bool = False,
+             real_bpp: bool = False) -> Dict[str, float]:
         """Test-split inference: reconstruction PNGs + per-image score lists
-        (reference main.py:101-126)."""
+        (reference main.py:101-126). `real_bpp=True` additionally ENCODES
+        each bottleneck with the rANS codec and reports the actual
+        bitstream's bits/pixel next to the cross-entropy estimate."""
         from dsin_tpu.eval import ScoreLists, image_output_path, save_image
         cfg = self.ae_config
         lists = ScoreLists(self.images_dir, self.model_name)
+        codec = self._bottleneck_codec() if real_bpp else None
         for idx, (x, y) in enumerate(
                 self._dataset("test", train=False).batches(loop=False)):
             if max_images is not None and idx >= max_images:
@@ -312,8 +325,14 @@ class Experiment:
             y_syn = (np.clip(np.asarray(out["y_syn"])[0], 0, 255)
                      if out["y_syn"] is not None else None)
             bpp = float(out["bpp"])
+            measured = None
+            if codec is not None:
+                syms = np.transpose(np.asarray(out["symbols"])[0], (2, 0, 1))
+                stream = codec.encode(syms)
+                measured = len(stream) * 8.0 / (x_np.shape[0] * x_np.shape[1])
             scores = lists.add_image(x_np, xsi, bpp=bpp, y_syn=y_syn,
-                                     patch_size=cfg.y_patch_size)
+                                     patch_size=cfg.y_patch_size,
+                                     real_bpp=measured)
             if save_images:
                 save_image(xsi, image_output_path(self.images_dir, idx, bpp))
             if save_plots:
@@ -336,7 +355,8 @@ def run(ae_config: Config, pc_config: Config, out_root: str = ".",
         max_steps: Optional[int] = None,
         max_val_batches: Optional[int] = None,
         max_test_images: Optional[int] = None,
-        profile_dir: Optional[str] = None) -> Dict[str, float]:
+        profile_dir: Optional[str] = None,
+        real_bpp: bool = False) -> Dict[str, float]:
     """Config-driven orchestration (reference main.py:21-126)."""
     exp = Experiment(ae_config, pc_config, out_root=out_root)
     exp.maybe_restore()
@@ -346,7 +366,8 @@ def run(ae_config: Config, pc_config: Config, out_root: str = ".",
                                  max_val_batches=max_val_batches,
                                  profile_dir=profile_dir))
     if ae_config.test_model:
-        results.update(exp.test(max_images=max_test_images))
+        results.update(exp.test(max_images=max_test_images,
+                                real_bpp=real_bpp))
     return results
 
 
@@ -360,6 +381,10 @@ def parse_args(argv=None):
                    help="override ae config root_data")
     p.add_argument("--max_steps", type=int, default=None)
     p.add_argument("--max_test_images", type=int, default=None)
+    p.add_argument("--real_bpp", action="store_true",
+                   help="at test time, also ENCODE each bottleneck with the "
+                        "rANS codec and report measured bitstream bpp (the "
+                        "reference's vestigial --real_bpp, working)")
     p.add_argument("--profile_dir", default=None,
                    help="capture an XLA trace of a few warm train steps")
     p.add_argument("--distributed", action="store_true",
@@ -380,7 +405,8 @@ def main(argv=None) -> None:
     results = run(ae_config, pc_config, out_root=args.out_root,
                   max_steps=args.max_steps,
                   max_test_images=args.max_test_images,
-                  profile_dir=args.profile_dir)
+                  profile_dir=args.profile_dir,
+                  real_bpp=args.real_bpp)
     color_print(f"done: {results}", "green", bold=True)
 
 

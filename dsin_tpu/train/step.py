@@ -110,6 +110,7 @@ def _forward_losses(model: DSIN, params, batch_stats, x, y,
         loss = loss / float(ae_cfg.batch_size)
 
     aux = {
+        "symbols": enc_out.symbols,
         "bpp": bpp,
         "H_real": rate.H_real,
         "H_soft": rate.H_soft,
@@ -205,6 +206,7 @@ def make_inference_step(model: DSIN, si_mask: Optional[jnp.ndarray] = None):
                                     collect_mutations=False)
         return {"x_dec": aux["x_dec"], "x_with_si": aux["x_with_si"],
                 "y_syn": aux["y_syn"], "bpp": aux["bpp"], "loss": loss,
-                "psnr": aux["psnr"], "mae": aux["mae"]}
+                "psnr": aux["psnr"], "mae": aux["mae"],
+                "symbols": aux["symbols"]}
 
     return jax.jit(infer)

@@ -76,10 +76,11 @@ def test_score_lists_accumulate_save_load(tmp_path):
     x_out = np.clip(x + rng.normal(0, 6, x.shape), 0, 255).astype(np.float32)
     y_syn = np.clip(x + rng.normal(0, 30, x.shape), 0, 255).astype(np.float32)
 
-    s1 = lists.add_image(x, x_out, bpp=0.02, y_syn=y_syn, patch_size=(20, 24))
+    s1 = lists.add_image(x, x_out, bpp=0.02, y_syn=y_syn, patch_size=(20, 24),
+                         real_bpp=0.021)
     s2 = lists.add_image(x, x_out, bpp=0.03)
     assert set(s1) == set(ScoreLists.METRICS)
-    assert "mse_x_ysyn" not in s2
+    assert "mse_x_ysyn" not in s2 and "real_bpp" not in s2
     lists.save()
 
     bpps = ScoreLists.load_list(out, "bpp", "modelA")

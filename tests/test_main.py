@@ -239,3 +239,82 @@ def test_resume_continues_iteration_numbering(tmp_path):
     r2 = exp2.train(max_steps=4, max_val_batches=1)
     assert r2["steps"] == 2  # only steps 2..4, not a restart from 0
     assert int(exp2.state.step) == 4
+
+
+def test_emergency_checkpoint_on_keyboard_interrupt(tmp_path):
+    """Ctrl-C / SIGINT preemption (how long TPU runs usually die) must hit
+    the emergency save too — the handler catches BaseException, not just
+    Exception, and re-raises."""
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+
+    exp = Experiment(ae, pc, out_root=out)
+    calls = {"n": 0}
+    real_step = exp.train_step
+
+    def interrupted_step(state, x, y):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise KeyboardInterrupt
+        return real_step(state, x, y)
+
+    exp.train_step = interrupted_step
+    with pytest.raises(KeyboardInterrupt):
+        exp.train(max_steps=4, max_val_batches=1)
+    from dsin_tpu.train.checkpoint import load_meta
+    meta = load_meta(os.path.join(exp.ckpt_dir, "emergency"))
+    assert meta["kind"] == "emergency" and meta["step"] == 1
+
+
+@pytest.mark.slow
+def test_resume_seeds_best_val_from_checkpoint(tmp_path):
+    """A true resume must not treat its first validation as an automatic
+    improvement: best_val starts from the checkpoint's recorded value, so a
+    regressed val loss does not overwrite the best checkpoint."""
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+    ae = ae.replace(validate_every=1)
+
+    exp = Experiment(ae, pc, out_root=out)
+    exp.maybe_restore()
+    r1 = exp.train(max_steps=1, max_val_batches=1)
+    recorded = r1["best_val"]
+    assert recorded != float("inf")
+
+    ae2 = ae.replace(load_model=True, load_model_name=exp.model_name,
+                     load_train_step=True)
+    exp2 = Experiment(ae2, pc, out_root=out)
+    exp2.maybe_restore()
+    assert exp2.restored_best_val == pytest.approx(recorded)
+
+    # a phase switch (no load_train_step) must NOT inherit best_val —
+    # the loss composition changes, the values are incomparable
+    ae3 = ae.replace(load_model=True, load_model_name=exp.model_name,
+                     load_train_step=False)
+    exp3 = Experiment(ae3, pc, out_root=out)
+    exp3.maybe_restore()
+    assert exp3.restored_best_val == float("inf")
+
+
+def test_real_bpp_measured_bitstream_at_test_time(tmp_path):
+    """test(real_bpp=True) encodes each bottleneck with the rANS codec and
+    reports the ACTUAL bitstream's bits/pixel: present, finite, and close
+    to (never far below) the cross-entropy estimate — a real stream can't
+    beat its own model's entropy by much more than quantization slack."""
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+
+    exp = Experiment(ae, pc, out_root=out)
+    exp.train(max_steps=1, max_val_batches=1)
+    means = exp.test(max_images=1, save_images=False, real_bpp=True)
+    assert "real_bpp" in means and np.isfinite(means["real_bpp"])
+    assert means["real_bpp"] > 0
+    # estimate and measurement agree to coding overhead (+ header/flush
+    # on a tiny image); generous bound, catches unit mistakes (x8, /8...)
+    assert 0.5 * means["bpp"] < means["real_bpp"] < 3.0 * means["bpp"] + 0.1
