@@ -133,9 +133,12 @@ def run():
 
     base = os.path.join(os.path.dirname(__file__), "dsin_tpu", "configs")
     ae_cfg = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
+    # BENCH_DTYPE: conv compute dtype ('float32' = reference numerics,
+    # 'bfloat16' = MXU fast path; params/BN/losses stay f32 either way)
+    compute_dtype = os.environ.get("BENCH_DTYPE", "float32")
     ae_cfg = ae_cfg.replace(batch_size=BATCH, crop_size=(CROP_H, CROP_W),
                             AE_only=False, load_model=False, train_model=True,
-                            test_model=False)
+                            test_model=False, compute_dtype=compute_dtype)
     pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
 
     shape = (BATCH, CROP_H, CROP_W, 3)
@@ -225,6 +228,7 @@ def run():
                 "impl": used_impl,
                 "batch": BATCH,
                 "step_ms": round(step_ms, 2),
+                "compute_dtype": compute_dtype,
             }
             if compile_s is not None:
                 payload["compile_s"] = round(compile_s, 1)

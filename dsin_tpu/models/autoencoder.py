@@ -19,7 +19,7 @@ Layout is NHWC (TPU native); the reference's NCHW is a GPU-era choice.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -78,19 +78,27 @@ _BN_KW = dict(momentum=0.9, epsilon=1e-5, use_scale=True, use_bias=True)
 
 
 class _ConvBN(nn.Module):
-    """Conv + batch norm (+ optional relu), slim-arg_scope style."""
+    """Conv + batch norm (+ optional relu), slim-arg_scope style.
+
+    `dtype` is the conv COMPUTE dtype (TPU mixed precision: bfloat16 puts
+    the matmul-conv on the MXU fast path). Params stay float32
+    (param_dtype default), and BatchNorm's type promotion (bf16 input +
+    f32 scale/bias -> f32) returns the activation to float32, so
+    statistics, residual adds, and everything downstream of each conv are
+    full precision — only the conv itself drops to bf16."""
     features: int
     kernel: int
     stride: int = 1
     relu: bool = True
     transpose: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         conv_cls = nn.ConvTranspose if self.transpose else nn.Conv
         x = conv_cls(self.features, (self.kernel, self.kernel),
                      strides=(self.stride, self.stride), padding="SAME",
-                     use_bias=False,
+                     use_bias=False, dtype=self.dtype,
                      kernel_init=nn.initializers.xavier_uniform())(x)
         x = nn.BatchNorm(use_running_average=not train, **_BN_KW)(x)
         if self.relu:
@@ -103,12 +111,14 @@ class _ResBlock(nn.Module):
     residual add (reference autoencoder_imgcomp.py:275-288)."""
     features: int
     relu_first: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
         inp = x
-        x = _ConvBN(self.features, 3, relu=self.relu_first)(x, train)
-        x = _ConvBN(self.features, 3, relu=False)(x, train)
+        x = _ConvBN(self.features, 3, relu=self.relu_first,
+                    dtype=self.dtype)(x, train)
+        x = _ConvBN(self.features, 3, relu=False, dtype=self.dtype)(x, train)
         return x + inp
 
 
@@ -118,6 +128,7 @@ class _ResGroupStack(nn.Module):
     (reference autoencoder_imgcomp.py:226-235, 253-263)."""
     features: int
     num_groups: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -125,9 +136,10 @@ class _ResGroupStack(nn.Module):
         for _ in range(self.num_groups):
             inner = x
             for _ in range(3):
-                x = _ResBlock(self.features)(x, train)
+                x = _ResBlock(self.features, dtype=self.dtype)(x, train)
             x = x + inner
-        x = _ResBlock(self.features, relu_first=False)(x, train)
+        x = _ResBlock(self.features, relu_first=False,
+                      dtype=self.dtype)(x, train)
         return x + outer
 
 
@@ -139,12 +151,13 @@ class Encoder(nn.Module):
     def __call__(self, x, train: bool):
         cfg = self.config
         n = cfg.get("arch_param_N", ARCH_PARAM_N)
+        dt = jnp.dtype(cfg.get("compute_dtype", "float32"))
         x = normalize_image(x, cfg.normalization)
-        x = _ConvBN(n // 2, 5, stride=2)(x, train)
-        x = _ConvBN(n, 5, stride=2)(x, train)
-        x = _ResGroupStack(n, cfg.arch_param_B)(x, train)
+        x = _ConvBN(n // 2, 5, stride=2, dtype=dt)(x, train)
+        x = _ConvBN(n, 5, stride=2, dtype=dt)(x, train)
+        x = _ResGroupStack(n, cfg.arch_param_B, dtype=dt)(x, train)
         c_out = cfg.num_chan_bn + 1 if cfg.heatmap else cfg.num_chan_bn
-        x = _ConvBN(c_out, 5, stride=2, relu=False)(x, train)
+        x = _ConvBN(c_out, 5, stride=2, relu=False, dtype=dt)(x, train)
         return x
 
 
@@ -156,10 +169,13 @@ class Decoder(nn.Module):
     def __call__(self, q, train: bool):
         cfg = self.config
         n = cfg.get("arch_param_N", ARCH_PARAM_N)
-        x = _ConvBN(n, 3, stride=2, transpose=True)(q, train)
-        x = _ResGroupStack(n, cfg.arch_param_B)(x, train)
-        x = _ConvBN(n // 2, 5, stride=2, transpose=True)(x, train)
-        x = _ConvBN(3, 5, stride=2, transpose=True, relu=False)(x, train)
+        dt = jnp.dtype(cfg.get("compute_dtype", "float32"))
+        x = _ConvBN(n, 3, stride=2, transpose=True, dtype=dt)(q, train)
+        x = _ResGroupStack(n, cfg.arch_param_B, dtype=dt)(x, train)
+        x = _ConvBN(n // 2, 5, stride=2, transpose=True, dtype=dt)(x, train)
+        x = _ConvBN(3, 5, stride=2, transpose=True, relu=False,
+                    dtype=dt)(x, train)
+        x = jnp.asarray(x, jnp.float32)
         x = denormalize_image(x, cfg.normalization)
         return jnp.clip(x, 0.0, 255.0)
 

@@ -44,7 +44,8 @@ class DSIN:
         self.si_weight = 0.0 if self.ae_only else ae_config.si_weight
         if not self.ae_only:
             from dsin_tpu.models.sinet import SiNet
-            self.sinet = SiNet()
+            self.sinet = SiNet(
+                dtype=jnp.dtype(ae_config.get("compute_dtype", "float32")))
         else:
             self.sinet = None
 

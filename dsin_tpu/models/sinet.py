@@ -12,6 +12,8 @@ NHWC layout; dilated 3x3 convs lower to efficient XLA window ops on TPU.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -31,9 +33,13 @@ def identity_kernel_init(key, shape, dtype=jnp.float32):
 
 
 class SiNet(nn.Module):
-    """(N, H, W, 6) normalized concat -> (N, H, W, 3) normalized output."""
+    """(N, H, W, 6) normalized concat -> (N, H, W, 3) normalized output.
+
+    `dtype`: conv compute dtype (bfloat16 = TPU MXU fast path); params
+    stay float32 and the output is returned in float32."""
     features: int = 32
     out_features: int = 3
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -41,9 +47,11 @@ class SiNet(nn.Module):
             x = nn.Conv(self.features, (3, 3), padding="SAME",
                         kernel_dilation=(rate, rate),
                         kernel_init=identity_kernel_init,
+                        dtype=self.dtype,
                         name=f"g_conv{i + 1}")(x)
             x = nn.leaky_relu(x, negative_slope=0.2)
         x = nn.Conv(self.out_features, (1, 1), padding="SAME",
                     kernel_init=nn.initializers.xavier_uniform(),
+                    dtype=self.dtype,
                     name="g_conv_last")(x)
-        return x
+        return jnp.asarray(x, jnp.float32)

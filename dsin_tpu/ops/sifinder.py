@@ -108,18 +108,27 @@ def _window_sums(img: jnp.ndarray, win_h: int, win_w: int):
     return pool(img), pool(img * img)
 
 
-def _correlate(patches: jnp.ndarray, image: jnp.ndarray) -> jnp.ndarray:
+def _correlate(patches: jnp.ndarray, image: jnp.ndarray,
+               conv_dtype=None) -> jnp.ndarray:
     """conv(image, patches-as-filters), VALID.
-    patches: (P, ph, pw, C); image: (H, W, C) -> (H-ph+1, W-pw+1, P)."""
+    patches: (P, ph, pw, C); image: (H, W, C) -> (H-ph+1, W-pw+1, P).
+    `conv_dtype` (e.g. bfloat16) casts the operands of this one conv — the
+    search's dominant MXU matmul — and returns float32 scores."""
     filters = jnp.transpose(patches, (1, 2, 3, 0))  # HWIO
+    img = image[None]
+    if conv_dtype is not None:
+        filters = filters.astype(conv_dtype)
+        img = img.astype(conv_dtype)
     out = jax.lax.conv_general_dilated(
-        image[None], filters, window_strides=(1, 1), padding="VALID",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    return out[0]
+        img, filters, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return out[0].astype(jnp.float32)
 
 
 def match_scores(x_patches: jnp.ndarray, y_image: jnp.ndarray,
-                 use_l2: bool, eps: float = 1e-12) -> jnp.ndarray:
+                 use_l2: bool, eps: float = 1e-12,
+                 conv_dtype=None) -> jnp.ndarray:
     """Score map of every x-patch against every y position.
 
     x_patches: (P, ph, pw, C) transformed patches; y_image: (H, W, C)
@@ -131,7 +140,12 @@ def match_scores(x_patches: jnp.ndarray, y_image: jnp.ndarray,
     sum_y, sum_y2 = _window_sums(y_image, ph, pw)
 
     if use_l2:
-        xy = _correlate(x_patches, y_image)
+        # conv_dtype deliberately NOT honored here: the conv-form distance
+        # |x|^2 - 2<x,y> + |y|^2 is already cancellation-limited in f32
+        # (terms ~1e9, true near-match distances ~0 — see search_single);
+        # bf16-rounded <x,y> would inject ~1e6-scale error and make argmin
+        # arbitrary. The reduced-precision knob is Pearson-only.
+        xy = _correlate(x_patches, y_image, None)
         sum_x2 = jnp.sum(x_patches * x_patches, axis=(1, 2, 3))  # (P,)
         return sum_x2[None, None, :] - 2.0 * xy + (sum_y2 - 0.0)[..., None]
 
@@ -140,10 +154,18 @@ def match_scores(x_patches: jnp.ndarray, y_image: jnp.ndarray,
     xc = x_patches - mean_x
     norm_x = jnp.sqrt(jnp.sum(xc * xc, axis=(1, 2, 3), keepdims=True) + eps)
     xn = xc / norm_x                                         # (P, ph, pw, C)
-    num = _correlate(xn, y_image)                            # <y_w, x̂>
+    num = _correlate(xn, y_image, conv_dtype)                # <y_w, x̂>
     var_y = sum_y2 - (sum_y * sum_y) / patch_size            # ||y_w - mean||^2
     denom = jnp.sqrt(jnp.maximum(var_y, 0.0) + eps)
     return num / denom[..., None]
+
+
+def sifinder_conv_dtype(config, default=None):
+    """The ONE reading of the `sifinder_dtype` knob, shared by every
+    dispatch path: missing or None -> `default` (XLA: None = f32 status
+    quo; Pallas: bfloat16), else the named dtype."""
+    val = getattr(config, "sifinder_dtype", None)
+    return jnp.dtype(val) if val is not None else default
 
 
 def find_matches(score_map: jnp.ndarray, use_l2: bool):
@@ -166,14 +188,14 @@ def gather_patches(y_image: jnp.ndarray, rows: jnp.ndarray, cols: jnp.ndarray,
 
 def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
                   mask: Optional[jnp.ndarray], patch_h: int, patch_w: int,
-                  use_l2: bool) -> SearchResult:
+                  use_l2: bool, conv_dtype=None) -> SearchResult:
     """Full search for one image pair (all tensors HWC)."""
     h, w, _ = x_dec.shape
     x_patches = extract_patches(x_dec, patch_h, patch_w)   # (P, ph, pw, 3)
     q = color_lib.search_transform(x_patches, use_l2)
     r = color_lib.search_transform(y_dec, use_l2)
 
-    scores = match_scores(q, r, use_l2)
+    scores = match_scores(q, r, use_l2, conv_dtype=conv_dtype)
     if use_l2:
         # the conv-form distance |x|^2 - 2<x,y> + |y|^2 cancels
         # catastrophically in float32 at near-matches (terms ~1e9, true
@@ -252,11 +274,15 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
                         "gaussian_position_mask (the kernel streams it in "
                         "separable form); pass mask=None or use "
                         "sifinder_impl='xla' for a custom mask")
-        dtype = jnp.dtype(getattr(config, "sifinder_dtype", "bfloat16"))
+        dtype = sifinder_conv_dtype(config, jnp.dtype("bfloat16"))
         return sifinder_pallas.fused_synthesize_side_image(
             x_dec, y_img, y_dec, jnp.asarray(gh), jnp.asarray(gw),
             patch_h, patch_w, compute_dtype=dtype,
             interpret=(impl == "pallas_interpret"))
+    # optional reduced-precision correlation conv on the XLA path too
+    # (same knob as the Pallas path via sifinder_conv_dtype); None/missing
+    # = float32 status quo. Pearson-only — see match_scores.
     fn = partial(search_single, mask=mask, patch_h=patch_h, patch_w=patch_w,
-                 use_l2=use_l2)
+                 use_l2=use_l2,
+                 conv_dtype=sifinder_conv_dtype(config))
     return jax.vmap(lambda a, b, c: fn(a, b, c).y_syn)(x_dec, y_img, y_dec)

@@ -72,9 +72,11 @@ def make_spatial_train_step(model: DSIN, tx: optax.GradientTransformation,
     assert not model.ae_only, (
         "spatial training is the SI path; AE_only needs no hand-sharded "
         "search — use make_sharded_train_step (GSPMD shards its convs)")
+    from dsin_tpu.ops.sifinder import sifinder_conv_dtype
     ph, pw = cfg.y_patch_size
     syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w,
-                                 use_mask=bool(cfg.use_gauss_mask))
+                                 use_mask=bool(cfg.use_gauss_mask),
+                                 conv_dtype=sifinder_conv_dtype(cfg))
     fn = step_lib.build_train_step_fn(model, tx, si_mask=None,
                                       synthesize_fn=syn)
     repl = mesh_lib.replicated(mesh)

@@ -62,7 +62,7 @@ def _halo_from_right(z: jnp.ndarray, halo: int, axis_name: str):
 
 
 def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
-                  eps=1e-12):
+                  eps=1e-12, conv_dtype=None):
     """Per-shard search for ONE pair. x_dec (H, W, 3) replicated;
     y_img/y_dec (H, Wl, 3) width shards. Returns y_syn (H, W, 3)."""
     axis = SPATIAL_AXIS
@@ -79,7 +79,8 @@ def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
     q = color_lib.search_transform(x_patches, False)
     r_img = color_lib.search_transform(y_dec_h, False)
 
-    scores = sifinder.match_scores(q, r_img, use_l2=False, eps=eps)
+    scores = sifinder.match_scores(q, r_img, use_l2=False, eps=eps,
+                                   conv_dtype=conv_dtype)
     # scores: (Hc, Wl, P) — local slice of the global map's columns
     hc, wl, p_count = scores.shape
 
@@ -121,11 +122,19 @@ def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
 
 
 def build_synthesize_shmap(mesh, patch_h: int, patch_w: int,
-                           img_h: int, img_w: int, use_mask: bool = True):
+                           img_h: int, img_w: int, use_mask: bool = True,
+                           conv_dtype=None):
     """Un-jitted shard_map'd (x_dec, y_img, y_dec) -> y_syn for composing
     into larger jitted programs (e.g. the spatial inference step). Inputs
     are interpreted as: batch over 'data', y width over 'spatial', x_dec
-    replicated over 'spatial'; output replicated over 'spatial'."""
+    replicated over 'spatial'; output replicated over 'spatial'.
+
+    `conv_dtype` must match the unsharded path's `sifinder_dtype` reading
+    (pass `sifinder.sifinder_conv_dtype(config)`): the bit-parity contract
+    with the unsharded search holds at float32 (conv_dtype None); with a
+    reduced-precision conv both paths use the same dtype but halo
+    partitioning changes the conv's reduction order, so near-tie argmax
+    winners may differ at bf16."""
     hc, wc = img_h - patch_h + 1, img_w - patch_w + 1
     p_count = (img_h // patch_h) * (img_w // patch_w)
     if use_mask:
@@ -151,7 +160,7 @@ def build_synthesize_shmap(mesh, patch_h: int, patch_w: int,
 
     def per_shard(x_dec, y_img, y_dec, gh_, gw_):
         fn = partial(_local_search, gh=gh_, gw=gw_, patch_h=patch_h,
-                     patch_w=patch_w, img_w=img_w)
+                     patch_w=patch_w, img_w=img_w, conv_dtype=conv_dtype)
         return jax.vmap(fn)(x_dec, y_img, y_dec)
 
     shmap = jax.shard_map(
@@ -204,7 +213,8 @@ def make_spatial_inference_step(model, mesh, img_h: int, img_w: int):
         "no siNet — use step.make_inference_step")
     ph, pw = cfg.y_patch_size
     use_mask = bool(cfg.use_gauss_mask)
-    syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w, use_mask)
+    syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w, use_mask,
+                                 conv_dtype=sifinder.sifinder_conv_dtype(cfg))
 
     repl = NamedSharding(mesh, P())
     img_sh = NamedSharding(mesh, P(DATA_AXIS, None, SPATIAL_AXIS, None))

@@ -273,3 +273,45 @@ def test_loss_composition_matches_reference(ae_only, train):
         f"ae_only={ae_only} train={train}")
     if ae_only:
         assert float(metrics["si_l1"]) == 0.0
+
+
+def test_bfloat16_compute_parity_and_descent():
+    """Mixed precision (compute_dtype='bfloat16'): conv matmuls in bf16,
+    params/BN/losses in f32. Same params must produce a CLOSE forward (bf16
+    conv rounding only) and training must still descend."""
+    ae32 = tiny_ae_cfg(AE_only=False, crop_size=(16, 24))
+    ae16 = ae32.replace(compute_dtype="bfloat16")
+    pc = tiny_pc_cfg()
+    m32, m16 = DSIN(ae32, pc), DSIN(ae16, pc)
+    shape = (2, 16, 24, 3)
+    rng = np.random.default_rng(3)
+    x, y = synthetic_batch(rng, 2, 16, 24)
+
+    v32 = m32.init_variables(jax.random.PRNGKey(0), shape)
+    # identical params: bf16 modules share the f32 param structure
+    enc32, _ = m32.encode(v32.params, v32.batch_stats, x, train=False)
+    enc16, _ = m16.encode(v32.params, v32.batch_stats, x, train=False)
+    assert enc16.qbar.dtype == enc32.qbar.dtype  # quantizer output f32
+    # bottleneck pre-quantization values close at bf16 resolution
+    rel = (np.linalg.norm(np.asarray(enc16.z, np.float64)
+                          - np.asarray(enc32.z, np.float64))
+           / (np.linalg.norm(np.asarray(enc32.z, np.float64)) + 1e-9))
+    assert rel < 0.05, rel
+
+    dec32, _ = m32.decode(v32.params, v32.batch_stats, enc32.qbar,
+                          train=False)
+    dec16, _ = m16.decode(v32.params, v32.batch_stats, enc32.qbar,
+                          train=False)
+    assert dec16.dtype == jnp.float32
+    assert float(jnp.mean(jnp.abs(dec16 - dec32))) < 8.0  # 0..255 scale
+
+    # bf16 training descends
+    tx = optim_lib.build_optimizer(None, ae16, pc, num_training_imgs=10)
+    state = step_lib.create_train_state(m16, jax.random.PRNGKey(0), shape, tx)
+    ts = step_lib.make_train_step(m16, tx, donate=False)
+    losses = []
+    for _ in range(25):
+        state, metrics = ts(state, x, y)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
