@@ -89,10 +89,36 @@ class Experiment:
                           if ae_config.use_gauss_mask else None)
 
         n_dev = jax.local_device_count()
+        spatial = int(ae_config.get("spatial_shards", 1))
         if use_mesh is None:
-            use_mesh = n_dev > 1 and ae_config.batch_size % n_dev == 0
+            use_mesh = (spatial > 1
+                        or (n_dev > 1 and ae_config.batch_size % n_dev == 0))
         self.mesh = None
-        if use_mesh:
+        if use_mesh and spatial > 1:
+            if spatial > jax.device_count():
+                raise ValueError(
+                    f"spatial_shards={spatial} exceeds the "
+                    f"{jax.device_count()} available devices")
+            # width-sharded training over a (data, spatial) mesh: the
+            # large-extent path — crops whose activations/score map exceed
+            # one chip (SURVEY §5). Requires not AE_only (the sharded
+            # search is the point) and divisibilities checked downstream.
+            from dsin_tpu.parallel import data_parallel as dp
+            from dsin_tpu.parallel import mesh as mesh_lib
+            # data axis sized to the batch: the largest divisor of
+            # batch_size that fits alongside the spatial axis
+            max_data = max(jax.device_count() // spatial, 1)
+            data_par = max(d for d in range(1, max_data + 1)
+                           if ae_config.batch_size % d == 0)
+            self.mesh = mesh_lib.make_mesh(num_devices=data_par * spatial,
+                                           spatial=spatial)
+            self.state = mesh_lib.replicate_state(self.mesh, self.state)
+            self.train_step = dp.make_spatial_train_step(
+                self.model, self.tx, self.mesh, ch, cw)
+            self.val_step = dp.make_spatial_eval_step(
+                self.model, self.mesh, ch, cw)
+            self._put = lambda x, y: mesh_lib.shard_images(self.mesh, x, y)
+        elif use_mesh:
             from dsin_tpu.parallel import data_parallel as dp
             from dsin_tpu.parallel import mesh as mesh_lib
             self.mesh = mesh_lib.make_mesh()

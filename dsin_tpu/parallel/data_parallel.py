@@ -46,6 +46,32 @@ def make_sharded_eval_step(model: DSIN, mesh,
                    out_shardings=repl)
 
 
+def _build_spatial_syn(model: DSIN, mesh, img_h: int, img_w: int):
+    """The ONE construction of the width-sharded search both spatial step
+    builders share (same mask/dtype config reading — train and eval must
+    run the same search)."""
+    from dsin_tpu.ops.sifinder import sifinder_conv_dtype
+    from dsin_tpu.parallel.spatial import build_synthesize_shmap
+
+    cfg = model.ae_config
+    ph, pw = cfg.y_patch_size
+    return build_synthesize_shmap(mesh, ph, pw, img_h, img_w,
+                                  use_mask=bool(cfg.use_gauss_mask),
+                                  conv_dtype=sifinder_conv_dtype(cfg))
+
+
+def make_spatial_eval_step(model: DSIN, mesh, img_h: int, img_w: int):
+    """Width-sharded eval twin of make_spatial_train_step: same shard_map'd
+    search, forward-only, metrics replicated."""
+    syn = _build_spatial_syn(model, mesh, img_h, img_w)
+    fn = step_lib.build_eval_step_fn(model, si_mask=None, synthesize_fn=syn)
+    return jax.jit(fn,
+                   in_shardings=(mesh_lib.replicated(mesh),
+                                 mesh_lib.image_sharding(mesh),
+                                 mesh_lib.image_sharding(mesh)),
+                   out_shardings=mesh_lib.replicated(mesh))
+
+
 def make_spatial_train_step(model: DSIN, tx: optax.GradientTransformation,
                             mesh, img_h: int, img_w: int,
                             donate: bool = True):
@@ -66,22 +92,14 @@ def make_spatial_train_step(model: DSIN, tx: optax.GradientTransformation,
     Gradient parity with the unsharded step is pinned by
     tests/test_spatial.py. (state, x, y) -> (state, metrics); x and y must
     be (N, img_h, img_w, 3)."""
-    from dsin_tpu.parallel.spatial import build_synthesize_shmap
-
-    cfg = model.ae_config
     assert not model.ae_only, (
         "spatial training is the SI path; AE_only needs no hand-sharded "
         "search — use make_sharded_train_step (GSPMD shards its convs)")
-    from dsin_tpu.ops.sifinder import sifinder_conv_dtype
-    ph, pw = cfg.y_patch_size
-    syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w,
-                                 use_mask=bool(cfg.use_gauss_mask),
-                                 conv_dtype=sifinder_conv_dtype(cfg))
+    syn = _build_spatial_syn(model, mesh, img_h, img_w)
     fn = step_lib.build_train_step_fn(model, tx, si_mask=None,
                                       synthesize_fn=syn)
     repl = mesh_lib.replicated(mesh)
-    img_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None,
-                                   mesh_lib.SPATIAL_AXIS, None))
+    img_sh = mesh_lib.image_sharding(mesh)
     return jax.jit(
         fn,
         in_shardings=(repl, img_sh, img_sh),

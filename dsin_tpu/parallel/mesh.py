@@ -47,15 +47,13 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, *arrays):
-    """Device-put arrays with the batch axis sharded over 'data'.
-
-    Single-process: a plain sharded device_put. Multi-process
-    (jax.distributed): each host holds only ITS loader shard of the global
-    batch (loader.py `host_id::num_hosts`), so the local array is this
-    process's slice and the global batch is assembled across hosts —
-    device_put can't address other hosts' devices."""
-    sh = batch_sharding(mesh)
+def _put_sharded(sh: NamedSharding, arrays):
+    """Device-put host arrays under `sh`. Single-process: plain sharded
+    device_put. Multi-process (jax.distributed): each host holds only ITS
+    loader shard of the global batch (loader.py `host_id::num_hosts`), so
+    the local array is this process's slice and the global array is
+    assembled across hosts — device_put can't address other hosts'
+    devices."""
     if jax.process_count() > 1:
         out = tuple(
             jax.make_array_from_process_local_data(sh, np.asarray(a))
@@ -63,6 +61,22 @@ def shard_batch(mesh: Mesh, *arrays):
     else:
         out = tuple(jax.device_put(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Device-put arrays with the batch axis sharded over 'data'."""
+    return _put_sharded(batch_sharding(mesh), arrays)
+
+
+def image_sharding(mesh: Mesh) -> NamedSharding:
+    """(N, H, W, C) images: batch over 'data', width over 'spatial'."""
+    return NamedSharding(mesh, P(DATA_AXIS, None, SPATIAL_AXIS, None))
+
+
+def shard_images(mesh: Mesh, *arrays):
+    """Device-put (N, H, W, C) arrays with batch over 'data' and width over
+    'spatial' — input layout for the width-sharded train/eval steps."""
+    return _put_sharded(image_sharding(mesh), arrays)
 
 
 def replicate_state(mesh: Mesh, state):

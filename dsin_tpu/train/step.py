@@ -178,14 +178,16 @@ def make_train_step(model: DSIN, tx: optax.GradientTransformation,
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 
-def build_eval_step_fn(model: DSIN, si_mask: Optional[jnp.ndarray] = None):
+def build_eval_step_fn(model: DSIN, si_mask: Optional[jnp.ndarray] = None,
+                       synthesize_fn=None):
     """The un-jitted eval step (state, x, y) -> metrics — callers wrap it in
     `jax.jit` (single chip) or jit-with-shardings (mesh)."""
 
     def eval_step(state: TrainState, x, y):
         loss, aux = _forward_losses(model, state.params, state.batch_stats,
                                     x, y, si_mask, train=False,
-                                    collect_mutations=False)
+                                    collect_mutations=False,
+                                    synthesize_fn=synthesize_fn)
         return _scalar_metrics(loss, aux)
 
     return eval_step

@@ -318,3 +318,25 @@ def test_real_bpp_measured_bitstream_at_test_time(tmp_path):
     # estimate and measurement agree to coding overhead (+ header/flush
     # on a tiny image); generous bound, catches unit mistakes (x8, /8...)
     assert 0.5 * means["bpp"] < means["real_bpp"] < 3.0 * means["bpp"] + 0.1
+
+
+@pytest.mark.slow
+def test_spatial_shards_training_through_experiment(tmp_path):
+    """spatial_shards=2 routes Experiment through the width-sharded
+    (data, spatial) train/eval steps — the large-extent path is reachable
+    from a config, not just the parallel API."""
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root, w=96)
+    ae, pc = _configs(root, ae_only=False)
+    ae = ae.replace(crop_size=(32, 96), eval_crop_size=(32, 96),
+                    spatial_shards=2, batch_size=2, iterations=2,
+                    validate_every=2)
+
+    exp = Experiment(ae, pc, out_root=out)
+    assert exp.mesh is not None
+    from dsin_tpu.parallel.mesh import SPATIAL_AXIS
+    assert exp.mesh.shape[SPATIAL_AXIS] == 2
+    r = exp.train(max_steps=2, max_val_batches=1)
+    assert r["steps"] == 2
+    assert np.isfinite(r["best_val"])
