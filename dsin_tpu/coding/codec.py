@@ -26,11 +26,20 @@ Design:
   split of `rans.Decoder`, so a fresh adaptive PMF per position costs one
   tiny jit call + O(L) host work.
 
-Two scan schedules share the same buffer/PMF machinery:
+Three scan engines share the same stream format (header mode byte — the
+engine defines both the symbol order and the exact PMF floats, so it is a
+property of the stream):
+
+* **wavefront_np** (default) — the pure-numpy incremental engine
+  (coding/incremental.py): cached per-layer activations, each computed
+  exactly once at its availability front; one fully-conv forward of work
+  total and no jax in the loop (~50x the jit wavefront on a 1-core host).
+
+The two jit engines remain as independently-derived cross-checks:
 
 * **sequential** — one position per jit call in raster order; the obviously-
   correct baseline (~1k-10k symbols/s host-loop).
-* **wavefront** (default) — positions are grouped into fronts
+* **wavefront** — positions are grouped into fronts
   t = a*d + b*h + w with b = pad+1, a = pad*(b+1)+1 (for K=3: t = 25d+5h+w).
   Every causal dependency of a position provably lies in a strictly earlier
   front (see `_wavefronts`), so all PMFs of one front are computed in a
@@ -58,7 +67,9 @@ MAGIC = b"DTPC"
 VERSION = 2
 MODE_SEQUENTIAL = 0
 MODE_WAVEFRONT = 1
-_MODES = {"sequential": MODE_SEQUENTIAL, "wavefront": MODE_WAVEFRONT}
+MODE_WAVEFRONT_NP = 2
+_MODES = {"sequential": MODE_SEQUENTIAL, "wavefront": MODE_WAVEFRONT,
+          "wavefront_np": MODE_WAVEFRONT_NP}
 
 
 class BottleneckCodec:
@@ -112,6 +123,15 @@ class BottleneckCodec:
         # vmap of the same per-block computation; all fronts are padded to
         # one bucket size so encode and decode hit the same executable.
         self._block_logits_batch = jax.jit(jax.vmap(_block_logits))
+        self._incremental = None  # lazy numpy engine (wavefront_np mode)
+
+    def _incremental_engine(self):
+        if self._incremental is None:
+            from dsin_tpu.coding.incremental import IncrementalResShallow
+            params_np = jax.tree_util.tree_map(np.asarray, self.pc_params)
+            self._incremental = IncrementalResShallow(
+                params_np, self.centers, self.pc_config, self.pad_value)
+        return self._incremental
 
     # -- internals ----------------------------------------------------------
 
@@ -205,6 +225,28 @@ class BottleneckCodec:
                 self.centers[s]
             yield front, s, cum_b, freqs_b
 
+    def _wavefront_pass_np(self, shape: Tuple[int, int, int], front_symbols):
+        """Same contract as `_wavefront_pass` (identical fronts, identical
+        yield tuples) but PMFs come from the pure-numpy incremental engine
+        (coding/incremental.py): cached per-layer activations updated
+        voxel-once in wavefront order — one fully-conv forward total instead
+        of a context cone per symbol, and no jax in the loop. Encode and
+        decode run this same code, so the quantized tables agree exactly;
+        streams are NOT interchangeable with the jit engine's (mode byte
+        keeps them apart)."""
+        vp = self._incremental_engine().begin(shape)
+        for i, (_, front) in enumerate(vp.sch.fronts):
+            logits = vp.logits_for(i).astype(np.float64)
+            z = logits - logits.max(axis=1, keepdims=True)
+            pmf = np.exp(z)
+            pmf /= pmf.sum(axis=1, keepdims=True)
+            freqs_b = rans.quantize_pmf_batch(pmf, self.scale_bits)
+            cum_b = rans.cum_from_freqs_batch(freqs_b)
+            s = np.asarray(front_symbols(front, cum_b, freqs_b),
+                           dtype=np.int64)
+            vp.write(i, s)
+            yield front, s, cum_b, freqs_b
+
     def _scan(self, shape: Tuple[int, int, int], symbol_at):
         """The one sequential driver every public method builds on: walk the
         volume in causal raster order maintaining the padded buffer; at each
@@ -227,8 +269,14 @@ class BottleneckCodec:
     # -- public API ---------------------------------------------------------
 
     def encode(self, symbols_dhw: np.ndarray,
-               mode: str = "wavefront") -> bytes:
-        """symbols (D=C, H, W) int -> framed bitstream."""
+               mode: str = "wavefront_np") -> bytes:
+        """symbols (D=C, H, W) int -> framed bitstream.
+
+        Default mode is the numpy incremental engine (~50x the jit
+        wavefront on a 1-core host: 0.96s vs 45s for a (32, 40, 120)
+        volume); 'wavefront' (jit) and 'sequential' remain as
+        cross-checking baselines. The mode is recorded in the stream
+        header — decode always uses the stream's own engine."""
         symbols = np.asarray(symbols_dhw)
         if symbols.ndim != 3:
             raise ValueError(f"expected (D, H, W) symbols, got "
@@ -238,11 +286,13 @@ class BottleneckCodec:
         mode_id = _MODES[mode]
         starts = np.empty(symbols.size, dtype=np.uint32)
         freqs_out = np.empty(symbols.size, dtype=np.uint32)
-        if mode_id == MODE_WAVEFRONT:
+        if mode_id in (MODE_WAVEFRONT, MODE_WAVEFRONT_NP):
+            passes = (self._wavefront_pass if mode_id == MODE_WAVEFRONT
+                      else self._wavefront_pass_np)
             idx = 0
             known = lambda front, cum_b, freqs_b: \
                 symbols[front[:, 0], front[:, 1], front[:, 2]]
-            for front, s, cum_b, freqs_b in self._wavefront_pass(
+            for front, s, cum_b, freqs_b in passes(
                     symbols.shape, known):
                 n = len(front)
                 ar = np.arange(n)
@@ -261,25 +311,29 @@ class BottleneckCodec:
         return header + payload
 
     def decode(self, bitstream: bytes) -> np.ndarray:
-        """Framed bitstream -> symbols (D, H, W) int32. The scan schedule
-        (sequential/wavefront) is read from the stream header — it defines
-        the symbol order, so it is a property of the stream, not a knob."""
+        """Framed bitstream -> symbols (D, H, W) int32. The scan engine
+        (sequential/wavefront/wavefront_np) is read from the stream header —
+        it defines the symbol order and the exact PMF floats, so it is a
+        property of the stream, not a knob."""
         if bitstream[:4] != MAGIC:
             raise ValueError("bad magic")
         version, mode_id, scale_bits, d, h, w = struct.unpack(
             "<BBBHHH", bitstream[4:13])
         if version != VERSION:
             raise ValueError(f"unsupported bitstream version {version}")
-        if mode_id not in (MODE_SEQUENTIAL, MODE_WAVEFRONT):
+        if mode_id not in (MODE_SEQUENTIAL, MODE_WAVEFRONT,
+                           MODE_WAVEFRONT_NP):
             raise ValueError(f"unknown scan mode {mode_id}")
         if scale_bits != self.scale_bits:
             raise ValueError(f"stream scale_bits {scale_bits} != codec "
                              f"{self.scale_bits}")
         symbols = np.empty((d, h, w), dtype=np.int32)
         with rans.Decoder(bitstream[13:], scale_bits) as dec:
-            if mode_id == MODE_WAVEFRONT:
+            if mode_id in (MODE_WAVEFRONT, MODE_WAVEFRONT_NP):
+                passes = (self._wavefront_pass if mode_id == MODE_WAVEFRONT
+                          else self._wavefront_pass_np)
                 take = lambda front, cum_b, freqs_b: dec.decode_front(cum_b)
-                for front, s, _, _ in self._wavefront_pass((d, h, w), take):
+                for front, s, _, _ in passes((d, h, w), take):
                     symbols[front[:, 0], front[:, 1], front[:, 2]] = s
             else:
                 for pos, s, _, _ in self._scan(

@@ -88,10 +88,16 @@ def main(argv=None) -> None:
     p.add_argument("--phase1_steps", type=int, default=None)
     p.add_argument("--phase2_steps", type=int, default=None)
     p.add_argument("--max_test_images", type=int, default=None)
+    p.add_argument("--H_target", type=float, default=None,
+                   help="override the config's rate target (bits per "
+                        "bottleneck voxel); target_bpp = H_target / "
+                        "(64 / num_chan_bn) — one RD-curve point per value")
     args = p.parse_args(argv)
 
     ae_config = parse_config_file(args.ae_config)
     pc_config = parse_config_file(args.pc_config)
+    if args.H_target is not None:
+        ae_config = ae_config.replace(H_target=args.H_target)
     if args.data_dir:
         ae_config = ae_config.replace(root_data=args.data_dir)
 

@@ -1,0 +1,87 @@
+"""Benchmark the real entropy codec at the reference bottleneck shape.
+
+Times a full 320x960-image bottleneck (32, 40, 120) = 153,600-symbol
+encode+decode roundtrip with the default numpy incremental engine
+(coding/incremental.py) and writes CODEC_BENCH.json. Symbols are
+uniform-random — the worst case for the context model, so the byte count
+is an upper bound, not a rate claim.
+
+Usage:  python tools/codec_bench.py   (CPU only; forces JAX_PLATFORMS=cpu)
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    # the axon site hook overrides jax_platforms at import time (see
+    # tests/conftest.py) — force it back so this host-codec bench never
+    # touches the TPU relay
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from dsin_tpu.coding import rans
+    from dsin_tpu.coding.codec import BottleneckCodec
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.probclass import ResShallow
+
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
+    L = 6
+    centers = np.linspace(-2.0, 2.0, L).astype(np.float32)
+    model = ResShallow(pc_cfg, num_centers=L)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 5, 9, 9, 1)))["params"]
+    codec = BottleneckCodec(model, params, centers, pc_cfg)
+
+    shape = (32, 40, 120)
+    rng = np.random.default_rng(0)
+    symbols = rng.integers(0, L, shape).astype(np.int64)
+
+    # warm (schedule build + first BLAS touch), then measure
+    stream = codec.encode(symbols)
+    t0 = time.perf_counter()
+    stream = codec.encode(symbols)
+    t1 = time.perf_counter()
+    decoded = codec.decode(stream)
+    t2 = time.perf_counter()
+    assert (decoded == symbols).all(), "roundtrip mismatch"
+
+    enc_s, dec_s = t1 - t0, t2 - t1
+    out = {
+        "shape": list(shape),
+        "symbols": symbols.size,
+        "bytes": len(stream),
+        "bpp_320x960": round(8 * len(stream) / (320 * 960), 4),
+        "engine": "wavefront_np (incremental cached activations)",
+        "encode_s_warm": round(enc_s, 3),
+        "decode_s_warm": round(dec_s, 3),
+        "encode_sym_per_s": int(symbols.size / enc_s),
+        "decode_sym_per_s": int(symbols.size / dec_s),
+        "native_rans": rans.native_available(),
+        "pc_config": "pc_default (res_shallow K=3 k=24)",
+        "host": "1-core CPU (driver container)",
+        "note": ("full 320x960-image bottleneck roundtrip; symbols "
+                 "uniform-random (worst case for the context model, so "
+                 "bytes ~= upper bound). Previous jit wavefront engine: "
+                 "44.8s enc / 44.5s dec at this shape."),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "CODEC_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

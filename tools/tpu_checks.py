@@ -7,6 +7,14 @@ This is the hardware evidence behind keeping `sifinder_impl='auto'` on the
 Pallas path (the CPU test suite can only run the kernel in interpret mode;
 ADVICE r1 asked for on-chip proof).
 
+Each check is independently guarded and results are written incrementally:
+at the 320x960 operating point the XLA path's materialized (301, 937, 640)
+score-map program is too large for the axon relay's remote-compile channel
+(observed: "remote_compile ... Broken pipe") — when the XLA reference is
+unavailable at a shape, the Pallas dtypes are still run and cross-checked
+against each other (both gather pixels from the original y, so equal patch
+choices mean bit-equal outputs).
+
 Usage (needs the real chip):  python tools/tpu_checks.py
 """
 
@@ -14,10 +22,30 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "TPU_CHECKS.json")
+
+
+def _write(results):
+    with open(OUT_PATH, "w") as f:
+        json.dump(results, f, indent=2)
+
+
+def _time_fn(fn, *args, reps=5):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return out, (time.perf_counter() - t0) / reps * 1e3
 
 
 def main() -> int:
@@ -26,7 +54,18 @@ def main() -> int:
 
     from dsin_tpu.ops import sifinder, sifinder_pallas
 
-    backend = jax.default_backend()
+    # the axon relay can be transiently unavailable (same failure mode
+    # bench.py retries); back off a few times before giving up
+    for attempt in range(3):
+        try:
+            backend = jax.default_backend()
+            break
+        except RuntimeError as e:
+            print(f"backend init failed (attempt {attempt + 1}/3): {e}",
+                  flush=True)
+            if attempt == 2:
+                raise
+            time.sleep(30 * (attempt + 1))
     results = {"backend": backend, "device": str(jax.devices()[0]),
                "checks": []}
     if backend != "tpu":
@@ -39,24 +78,14 @@ def main() -> int:
         x = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32))
         y = jnp.asarray(np.clip(np.asarray(x) + rng.normal(0, 8, x.shape),
                                 0, 255).astype(np.float32))
-        mask = jnp.asarray(sifinder.gaussian_position_mask(h, w, ph, pw))
         gh, gw = sifinder.gaussian_position_mask_factors(h, w, ph, pw)
+        entry = {"shape": [h, w], "patch": [ph, pw]}
 
-        from functools import partial
-        fn = partial(sifinder.search_single, mask=mask, patch_h=ph,
-                     patch_w=pw, use_l2=False)
-        xla_fn = jax.jit(lambda a, b, c: jax.vmap(
-            lambda u, v, t: fn(u, v, t).y_syn)(a, b, c))
-        ref = xla_fn(x, y, y)
-        jax.block_until_ready(ref)
-        t0 = time.perf_counter()
-        for _ in range(5):
-            ref = xla_fn(x, y, y)
-        jax.block_until_ready(ref)
-        xla_ms = (time.perf_counter() - t0) / 5 * 1e3
-
-        entry = {"shape": [h, w], "patch": [ph, pw],
-                 "xla_ms": round(xla_ms, 2)}
+        # Pallas first (known to compile at every shape — bench r2 proved
+        # 320x960 inside the full train step); XLA reference afterwards so
+        # a relay failure on the big XLA program can't lose the kernel runs.
+        outs = {}
+        pal_raw = {}
         for dtype in ("float32", "bfloat16"):
             try:
                 pal_fn = jax.jit(
@@ -64,29 +93,43 @@ def main() -> int:
                     sifinder_pallas.fused_synthesize_side_image(
                         a, b, c, jnp.asarray(gh), jnp.asarray(gw), ph, pw,
                         compute_dtype=jnp.dtype(dt), interpret=False))
-                out = pal_fn(x, y, y)
-                jax.block_until_ready(out)
-                t0 = time.perf_counter()
-                for _ in range(5):
-                    out = pal_fn(x, y, y)
-                jax.block_until_ready(out)
-                pal_ms = (time.perf_counter() - t0) / 5 * 1e3
-                diff = float(jnp.abs(out - ref).max())
-                frac_eq = float(jnp.mean((out == ref).astype(jnp.float32)))
-                entry[dtype] = {"pallas_ms": round(pal_ms, 2),
-                                "max_abs_diff_vs_xla": diff,
-                                "frac_pixels_equal": round(frac_eq, 6),
-                                "speedup_vs_xla": round(xla_ms / pal_ms, 2)}
+                out, pal_ms = _time_fn(pal_fn, x, y, y)
+                outs[dtype] = out
+                pal_raw[dtype] = pal_ms
+                entry[dtype] = {"pallas_ms": round(pal_ms, 2)}
             except Exception as e:  # noqa: BLE001 — record, keep going
                 entry[dtype] = {"error": repr(e)[:300]}
             print(f"{h}x{w} {dtype}: {entry[dtype]}", flush=True)
-        results["checks"].append(entry)
 
-    out_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "TPU_CHECKS.json")
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
-    print(f"wrote {out_path}")
+        if "float32" in outs and "bfloat16" in outs:
+            entry["pallas_f32_vs_bf16_pixels_equal"] = round(float(
+                jnp.mean((outs["float32"] == outs["bfloat16"])
+                         .astype(jnp.float32))), 6)
+
+        try:
+            mask = jnp.asarray(sifinder.gaussian_position_mask(h, w, ph, pw))
+            fn = partial(sifinder.search_single, mask=mask, patch_h=ph,
+                         patch_w=pw, use_l2=False)
+            xla_fn = jax.jit(lambda a, b, c: jax.vmap(
+                lambda u, v, t: fn(u, v, t).y_syn)(a, b, c))
+            ref, xla_ms = _time_fn(xla_fn, x, y, y)
+            entry["xla_ms"] = round(xla_ms, 2)
+            for dtype, out in outs.items():
+                entry[dtype]["max_abs_diff_vs_xla"] = float(
+                    jnp.abs(out - ref).max())
+                entry[dtype]["frac_pixels_equal"] = round(float(
+                    jnp.mean((out == ref).astype(jnp.float32))), 6)
+                entry[dtype]["speedup_vs_xla"] = round(
+                    xla_ms / pal_raw[dtype], 2)
+        except Exception as e:  # noqa: BLE001
+            entry["xla_error"] = repr(e)[:300]
+        print(f"{h}x{w} xla: {entry.get('xla_ms', entry.get('xla_error'))}",
+              flush=True)
+
+        results["checks"].append(entry)
+        _write(results)
+
+    print(f"wrote {OUT_PATH}")
     return 0
 
 
