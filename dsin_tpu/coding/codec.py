@@ -152,6 +152,17 @@ class BottleneckCodec:
         pmf /= pmf.sum()
         return rans.quantize_pmf(pmf, self.scale_bits)
 
+    def _tables_from_logits(self, logits_batch: np.ndarray):
+        """(n, L) float64 logits -> (freqs (n, L) u32, cum (n, L+1) u32).
+        The ONE softmax+quantize path both wavefront engines share — the
+        stream format depends on encode and decode (and ideal_bits) hitting
+        bit-identical tables, so there must be exactly one copy of this."""
+        z = logits_batch - logits_batch.max(axis=1, keepdims=True)
+        pmf = np.exp(z)
+        pmf /= pmf.sum(axis=1, keepdims=True)
+        freqs_b = rans.quantize_pmf_batch(pmf, self.scale_bits)
+        return freqs_b, rans.cum_from_freqs_batch(freqs_b)
+
     def _positions(self, d: int, h: int, w: int):
         for dd in range(d):
             for hh in range(h):
@@ -168,9 +179,10 @@ class BottleneckCodec:
           d'<d, h'<=h+pad, w'<=w+pad-> t-t' >= a - b*pad-pad = 1
         so equal-t positions are mutually independent. Returns a list of
         (n_i, 3) int arrays, t ascending, raster order within a front."""
-        p = self.pad
-        b_coef = p + 1
-        a_coef = p * (b_coef + 1) + 1
+        # shared with the numpy engine's schedule builder — the two engines'
+        # fronts must coincide (same symbol order in the stream format)
+        from dsin_tpu.coding.incremental import wavefront_coeffs
+        a_coef, b_coef = wavefront_coeffs(self.pad)
         dd, hh, ww = np.meshgrid(np.arange(d), np.arange(h), np.arange(w),
                                  indexing="ij")
         pos = np.stack([dd, hh, ww], axis=-1).reshape(-1, 3)
@@ -214,11 +226,7 @@ class BottleneckCodec:
             blocks[n:bucket] = 0.0  # deterministic padding
             logits = np.asarray(self._block_logits_batch(
                 jnp.asarray(blocks[:bucket])), dtype=np.float64)[:n]
-            z = logits - logits.max(axis=1, keepdims=True)
-            pmf = np.exp(z)
-            pmf /= pmf.sum(axis=1, keepdims=True)
-            freqs_b = rans.quantize_pmf_batch(pmf, self.scale_bits)
-            cum_b = rans.cum_from_freqs_batch(freqs_b)
+            freqs_b, cum_b = self._tables_from_logits(logits)
             s = np.asarray(front_symbols(front, cum_b, freqs_b),
                            dtype=np.int64)
             buf[front[:, 0] + p, front[:, 1] + p, front[:, 2] + p] = \
@@ -237,11 +245,7 @@ class BottleneckCodec:
         vp = self._incremental_engine().begin(shape)
         for i, (_, front) in enumerate(vp.sch.fronts):
             logits = vp.logits_for(i).astype(np.float64)
-            z = logits - logits.max(axis=1, keepdims=True)
-            pmf = np.exp(z)
-            pmf /= pmf.sum(axis=1, keepdims=True)
-            freqs_b = rans.quantize_pmf_batch(pmf, self.scale_bits)
-            cum_b = rans.cum_from_freqs_batch(freqs_b)
+            freqs_b, cum_b = self._tables_from_logits(logits)
             s = np.asarray(front_symbols(front, cum_b, freqs_b),
                            dtype=np.int64)
             vp.write(i, s)
@@ -342,13 +346,27 @@ class BottleneckCodec:
                     symbols[pos] = s
         return symbols
 
-    def ideal_bits(self, symbols_dhw: np.ndarray) -> float:
+    def ideal_bits(self, symbols_dhw: np.ndarray,
+                   mode: str = "wavefront_np") -> float:
         """Information content under the *quantized* tables — the tight lower
         bound for the actual stream (the cross-entropy estimate differs by
-        the PMF-quantization loss)."""
+        the PMF-quantization loss). `mode` picks whose tables: it must match
+        the stream being bounded (engines differ in last-ulp PMF floats)."""
+        if mode not in _MODES:
+            raise ValueError(f"unknown mode {mode!r}; expected one of "
+                             f"{sorted(_MODES)}")
         symbols = np.asarray(symbols_dhw)
         total = 0.0
         scale = float(1 << self.scale_bits)
+        if mode in ("wavefront", "wavefront_np"):
+            passes = (self._wavefront_pass if mode == "wavefront"
+                      else self._wavefront_pass_np)
+            known = lambda front, cum_b, freqs_b: \
+                symbols[front[:, 0], front[:, 1], front[:, 2]]
+            for front, s, _, freqs_b in passes(symbols.shape, known):
+                total += float(np.sum(np.log2(
+                    scale / freqs_b[np.arange(len(s)), s].astype(np.float64))))
+            return total
         take = lambda pos, cum, freqs: int(symbols[pos])
         for _, s, _, freqs in self._scan(symbols.shape, take):
             total += float(np.log2(scale / float(freqs[s])))

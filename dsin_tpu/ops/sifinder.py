@@ -162,8 +162,9 @@ def match_scores(x_patches: jnp.ndarray, y_image: jnp.ndarray,
 
 def sifinder_conv_dtype(config, default=None):
     """The ONE reading of the `sifinder_dtype` knob, shared by every
-    dispatch path: missing or None -> `default` (XLA: None = f32 status
-    quo; Pallas: bfloat16), else the named dtype."""
+    dispatch path: missing or None -> `default` (f32 on both paths —
+    on-chip f32 is also faster than bf16 in the fused kernel, see
+    TPU_CHECKS.json), else the named dtype."""
     val = getattr(config, "sifinder_dtype", None)
     return jnp.dtype(val) if val is not None else default
 
@@ -274,7 +275,12 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
                         "gaussian_position_mask (the kernel streams it in "
                         "separable form); pass mask=None or use "
                         "sifinder_impl='xla' for a custom mask")
-        dtype = sifinder_conv_dtype(config, jnp.dtype("bfloat16"))
+        # float32 default: measured on-chip (TPU_CHECKS.json) the kernel is
+        # ~2x FASTER in f32 than bf16 (16-bit sublane packing costs more in
+        # the im2col scratch than the MXU saves at these tile sizes), and
+        # f32 scores replicate the reference's full-precision patch choice.
+        # bf16 remains available via sifinder_dtype.
+        dtype = sifinder_conv_dtype(config, jnp.dtype("float32"))
         return sifinder_pallas.fused_synthesize_side_image(
             x_dec, y_img, y_dec, jnp.asarray(gh), jnp.asarray(gw),
             patch_h, patch_w, compute_dtype=dtype,

@@ -213,7 +213,7 @@ def _prepare_single(x_dec, y_dec, ph: int, pw: int, eps: float):
 def fused_synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
                                 y_dec: jnp.ndarray, gh: jnp.ndarray,
                                 gw: jnp.ndarray, patch_h: int, patch_w: int,
-                                *, compute_dtype=jnp.bfloat16,
+                                *, compute_dtype=jnp.float32,
                                 tile_w: int = 512, interpret: bool = False,
                                 eps: float = 1e-12) -> jnp.ndarray:
     """Batched y_syn via the fused kernel. All image tensors (N, H, W, 3);

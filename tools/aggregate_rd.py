@@ -1,0 +1,89 @@
+"""Merge per-operating-point 3-phase RD artifacts into one curve file.
+
+Each `eval/synthetic_rd.py` run produces `<out_root>/rd_synthetic.json` at
+one target bpp (the reference's workflow: one trained model per rate —
+reference ae_run_configs:21, README.md:45-54). This collects every
+`artifacts/rd_synthetic*/rd_synthetic.json` into `artifacts/rd_curve.json`
+with two series (AE-only and with-SI), sorted by measured bpp, and an
+optional matplotlib plot.
+
+Usage:  python tools/aggregate_rd.py [--plot]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--glob", default=os.path.join(
+        ROOT, "artifacts", "rd_synthetic*", "rd_synthetic.json"))
+    p.add_argument("--out", default=os.path.join(ROOT, "artifacts",
+                                                 "rd_curve.json"))
+    p.add_argument("--plot", action="store_true")
+    args = p.parse_args(argv)
+
+    points = []
+    for path in sorted(glob.glob(args.glob)):
+        with open(path) as f:
+            r = json.load(f)
+        entry = {"source": os.path.relpath(path, ROOT),
+                 "target_bpp": r.get("target_bpp"),
+                 "ae_only": r.get("ae_only_test"),
+                 "with_si": r.get("with_si_test")}
+        if "with_si_test_real_bpp" in r:
+            entry["with_si_real_bpp"] = r["with_si_test_real_bpp"]
+        points.append(entry)
+    if not points:
+        print(f"no artifacts match {args.glob}")
+        return 1
+    points.sort(key=lambda e: e["target_bpp"] or 0)
+
+    curve = {
+        "dataset": "synthetic stereo corpus (data/synthetic.py)",
+        "points": points,
+        # each series sorted by MEASURED bpp (target order can invert near
+        # rate-target saturation, which would make the plot zigzag)
+        "series": {
+            mode: sorted(({"bpp": e[mode]["bpp"], "psnr": e[mode]["psnr"],
+                           "ms_ssim": e[mode]["ms_ssim"]}
+                          for e in points if e.get(mode)),
+                         key=lambda s: s["bpp"])
+            for mode in ("ae_only", "with_si")
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(curve, f, indent=2)
+    print(f"wrote {args.out} with {len(points)} point(s)")
+
+    if args.plot:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+        for mode, label in (("ae_only", "AE only"),
+                            ("with_si", "with side information")):
+            s = curve["series"][mode]
+            axes[0].plot([e["bpp"] for e in s], [e["psnr"] for e in s],
+                         marker="o", label=label)
+            axes[1].plot([e["bpp"] for e in s], [e["ms_ssim"] for e in s],
+                         marker="o", label=label)
+        axes[0].set_xlabel("bpp"), axes[0].set_ylabel("PSNR (dB)")
+        axes[1].set_xlabel("bpp"), axes[1].set_ylabel("MS-SSIM")
+        for ax in axes:
+            ax.grid(True, alpha=0.3), ax.legend()
+        fig.suptitle("DSIN-TPU rate-distortion (synthetic stereo)")
+        fig.tight_layout()
+        out_png = args.out.replace(".json", ".png")
+        fig.savefig(out_png, dpi=120)
+        print(f"wrote {out_png}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
