@@ -91,9 +91,12 @@ def _watchdog():
             os._exit(3)
 
 
-def _init_backend_with_retry(jax, attempts=3, backoff_s=10.0):
+def _init_backend_with_retry(jax, attempts=6, backoff_s=45.0):
     """First device touch, retried: the axon TPU relay can fail transiently
-    (round-1 BENCH died in backend init before any fallback could run)."""
+    (round-1 BENCH died in backend init before any fallback could run;
+    round-2 observed multi-minute relay outages after a remote-compile
+    crash). 5 sleeps x 45 s = 225 s of total backoff still leaves ~1275 s
+    of the 1500 s watchdog deadline for compile+run."""
     for i in range(attempts):
         try:
             stage(f"initializing backend (attempt {i + 1}/{attempts})")
@@ -105,7 +108,7 @@ def _init_backend_with_retry(jax, attempts=3, backoff_s=10.0):
                   f" (attempt {i + 1}/{attempts}): {e}")
             if i == attempts - 1:
                 raise
-            time.sleep(backoff_s * (i + 1))
+            time.sleep(backoff_s)
 
 
 def run():

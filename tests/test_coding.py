@@ -257,6 +257,23 @@ def test_decode_front_matches_decode_symbol():
 
 # -- numpy incremental engine (coding/incremental.py) -------------------------
 
+def _assert_incremental_matches_fully_conv(codec, model, variables, symbols):
+    """Replay the incremental engine over `symbols` and pin every front's
+    logits against the jitted fully-convolutional probclass forward."""
+    q = codec.centers[symbols]                       # (D, H, W)
+    q_nhwc = jnp.asarray(np.transpose(q, (1, 2, 0))[None])
+    ref = np.asarray(pc_lib.logits_from_q(
+        model, variables, q_nhwc,
+        pc_lib.auto_pad_value(codec.pc_config, jnp.asarray(codec.centers))))
+    ref = np.transpose(ref[0], (2, 0, 1, 3))         # (D, H, W, L)
+    vp = codec._incremental_engine().begin(symbols.shape)
+    got = np.zeros_like(ref)
+    for i, (_, front) in enumerate(vp.sch.fronts):
+        got[front[:, 0], front[:, 1], front[:, 2]] = vp.logits_for(i)
+        vp.write(i, symbols[front[:, 0], front[:, 1], front[:, 2]])
+    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+
+
 def test_np_engine_roundtrip_and_cross_engine_decode(tiny_codec):
     codec, (d, h, w), _, _ = tiny_codec
     rng = np.random.default_rng(21)
@@ -286,16 +303,31 @@ def test_np_engine_logits_match_fully_conv_forward(tiny_codec):
     codec, (d, h, w), model, variables = tiny_codec
     rng = np.random.default_rng(23)
     symbols = rng.integers(0, codec.num_centers, (d, h, w))
-    q = codec.centers[symbols]                       # (D, H, W)
-    q_nhwc = jnp.asarray(np.transpose(q, (1, 2, 0))[None])
-    ref = np.asarray(pc_lib.logits_from_q(
-        model, variables, q_nhwc,
-        pc_lib.auto_pad_value(codec.pc_config, jnp.asarray(codec.centers))))
-    ref = np.transpose(ref[0], (2, 0, 1, 3))         # (D, H, W, L)
+    _assert_incremental_matches_fully_conv(codec, model, variables, symbols)
 
-    vp = codec._incremental_engine().begin(symbols.shape)
-    got = np.zeros_like(ref)
-    for i, (_, front) in enumerate(vp.sch.fronts):
-        got[front[:, 0], front[:, 1], front[:, 2]] = vp.logits_for(i)
-        vp.write(i, symbols[front[:, 0], front[:, 1], front[:, 2]])
-    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+
+def test_np_engine_generalizes_to_k5():
+    """kernel_size=5 exercises the schedule builder's generic geometry
+    (filter (3,5,5), pad 8, wavefront coeffs a=81/b=9) — nothing in
+    incremental.py may hardcode K=3."""
+    pc_cfg = parse_config(
+        """
+        arch = res_shallow
+        kernel_size = 5
+        arch_param__k = 3
+        use_centers_for_padding = True
+        """)
+    L = 4
+    model = pc_lib.ResShallow(pc_cfg, num_centers=L)
+    centers = np.linspace(-2.0, 2.0, L).astype(np.float32)
+    d, h, w = 3, 6, 9
+    vol = pc_lib.pad_volume(jnp.zeros((1, d, h, w, 1)), 5, 0.0)
+    variables = model.init(jax.random.PRNGKey(1), vol)
+    codec = codec_lib.BottleneckCodec(model, variables["params"], centers,
+                                      pc_cfg)
+    rng = np.random.default_rng(31)
+    symbols = rng.integers(0, L, (d, h, w))
+    stream = codec.encode(symbols, mode="wavefront_np")
+    np.testing.assert_array_equal(codec.decode(stream), symbols)
+    # and the incremental logits still match the fully-conv forward
+    _assert_incremental_matches_fully_conv(codec, model, variables, symbols)
