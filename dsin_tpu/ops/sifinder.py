@@ -44,7 +44,9 @@ from dsin_tpu.ops.patches import assemble_patches, extract_patches
 
 class SearchResult(NamedTuple):
     y_syn: jnp.ndarray       # (H, W, 3) synthesized side image
-    score_map: jnp.ndarray   # (Hc, Wc, P) masked correlation / distance map
+    # (Hc, Wc, P) masked correlation / distance map; None from the tiled
+    # search, which exists precisely to never materialize this tensor
+    score_map: Optional[jnp.ndarray]
     best_flat: jnp.ndarray   # (P,) argmax/argmin of the flattened map
     row: jnp.ndarray         # (P,) match rows
     col: jnp.ndarray         # (P,) match cols
@@ -96,6 +98,41 @@ def gaussian_position_mask_factors(img_h: int, img_w: int, patch_h: int,
     Pallas kernel stream the prior without building the (Hc, Wc, P) tensor."""
     gh, gw = _gaussian_mask_factors_f64(img_h, img_w, patch_h, patch_w)
     return gh.astype(np.float32), gw.astype(np.float32)
+
+
+def standard_mask_factors(mask, img_h: int, img_w: int, patch_h: int,
+                          patch_w: int):
+    """(gh, gw) if `mask` is (recognizably) the standard Gaussian prior for
+    these shapes, else None.
+
+    Shared by every dispatch branch that wants to stream the prior in
+    separable form instead of materializing/carrying the (Hc, Wc, P)
+    tensor. The check samples thin slices — first/middle/last rows and
+    columns — rather than rebuilding the full product (~722 MB of host
+    temporaries at the 320x960 operating point). A crafted mask equal to
+    the Gaussian on all six sampled slices but different elsewhere would be
+    misdetected; callers for whom silent substitution is unacceptable must
+    route custom masks explicitly (the tiled path row-slices them; the
+    materialized path uses them directly).
+    """
+    if mask is None or isinstance(mask, jax.core.Tracer):
+        return None
+    gh, gw = gaussian_position_mask_factors(img_h, img_w, patch_h, patch_w)
+    hc, wc, p_count = gh.shape[0], gw.shape[0], gh.shape[1]
+    mask_np = np.asarray(mask)
+    if mask_np.shape != (hc, wc, p_count):
+        return None
+    # the genuine mask is exactly f32(gh)*f32(gw) (see
+    # gaussian_position_mask), so exact equality is the right test
+    for h_idx in (0, hc // 2, hc - 1):
+        if not np.array_equal(mask_np[h_idx, :, :],
+                              gh[h_idx][None, :] * gw):
+            return None
+    for w_idx in (0, wc // 2, wc - 1):
+        if not np.array_equal(mask_np[:, w_idx, :],
+                              gh * gw[w_idx][None, :]):
+            return None
+    return gh, gw
 
 
 def _window_sums(img: jnp.ndarray, win_h: int, win_w: int):
@@ -222,6 +259,83 @@ def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
                         row=rows, col=cols)
 
 
+def search_single_tiled(x_dec: jnp.ndarray, y_img: jnp.ndarray,
+                        y_dec: jnp.ndarray, patch_h: int, patch_w: int,
+                        *, mask_factors=None, mask: Optional[jnp.ndarray] =
+                        None, row_chunk: int = 32,
+                        conv_dtype=None) -> SearchResult:
+    """Pearson search that never materializes the (Hc, Wc, P) score map.
+
+    A `lax.scan` over row-chunks of the correlation map computes each chunk
+    (same `match_scores` math on a row slice of ŷ), applies the prior, and
+    folds a running per-patch (best value, flat index) — peak memory is
+    O(row_chunk * Wc * P) instead of O(Hc * Wc * P), and the emitted XLA
+    program is a small loop body instead of one giant fused map. Motivated
+    by measurement: at the 320x960 operating point the materialized
+    program is ~0.9 GB of HBM traffic and exceeded the axon relay's
+    remote-compile limits (TPU_CHECKS.json), while a chunked body compiles
+    anywhere. Chunks scan in ascending row order with a strict ">" merge,
+    reproducing jnp.argmax's lowest-flat-index tie rule.
+
+    The prior comes either as separable `mask_factors` (gh (Hc, P),
+    gw (Wc, P) — the standard Gaussian; multiplied factors-first exactly
+    like `gaussian_position_mask` builds its product) or as a full `mask`
+    array that is row-sliced per chunk. Pearson only: the L2 mode needs a
+    global score mean for its additive discount (see search_single).
+    """
+    h, w, _ = x_dec.shape
+    hc, wc = h - patch_h + 1, w - patch_w + 1
+    x_patches = extract_patches(x_dec, patch_h, patch_w)
+    q = color_lib.search_transform(x_patches, False)
+    r = color_lib.search_transform(y_dec, False)
+    p_count = q.shape[0]
+
+    num_chunks = -(-hc // row_chunk)
+    pad_rows = num_chunks * row_chunk + patch_h - 1 - r.shape[0]
+    r_pad = jnp.pad(r, ((0, pad_rows), (0, 0), (0, 0)))
+    if mask_factors is not None:
+        gh, gw = (jnp.asarray(m) for m in mask_factors)
+        gh_pad = jnp.pad(gh, ((0, num_chunks * row_chunk - hc), (0, 0)))
+    elif mask is not None:
+        mask_pad = jnp.pad(jnp.asarray(mask),
+                           ((0, num_chunks * row_chunk - hc), (0, 0), (0, 0)))
+
+    def body(carry, k):
+        best_val, best_flat = carry
+        r0 = k * row_chunk
+        y_slice = jax.lax.dynamic_slice(
+            r_pad, (r0, 0, 0), (row_chunk + patch_h - 1, r_pad.shape[1],
+                                r_pad.shape[2]))
+        scores = match_scores(q, y_slice, use_l2=False,
+                              conv_dtype=conv_dtype)   # (row_chunk, Wc, P)
+        if mask_factors is not None:
+            gh_s = jax.lax.dynamic_slice(gh_pad, (r0, 0),
+                                         (row_chunk, p_count))
+            scores = scores * (gh_s[:, None, :] * gw[None, :, :])
+        elif mask is not None:
+            scores = scores * jax.lax.dynamic_slice(
+                mask_pad, (r0, 0, 0), (row_chunk, wc, p_count))
+        valid = (r0 + jnp.arange(row_chunk)) < hc
+        scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+        flat = scores.reshape(row_chunk * wc, p_count)
+        loc = jnp.argmax(flat, axis=0).astype(jnp.int32)
+        val = flat[loc, jnp.arange(p_count)]
+        glob = (r0 + loc // wc) * wc + loc % wc
+        take = val > best_val           # strict: earlier chunk wins ties
+        return (jnp.where(take, val, best_val),
+                jnp.where(take, glob, best_flat)), None
+
+    init = (jnp.full((p_count,), -jnp.inf, jnp.float32),
+            jnp.zeros((p_count,), jnp.int32))
+    (best_val, best_flat), _ = jax.lax.scan(body, init,
+                                            jnp.arange(num_chunks))
+    rows, cols = best_flat // wc, best_flat % wc
+    y_patches = gather_patches(y_img, rows, cols, patch_h, patch_w)
+    y_syn = assemble_patches(y_patches, h, w)
+    return SearchResult(y_syn=y_syn, score_map=None, best_flat=best_flat,
+                        row=rows, col=cols)
+
+
 def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
                           y_dec: jnp.ndarray, mask: Optional[jnp.ndarray],
                           patch_h: int, patch_w: int, config) -> jnp.ndarray:
@@ -236,14 +350,18 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
         silently ignored — only this module's XLA path honors arbitrary
         masks);
       * 'pallas_interpret' — same kernel, Pallas interpreter (tests on CPU);
+      * 'xla_tiled' — chunked-scan search (`search_single_tiled`): XLA
+        semantics, O(row_chunk·Wc·P) memory, compiles at shapes where the
+        materialized map cannot (Pearson only; honors custom masks by
+        row-slicing; `sifinder_row_chunk` config tunes the chunk);
       * 'auto'   — 'pallas' on TPU backends when Pearson, else 'xla'.
     """
     use_l2 = bool(config.use_L2andLAB)
     impl = getattr(config, "sifinder_impl", "auto")
-    if impl not in ("auto", "xla", "pallas", "pallas_interpret"):
+    if impl not in ("auto", "xla", "xla_tiled", "pallas", "pallas_interpret"):
         raise ValueError(
             f"sifinder_impl={impl!r}: expected one of "
-            "'auto', 'xla', 'pallas', 'pallas_interpret'")
+            "'auto', 'xla', 'xla_tiled', 'pallas', 'pallas_interpret'")
     if impl == "auto":
         impl = ("pallas" if (not use_l2 and
                              jax.default_backend() == "tpu") else "xla")
@@ -256,25 +374,19 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
             p_count = (h // patch_h) * (w // patch_w)
             gh = np.ones((hc, p_count), np.float32)
             gw = np.ones((wc, p_count), np.float32)
-        else:
+        elif isinstance(mask, jax.core.Tracer):
+            # traced mask: cannot be inspected — assume the standard prior
+            # (documented kernel contract)
             gh, gw = gaussian_position_mask_factors(h, w, patch_h, patch_w)
-            if not isinstance(mask, jax.core.Tracer):
-                # validate via thin slices, not the full (Hc, Wc, P)
-                # product — at the 320x960 operating point that would be
-                # ~722 MB of host temporaries per trace
-                hc, wc = gh.shape[0], gw.shape[0]
-                mask_np = np.asarray(mask)
-                ok = (mask_np.shape == (hc, wc, gh.shape[1])
-                      and np.allclose(mask_np[:, 0, :], gh * gw[0][None, :],
-                                      atol=1e-6)
-                      and np.allclose(mask_np[0, :, :], gh[0][None, :] * gw,
-                                      atol=1e-6))
-                if not ok:
-                    raise ValueError(
-                        "sifinder_impl='pallas' only supports the standard "
-                        "gaussian_position_mask (the kernel streams it in "
-                        "separable form); pass mask=None or use "
-                        "sifinder_impl='xla' for a custom mask")
+        else:
+            factors = standard_mask_factors(mask, h, w, patch_h, patch_w)
+            if factors is None:
+                raise ValueError(
+                    "sifinder_impl='pallas' only supports the standard "
+                    "gaussian_position_mask (the kernel streams it in "
+                    "separable form); pass mask=None or use "
+                    "sifinder_impl='xla'/'xla_tiled' for a custom mask")
+            gh, gw = factors
         # float32 default: measured on-chip (TPU_CHECKS.json) the kernel is
         # ~2x FASTER in f32 than bf16 (16-bit sublane packing costs more in
         # the im2col scratch than the MXU saves at these tile sizes), and
@@ -285,6 +397,21 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
             x_dec, y_img, y_dec, jnp.asarray(gh), jnp.asarray(gw),
             patch_h, patch_w, compute_dtype=dtype,
             interpret=(impl == "pallas_interpret"))
+    if impl == "xla_tiled":
+        assert not use_l2, "tiled siFinder search is Pearson-only"
+        h, w = x_dec.shape[1], x_dec.shape[2]
+        # standard Gaussian prior -> stream its separable factors (the
+        # combined mask IS f32(gh)*f32(gw), so results are bit-equal);
+        # anything else -> row-slice the provided array per chunk
+        factors = standard_mask_factors(mask, h, w, patch_h, patch_w)
+        fn = partial(search_single_tiled, patch_h=patch_h, patch_w=patch_w,
+                     mask_factors=factors,
+                     mask=None if factors is not None else mask,
+                     row_chunk=int(getattr(config, "sifinder_row_chunk", 32)
+                                   or 32),
+                     conv_dtype=sifinder_conv_dtype(config))
+        return jax.vmap(lambda a, b, c: fn(a, b, c).y_syn)(x_dec, y_img,
+                                                           y_dec)
     # optional reduced-precision correlation conv on the XLA path too
     # (same knob as the Pallas path via sifinder_conv_dtype); None/missing
     # = float32 status quo. Pearson-only — see match_scores.
