@@ -119,17 +119,18 @@ def standard_mask_factors(mask, img_h: int, img_w: int, patch_h: int,
         return None
     gh, gw = gaussian_position_mask_factors(img_h, img_w, patch_h, patch_w)
     hc, wc, p_count = gh.shape[0], gw.shape[0], gh.shape[1]
-    mask_np = np.asarray(mask)
-    if mask_np.shape != (hc, wc, p_count):
+    if tuple(mask.shape) != (hc, wc, p_count):
         return None
-    # the genuine mask is exactly f32(gh)*f32(gw) (see
-    # gaussian_position_mask), so exact equality is the right test
+    # convert ONLY the sampled slices — np.asarray(mask) of the full tensor
+    # would itself be the ~722 MB device-to-host copy this check avoids.
+    # The genuine mask is exactly f32(gh)*f32(gw) (see
+    # gaussian_position_mask), so exact equality is the right test.
     for h_idx in (0, hc // 2, hc - 1):
-        if not np.array_equal(mask_np[h_idx, :, :],
+        if not np.array_equal(np.asarray(mask[h_idx, :, :]),
                               gh[h_idx][None, :] * gw):
             return None
     for w_idx in (0, wc // 2, wc - 1):
-        if not np.array_equal(mask_np[:, w_idx, :],
+        if not np.array_equal(np.asarray(mask[:, w_idx, :]),
                               gh * gw[w_idx][None, :]):
             return None
     return gh, gw
@@ -204,6 +205,64 @@ def sifinder_conv_dtype(config, default=None):
     TPU_CHECKS.json), else the named dtype."""
     val = getattr(config, "sifinder_dtype", None)
     return jnp.dtype(val) if val is not None else default
+
+
+def sifinder_row_chunk(config, default: int = 32) -> int:
+    """The ONE reading of the `sifinder_row_chunk` knob (rows of the
+    correlation map per chunk in the tiled search), shared by the
+    unsharded dispatch and both spatial step builders: missing, None, or 0
+    -> `default`."""
+    return int(getattr(config, "sifinder_row_chunk", default) or default)
+
+
+def chunked_score_argmax(q: jnp.ndarray, r_padded: jnp.ndarray, hc: int,
+                         width: int, row_chunk: int, mask_chunk_fn,
+                         patch_h: int, conv_dtype=None, eps: float = 1e-12):
+    """Row-chunked Pearson score-map arg-max — the ONE scan body shared by
+    `search_single_tiled` and the spatial shard-local search, so the
+    bit-parity tie-break contract lives in exactly one place.
+
+    Scans chunks of `row_chunk` score rows in ascending order; each chunk
+    runs `match_scores` on the matching row slice of `r_padded` (which must
+    be pre-padded to num_chunks*row_chunk + patch_h - 1 rows), gets
+    `mask_chunk_fn(scores, r0)` applied (prior multiply + any column
+    masking; shape (row_chunk, width, P) in/out), then rows >= hc are
+    forced to -inf and a strict ">" merge folds the per-chunk argmax into
+    the running best — earlier chunks win ties, and within a chunk
+    jnp.argmax picks the first maximum, which together reproduce
+    jnp.argmax's lowest-flat-index rule on the full (hc, width) map.
+
+    Returns (best_val (P,), best_flat (P,)) with best_flat a row-major
+    flat index over (hc, width)."""
+    p_count = q.shape[0]
+    num_chunks = -(-hc // row_chunk)
+    assert r_padded.shape[0] == num_chunks * row_chunk + patch_h - 1, (
+        r_padded.shape, num_chunks, row_chunk, patch_h)
+
+    def body(carry, k):
+        best_val, best_flat = carry
+        r0 = k * row_chunk
+        y_slice = jax.lax.dynamic_slice(
+            r_padded, (r0, 0, 0), (row_chunk + patch_h - 1,
+                                   r_padded.shape[1], r_padded.shape[2]))
+        scores = match_scores(q, y_slice, use_l2=False, eps=eps,
+                              conv_dtype=conv_dtype)  # (row_chunk, width, P)
+        scores = mask_chunk_fn(scores, r0)
+        valid = (r0 + jnp.arange(row_chunk)) < hc
+        scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
+        flat = scores.reshape(row_chunk * width, p_count)
+        loc = jnp.argmax(flat, axis=0).astype(jnp.int32)
+        val = flat[loc, jnp.arange(p_count)]
+        glob = (r0 + loc // width) * width + loc % width
+        take = val > best_val           # strict: earlier chunk wins ties
+        return (jnp.where(take, val, best_val),
+                jnp.where(take, glob, best_flat)), None
+
+    init = (jnp.full((p_count,), -jnp.inf, jnp.float32),
+            jnp.zeros((p_count,), jnp.int32))
+    (best_val, best_flat), _ = jax.lax.scan(body, init,
+                                            jnp.arange(num_chunks))
+    return best_val, best_flat
 
 
 def find_matches(score_map: jnp.ndarray, use_l2: bool):
@@ -296,39 +355,25 @@ def search_single_tiled(x_dec: jnp.ndarray, y_img: jnp.ndarray,
     if mask_factors is not None:
         gh, gw = (jnp.asarray(m) for m in mask_factors)
         gh_pad = jnp.pad(gh, ((0, num_chunks * row_chunk - hc), (0, 0)))
+
+        def mask_chunk(scores, r0):
+            gh_s = jax.lax.dynamic_slice(gh_pad, (r0, 0),
+                                         (row_chunk, p_count))
+            return scores * (gh_s[:, None, :] * gw[None, :, :])
     elif mask is not None:
         mask_pad = jnp.pad(jnp.asarray(mask),
                            ((0, num_chunks * row_chunk - hc), (0, 0), (0, 0)))
 
-    def body(carry, k):
-        best_val, best_flat = carry
-        r0 = k * row_chunk
-        y_slice = jax.lax.dynamic_slice(
-            r_pad, (r0, 0, 0), (row_chunk + patch_h - 1, r_pad.shape[1],
-                                r_pad.shape[2]))
-        scores = match_scores(q, y_slice, use_l2=False,
-                              conv_dtype=conv_dtype)   # (row_chunk, Wc, P)
-        if mask_factors is not None:
-            gh_s = jax.lax.dynamic_slice(gh_pad, (r0, 0),
-                                         (row_chunk, p_count))
-            scores = scores * (gh_s[:, None, :] * gw[None, :, :])
-        elif mask is not None:
-            scores = scores * jax.lax.dynamic_slice(
+        def mask_chunk(scores, r0):
+            return scores * jax.lax.dynamic_slice(
                 mask_pad, (r0, 0, 0), (row_chunk, wc, p_count))
-        valid = (r0 + jnp.arange(row_chunk)) < hc
-        scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
-        flat = scores.reshape(row_chunk * wc, p_count)
-        loc = jnp.argmax(flat, axis=0).astype(jnp.int32)
-        val = flat[loc, jnp.arange(p_count)]
-        glob = (r0 + loc // wc) * wc + loc % wc
-        take = val > best_val           # strict: earlier chunk wins ties
-        return (jnp.where(take, val, best_val),
-                jnp.where(take, glob, best_flat)), None
+    else:
+        def mask_chunk(scores, r0):
+            return scores
 
-    init = (jnp.full((p_count,), -jnp.inf, jnp.float32),
-            jnp.zeros((p_count,), jnp.int32))
-    (best_val, best_flat), _ = jax.lax.scan(body, init,
-                                            jnp.arange(num_chunks))
+    _, best_flat = chunked_score_argmax(q, r_pad, hc, wc, row_chunk,
+                                        mask_chunk, patch_h,
+                                        conv_dtype=conv_dtype)
     rows, cols = best_flat // wc, best_flat % wc
     y_patches = gather_patches(y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)
@@ -407,8 +452,7 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
         fn = partial(search_single_tiled, patch_h=patch_h, patch_w=patch_w,
                      mask_factors=factors,
                      mask=None if factors is not None else mask,
-                     row_chunk=int(getattr(config, "sifinder_row_chunk", 32)
-                                   or 32),
+                     row_chunk=sifinder_row_chunk(config),
                      conv_dtype=sifinder_conv_dtype(config))
         return jax.vmap(lambda a, b, c: fn(a, b, c).y_syn)(x_dec, y_img,
                                                            y_dec)

@@ -50,14 +50,21 @@ def _build_spatial_syn(model: DSIN, mesh, img_h: int, img_w: int):
     """The ONE construction of the width-sharded search both spatial step
     builders share (same mask/dtype config reading — train and eval must
     run the same search)."""
-    from dsin_tpu.ops.sifinder import sifinder_conv_dtype
+    from dsin_tpu.ops.sifinder import sifinder_conv_dtype, sifinder_row_chunk
     from dsin_tpu.parallel.spatial import build_synthesize_shmap
 
     cfg = model.ae_config
     ph, pw = cfg.y_patch_size
+    # sifinder_impl='xla_tiled' composes row tiling into the width shards:
+    # per-device search memory O(row_chunk * Wl * P) — the very-large-extent
+    # configuration (sharding and tiling multiply)
+    row_chunk = (sifinder_row_chunk(cfg)
+                 if getattr(cfg, "sifinder_impl", "auto") == "xla_tiled"
+                 else None)
     return build_synthesize_shmap(mesh, ph, pw, img_h, img_w,
                                   use_mask=bool(cfg.use_gauss_mask),
-                                  conv_dtype=sifinder_conv_dtype(cfg))
+                                  conv_dtype=sifinder_conv_dtype(cfg),
+                                  row_chunk=row_chunk)
 
 
 def make_spatial_eval_step(model: DSIN, mesh, img_h: int, img_w: int):

@@ -62,9 +62,15 @@ def _halo_from_right(z: jnp.ndarray, halo: int, axis_name: str):
 
 
 def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
-                  eps=1e-12, conv_dtype=None):
+                  eps=1e-12, conv_dtype=None, row_chunk=None):
     """Per-shard search for ONE pair. x_dec (H, W, 3) replicated;
-    y_img/y_dec (H, Wl, 3) width shards. Returns y_syn (H, W, 3)."""
+    y_img/y_dec (H, Wl, 3) width shards. Returns y_syn (H, W, 3).
+
+    `row_chunk=None` materializes the local (Hc, Wl, P) score map;
+    an int runs the same math as a row-chunked `lax.scan` (the spatial
+    composition of ops/sifinder.search_single_tiled), dropping per-shard
+    peak memory to O(row_chunk * Wl * P) — width sharding and row tiling
+    multiply, which is what makes Cityscapes-and-beyond extents fit."""
     axis = SPATIAL_AXIS
     h, w_local = y_dec.shape[0], y_dec.shape[1]
     wc = img_w - patch_w + 1
@@ -79,27 +85,45 @@ def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
     q = color_lib.search_transform(x_patches, False)
     r_img = color_lib.search_transform(y_dec_h, False)
 
-    scores = sifinder.match_scores(q, r_img, use_l2=False, eps=eps,
-                                   conv_dtype=conv_dtype)
-    # scores: (Hc, Wl, P) — local slice of the global map's columns
-    hc, wl, p_count = scores.shape
-
-    # global Gaussian prior, sliced to this shard's columns; combine the
-    # factors FIRST so each masked score is scores * (gh*gw) — the exact
-    # multiply order of the unsharded path's combined mask
-    # (gaussian_position_mask builds the same f32 product), keeping
-    # near-tie argmax winners bit-identical
-    gh_t = gh[:, None, :]                                   # (Hc, 1, P)
+    hc = h - patch_h + 1
+    wl = w_local
+    p_count = q.shape[0]
     gw_l = jax.lax.dynamic_slice(gw, (col0, 0), (wl, p_count))
-    scores = scores * (gh_t * gw_l[None, :, :])
+    # validity of this shard's global columns (right edge of the last shard)
+    cols_valid = (col0 + jnp.arange(wl)) < wc
 
-    # mask out-of-range global columns (right edge of the last shard)
-    cols = col0 + jnp.arange(wl)
-    scores = jnp.where((cols < wc)[None, :, None], scores, -jnp.inf)
+    def _mask_chunk(scores, gh_slice):
+        # combine the factors FIRST so each masked score is
+        # scores * (gh*gw) — the exact multiply order of the unsharded
+        # path's combined mask (gaussian_position_mask builds the same f32
+        # product), keeping near-tie argmax winners bit-identical
+        scores = scores * (gh_slice[:, None, :] * gw_l[None, :, :])
+        return jnp.where(cols_valid[None, :, None], scores, -jnp.inf)
 
-    flat = scores.reshape(hc * wl, p_count)
-    best_local = jnp.argmax(flat, axis=0).astype(jnp.int32)   # (P,)
-    best_val = jnp.max(flat, axis=0)                          # (P,)
+    if row_chunk is None:
+        scores = sifinder.match_scores(q, r_img, use_l2=False, eps=eps,
+                                       conv_dtype=conv_dtype)
+        scores = _mask_chunk(scores, gh)
+        flat = scores.reshape(hc * wl, p_count)
+        best_local = jnp.argmax(flat, axis=0).astype(jnp.int32)   # (P,)
+        best_val = jnp.max(flat, axis=0)                          # (P,)
+    else:
+        # the scan body (padding, per-chunk argmax, strict-">" tie merge)
+        # lives in ops/sifinder.chunked_score_argmax — ONE copy of the
+        # bit-parity contract for the unsharded and sharded tiled paths
+        num_chunks = -(-hc // row_chunk)
+        pad_rows = num_chunks * row_chunk + patch_h - 1 - r_img.shape[0]
+        r_pad = jnp.pad(r_img, ((0, pad_rows), (0, 0), (0, 0)))
+        gh_pad = jnp.pad(gh, ((0, num_chunks * row_chunk - hc), (0, 0)))
+
+        def mask_chunk(scores, r0):
+            gh_s = jax.lax.dynamic_slice(gh_pad, (r0, 0),
+                                         (row_chunk, p_count))
+            return _mask_chunk(scores, gh_s)
+
+        best_val, best_local = sifinder.chunked_score_argmax(
+            q, r_pad, hc, wl, row_chunk, mask_chunk, patch_h,
+            conv_dtype=conv_dtype, eps=eps)
     rows = best_local // wl
     cols_l = best_local % wl
     flat_global = rows * wc + col0 + cols_l                   # (P,)
@@ -123,7 +147,7 @@ def _local_search(x_dec, y_img, y_dec, gh, gw, patch_h, patch_w, img_w,
 
 def build_synthesize_shmap(mesh, patch_h: int, patch_w: int,
                            img_h: int, img_w: int, use_mask: bool = True,
-                           conv_dtype=None):
+                           conv_dtype=None, row_chunk: Optional[int] = None):
     """Un-jitted shard_map'd (x_dec, y_img, y_dec) -> y_syn for composing
     into larger jitted programs (e.g. the spatial inference step). Inputs
     are interpreted as: batch over 'data', y width over 'spatial', x_dec
@@ -160,7 +184,8 @@ def build_synthesize_shmap(mesh, patch_h: int, patch_w: int,
 
     def per_shard(x_dec, y_img, y_dec, gh_, gw_):
         fn = partial(_local_search, gh=gh_, gw=gw_, patch_h=patch_h,
-                     patch_w=patch_w, img_w=img_w, conv_dtype=conv_dtype)
+                     patch_w=patch_w, img_w=img_w, conv_dtype=conv_dtype,
+                     row_chunk=row_chunk)
         return jax.vmap(fn)(x_dec, y_img, y_dec)
 
     shmap = jax.shard_map(
@@ -213,8 +238,12 @@ def make_spatial_inference_step(model, mesh, img_h: int, img_w: int):
         "no siNet — use step.make_inference_step")
     ph, pw = cfg.y_patch_size
     use_mask = bool(cfg.use_gauss_mask)
+    row_chunk = (sifinder.sifinder_row_chunk(cfg)
+                 if getattr(cfg, "sifinder_impl", "auto") == "xla_tiled"
+                 else None)
     syn = build_synthesize_shmap(mesh, ph, pw, img_h, img_w, use_mask,
-                                 conv_dtype=sifinder.sifinder_conv_dtype(cfg))
+                                 conv_dtype=sifinder.sifinder_conv_dtype(cfg),
+                                 row_chunk=row_chunk)
 
     repl = NamedSharding(mesh, P())
     img_sh = NamedSharding(mesh, P(DATA_AXIS, None, SPATIAL_AXIS, None))

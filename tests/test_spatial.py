@@ -234,3 +234,19 @@ def test_spatial_train_step_gradient_parity(mesh):
         rel = (np.linalg.norm(pvec(g_sp, part) - pvec(g_ref, part))
                / (np.linalg.norm(pvec(g_ref, part)) + 1e-12))
         assert rel < 1e-5, (part, rel)
+
+
+@pytest.mark.parametrize("row_chunk", [3, 8])
+def test_sharded_tiled_matches_unsharded(mesh, row_chunk):
+    """Width sharding composed with row tiling (row_chunk) must still be
+    bit-identical to the unsharded materialized search — sharding and
+    tiling multiply into the very-large-extent configuration."""
+    x, y = _pair(11)
+    mask = jnp.asarray(sifinder.gaussian_position_mask(H, W, PH, PW))
+    ref = jax.vmap(lambda a, b, c: sifinder.search_single(
+        a, b, c, mask=mask, patch_h=PH, patch_w=PW,
+        use_l2=False).y_syn)(x, y, y)
+    fn = spatial.build_synthesize_shmap(mesh, PH, PW, H, W, use_mask=True,
+                                        row_chunk=row_chunk)
+    got = jax.jit(fn)(x, y, y)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
