@@ -90,6 +90,7 @@ class Experiment:
 
         n_dev = jax.local_device_count()
         spatial = int(ae_config.get("spatial_shards", 1))
+        grad_accum = int(ae_config.get("grad_accum_steps", 1) or 1)
         if use_mesh is None:
             use_mesh = (spatial > 1
                         or (n_dev > 1 and ae_config.batch_size % n_dev == 0))
@@ -114,7 +115,8 @@ class Experiment:
                                            spatial=spatial)
             self.state = mesh_lib.replicate_state(self.mesh, self.state)
             self.train_step = dp.make_spatial_train_step(
-                self.model, self.tx, self.mesh, ch, cw)
+                self.model, self.tx, self.mesh, ch, cw,
+                grad_accum=grad_accum)
             self.val_step = dp.make_spatial_eval_step(
                 self.model, self.mesh, ch, cw)
             self._put = lambda x, y: mesh_lib.shard_images(self.mesh, x, y)
@@ -124,13 +126,15 @@ class Experiment:
             self.mesh = mesh_lib.make_mesh()
             self.state = mesh_lib.replicate_state(self.mesh, self.state)
             self.train_step = dp.make_sharded_train_step(
-                self.model, self.tx, self.mesh, si_mask=self.train_mask)
+                self.model, self.tx, self.mesh, si_mask=self.train_mask,
+                grad_accum=grad_accum)
             self.val_step = dp.make_sharded_eval_step(
                 self.model, self.mesh, si_mask=self.train_mask)
             self._put = lambda x, y: mesh_lib.shard_batch(self.mesh, x, y)
         else:
             self.train_step = step_lib.make_train_step(
-                self.model, self.tx, si_mask=self.train_mask)
+                self.model, self.tx, si_mask=self.train_mask,
+                grad_accum=grad_accum)
             self.val_step = step_lib.make_eval_step(
                 self.model, si_mask=self.train_mask)
             self._put = lambda x, y: (jnp.asarray(x), jnp.asarray(y))

@@ -24,9 +24,14 @@ from dsin_tpu.train import step as step_lib
 
 def make_sharded_train_step(model: DSIN, tx: optax.GradientTransformation,
                             mesh, si_mask: Optional[jnp.ndarray] = None,
-                            donate: bool = True):
-    """(state, x, y) -> (state, metrics), batch sharded over 'data'."""
-    fn = step_lib.build_train_step_fn(model, tx, si_mask)
+                            donate: bool = True, grad_accum: int = 1):
+    """(state, x, y) -> (state, metrics), batch sharded over 'data'.
+    `grad_accum` micro-batches the GLOBAL batch with strided micros (see
+    step.build_train_step_fn), so every micro stays spread over all 'data'
+    shards with no resharding; each micro's gradient all-reduce rides the
+    same GSPMD insertion."""
+    fn = step_lib.build_train_step_fn(model, tx, si_mask,
+                                      grad_accum=grad_accum)
     repl = mesh_lib.replicated(mesh)
     batch = mesh_lib.batch_sharding(mesh)
     return jax.jit(
@@ -81,7 +86,7 @@ def make_spatial_eval_step(model: DSIN, mesh, img_h: int, img_w: int):
 
 def make_spatial_train_step(model: DSIN, tx: optax.GradientTransformation,
                             mesh, img_h: int, img_w: int,
-                            donate: bool = True):
+                            donate: bool = True, grad_accum: int = 1):
     """Width-sharded FULL training step over a (data, spatial) mesh — the
     large-extent training path (SURVEY §5: Cityscapes-and-beyond crops whose
     score map / activations exceed one chip):
@@ -104,7 +109,8 @@ def make_spatial_train_step(model: DSIN, tx: optax.GradientTransformation,
         "search — use make_sharded_train_step (GSPMD shards its convs)")
     syn = _build_spatial_syn(model, mesh, img_h, img_w)
     fn = step_lib.build_train_step_fn(model, tx, si_mask=None,
-                                      synthesize_fn=syn)
+                                      synthesize_fn=syn,
+                                      grad_accum=grad_accum)
     repl = mesh_lib.replicated(mesh)
     img_sh = mesh_lib.image_sharding(mesh)
     return jax.jit(

@@ -140,41 +140,95 @@ def _scalar_metrics(loss, aux):
 
 def build_train_step_fn(model: DSIN, tx: optax.GradientTransformation,
                         si_mask: Optional[jnp.ndarray] = None,
-                        synthesize_fn=None):
+                        synthesize_fn=None, grad_accum: int = 1):
     """The un-jitted train step (state, x, y) -> (state, metrics) — callers
-    wrap it in `jax.jit` (single chip) or jit-with-shardings (mesh)."""
+    wrap it in `jax.jit` (single chip) or jit-with-shardings (mesh).
+
+    `grad_accum > 1` splits the leading batch axis into that many
+    micro-batches, accumulates their gradients in a `lax.scan`, and applies
+    ONE optimizer update — peak activation memory scales with the
+    micro-batch while the update sees the accumulated gradient. The loss's
+    batch reductions are means (and the SI /batch rule divides by the
+    *static* config batch size, losses.py), so the averaged micro
+    gradients equal the full-batch gradient exactly whenever the forward
+    is per-example — which BatchNorm in train mode is not (it normalizes
+    by the micro-batch's own statistics; the usual grad-accum caveat in
+    every framework). BN batch_stats chain sequentially through the
+    micro-batches (same semantics as running the micros as consecutive
+    reference steps); metrics are averaged."""
     update_bn = model.ae_config.get("bn_stats", "update") == "update"
 
-    def train_step(state: TrainState, x, y):
-        def loss_fn(params):
-            return _forward_losses(model, params, state.batch_stats, x, y,
+    def grads_and_aux(params, batch_stats, x, y):
+        def loss_fn(p):
+            return _forward_losses(model, p, batch_stats, x, y,
                                    si_mask, train=True,
                                    collect_mutations=update_bn,
                                    synthesize_fn=synthesize_fn)
-
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params)
+            params)
+        return loss, aux, grads
+
+    def new_stats(aux, batch_stats):
+        if update_bn:
+            return {"encoder": aux["enc_mut"]["batch_stats"],
+                    "decoder": aux["dec_mut"]["batch_stats"]}
+        return batch_stats
+
+    def train_step(state: TrainState, x, y):
+        if grad_accum == 1:
+            loss, aux, grads = grads_and_aux(state.params, state.batch_stats,
+                                             x, y)
+            batch_stats = new_stats(aux, state.batch_stats)
+            metrics = _scalar_metrics(loss, aux)
+        else:
+            b = x.shape[0]
+            assert b % grad_accum == 0, (
+                f"batch {b} not divisible by grad_accum {grad_accum}")
+            micro = b // grad_accum
+            # STRIDED micro-batches (micro k = rows k::grad_accum), not
+            # contiguous slices: under data-parallel sharding the batch
+            # axis is block-sharded across devices, so contiguous micros
+            # would each live on a fraction of the mesh and force a
+            # per-step reshard; strided micros keep every micro spread
+            # over all shards
+            xs = jnp.swapaxes(x.reshape(micro, grad_accum, *x.shape[1:]),
+                              0, 1)
+            ys = jnp.swapaxes(y.reshape(micro, grad_accum, *y.shape[1:]),
+                              0, 1)
+
+            def body(carry, xy):
+                stats, grad_sum, metric_sum = carry
+                loss, aux, grads = grads_and_aux(state.params, stats, *xy)
+                grad_sum = jax.tree_util.tree_map(jnp.add, grad_sum, grads)
+                m = _scalar_metrics(loss, aux)
+                metric_sum = {k: metric_sum[k] + m[k] for k in metric_sum}
+                return (new_stats(aux, stats), grad_sum, metric_sum), None
+
+            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zero_metrics = {k: jnp.float32(0.0)
+                            for k in list(SCALAR_METRICS) + ["loss"]}
+            (batch_stats, grad_sum, metric_sum), _ = jax.lax.scan(
+                body, (state.batch_stats, zero_grads, zero_metrics),
+                (xs, ys))
+            inv = 1.0 / grad_accum
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
+            metrics = {k: v * inv for k, v in metric_sum.items()}
+
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-
-        if update_bn:
-            batch_stats = {"encoder": aux["enc_mut"]["batch_stats"],
-                           "decoder": aux["dec_mut"]["batch_stats"]}
-        else:
-            batch_stats = state.batch_stats
-
         new_state = TrainState(params=params, batch_stats=batch_stats,
                                opt_state=opt_state, step=state.step + 1)
-        return new_state, _scalar_metrics(loss, aux)
+        return new_state, metrics
 
     return train_step
 
 
 def make_train_step(model: DSIN, tx: optax.GradientTransformation,
                     si_mask: Optional[jnp.ndarray] = None,
-                    donate: bool = True):
+                    donate: bool = True, grad_accum: int = 1):
     """Build the jitted single-chip train step: (state, x, y) -> (state, metrics)."""
-    train_step = build_train_step_fn(model, tx, si_mask)
+    train_step = build_train_step_fn(model, tx, si_mask,
+                                     grad_accum=grad_accum)
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 
