@@ -46,6 +46,15 @@ def main(argv=None) -> int:
 
     curve = {
         "dataset": "synthetic stereo corpus (data/synthetic.py)",
+        "note": ("Identical ae_only entries across different targets are "
+                 "expected, not a bug: the rate penalty beta*max(H - "
+                 "H_target, 0) has an H_target-independent gradient while "
+                 "H remains above the target, so with deterministic "
+                 "seeding two targets that both stay unreached in phase 1 "
+                 "produce bit-identical AE trajectories. The points "
+                 "diverge (in phase 2 here) once the looser target is "
+                 "crossed and its penalty switches off - the visible RD "
+                 "tradeoff."),
         "points": points,
         # each series sorted by MEASURED bpp (target order can invert near
         # rate-target saturation, which would make the plot zigzag)
