@@ -1,0 +1,36 @@
+"""tools/aggregate_rd.py: curve assembly from per-point artifacts."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_aggregate_rd_sorts_by_measured_bpp(tmp_path):
+    for name, target, bpp, psnr in (("a", 0.04, 0.30, 24.0),
+                                    ("b", 0.08, 0.20, 22.0)):
+        d = tmp_path / f"rd_synthetic_{name}"
+        d.mkdir()
+        (d / "rd_synthetic.json").write_text(json.dumps({
+            "target_bpp": target,
+            "ae_only_test": {"bpp": bpp, "psnr": psnr, "ms_ssim": 0.9,
+                             "l1": 10.0},
+            "with_si_test": {"bpp": bpp / 2, "psnr": psnr + 3,
+                             "ms_ssim": 0.95, "l1": 7.0},
+        }))
+    out = tmp_path / "rd_curve.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "aggregate_rd.py"),
+         "--glob", str(tmp_path / "rd_synthetic_*" / "rd_synthetic.json"),
+         "--out", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    curve = json.loads(out.read_text())
+    assert len(curve["points"]) == 2
+    # series sorted by MEASURED bpp: target order (a=0.04 -> 0.30 bpp)
+    # inverts, so point b (0.20 bpp) must come first
+    bpps = [e["bpp"] for e in curve["series"]["ae_only"]]
+    assert bpps == sorted(bpps), bpps
+    assert bpps[0] == 0.20
