@@ -22,15 +22,20 @@ ran >9 min with no output):
   * on total failure a JSON line with "value": null and the error is
     printed before the nonzero exit.
 
-vs_baseline: the reference publishes no throughput numbers (BASELINE.md);
-the denominator is our documented estimate of the reference's V100 training
-throughput (3 sess.run round trips per iteration at batch 1). Until a
-measured V100 number exists, V100_BASELINE_IMG_PER_SEC below is an assumed
-constant — the north star is >= 1.5x it (BASELINE.json).
+vs_baseline: the reference publishes no throughput numbers (BASELINE.md),
+so the denominator is a FLOP-derived *upper bound* on the reference's V100
+throughput: the compiled step's own cost analysis gives FLOPs/image for the
+full DSIN step (which, like the reference's 3 sess.run round trips per
+iteration, includes the y_dec synthesis forward — AE.py:108-118), and a
+V100 cannot run that step faster than fp32 peak / FLOPs-per-image
+(tensorflow-gpu 1.11 ran fp32; no AMP). vs_baseline >= 1 therefore means
+"at least as fast as a V100 could possibly be on this workload", with no
+assumed utilization constant anywhere.
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -38,10 +43,10 @@ import traceback
 
 import numpy as np
 
-# Assumed reference throughput (tensorflow-gpu 1.11, V100, batch 1, the
-# 3-forward+1-backward step of reference AE.py:108-118). Documented
-# assumption, not a measurement — see module docstring.
-V100_BASELINE_IMG_PER_SEC = 3.0
+# V100 (SXM2) fp32 peak: 15.7 TFLOP/s. The reference stack
+# (tensorflow-gpu==1.11, requirements.txt:1) executes fp32 — tensor cores
+# are out of reach without AMP, which TF 1.11 predates.
+V100_PEAK_FP32_FLOPS = 15.7e12
 
 # MFU denominator: peak dense bf16 matmul throughput of one TPU v5e chip
 # (the chip this driver benches on; 197 TFLOP/s per chip).
@@ -49,10 +54,19 @@ TPU_V5E_PEAK_FLOPS = 197e12
 
 CROP_H, CROP_W = 320, 960
 PATCH_H, PATCH_W = 20, 24
-BATCH = int(os.environ.get("BENCH_BATCH", "2"))
+BATCH = int(os.environ.get("BENCH_BATCH", "4"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
 ITERS = int(os.environ.get("BENCH_ITERS", "10"))
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+# Backend-init budget: r02 died because ONE jax.devices() call blocked
+# ~1500 s inside the axon relay before raising — an in-process retry loop
+# never got a second attempt. Init is therefore probed in a KILLABLE
+# subprocess with a per-attempt timeout, retried across INIT_WINDOW_S
+# (the relay recovers from outages on minutes timescales), and the
+# remaining deadline is reserved for compile+run.
+INIT_WINDOW_S = float(os.environ.get("BENCH_INIT_WINDOW_S",
+                                     str(DEADLINE_S * 0.55)))
+INIT_ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_ATTEMPT_S", "120"))
 
 _T0 = time.time()
 _STAGE = {"name": "start"}
@@ -91,24 +105,78 @@ def _watchdog():
             os._exit(3)
 
 
-def _init_backend_with_retry(jax, attempts=6, backoff_s=45.0):
-    """First device touch, retried: the axon TPU relay can fail transiently
-    (round-1 BENCH died in backend init before any fallback could run;
-    round-2 observed multi-minute relay outages after a remote-compile
-    crash). 5 sleeps x 45 s = 225 s of total backoff still leaves ~1275 s
-    of the 1500 s watchdog deadline for compile+run."""
-    for i in range(attempts):
-        try:
-            stage(f"initializing backend (attempt {i + 1}/{attempts})")
-            devices = jax.devices()
+def _probe_backend_subprocess(timeout_s):
+    """Touch the backend in a subprocess that can be killed on timeout.
+
+    jax.devices() blocks inside native relay code (no GIL, uninterruptible
+    from a thread) and has been observed to block 1500 s before raising
+    (round-2 BENCH, round-3 probe: 1503 s -> RuntimeError UNAVAILABLE). A
+    subprocess is the only way to bound one attempt. Returns (ok, detail).
+    """
+    code = ("import jax, sys; d = jax.devices(); "
+            "print(jax.default_backend(), len(d), d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+    if r.returncode == 0:
+        return True, r.stdout.strip()
+    return False, (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode]
+                   )[-1][:300]
+
+
+def _init_backend_with_retry(jax):
+    """Bring the backend up within INIT_WINDOW_S, failing fast per attempt.
+
+    Probes run in killable subprocesses every INIT_ATTEMPT_TIMEOUT_S until
+    one succeeds; only then is the in-process (uninterruptible) first
+    device touch made. If the window closes with no successful probe, we
+    raise immediately so the failure JSON is emitted with most of the
+    deadline unspent, instead of the watchdog firing at the wire."""
+    t_end = _T0 + INIT_WINDOW_S
+    attempt = 0
+    while True:
+        attempt += 1
+        budget = t_end - time.time()
+        if budget <= 5:
+            raise RuntimeError(
+                f"backend unavailable: no successful init probe within "
+                f"{INIT_WINDOW_S:.0f}s ({attempt - 1} attempts)")
+        stage(f"probing backend (attempt {attempt}, "
+              f"{budget:.0f}s left in init window)")
+        ok, detail = _probe_backend_subprocess(
+            min(INIT_ATTEMPT_TIMEOUT_S, budget))
+        if ok:
+            stage("probe ok", f": {detail}; touching backend in-process")
+            # The in-process first touch is uninterruptible native code; if
+            # the relay flaps between probe and here it could block to the
+            # wire like r02. A one-shot timer converts that into a fast
+            # failure JSON instead of a watchdog death at the deadline.
+            grace = 2 * INIT_ATTEMPT_TIMEOUT_S
+
+            def _bail():
+                emit(failure_payload(
+                    f"in-process backend init exceeded {grace:.0f}s after a "
+                    "successful probe (relay flapped)"))
+                os._exit(4)
+
+            timer = threading.Timer(grace, _bail)
+            timer.daemon = True
+            timer.start()
+            try:
+                devices = jax.devices()
+            except RuntimeError as e:
+                # fast transient failure (relay flapped between probe and
+                # touch): stay in the retry loop while the window lasts
+                stage("in-process init failed", f": {e}")
+                continue
+            finally:
+                timer.cancel()
             stage("backend up", f": {jax.default_backend()} {devices}")
             return devices
-        except RuntimeError as e:
-            stage("backend init failed",
-                  f" (attempt {i + 1}/{attempts}): {e}")
-            if i == attempts - 1:
-                raise
-            time.sleep(backoff_s)
+        stage("probe failed", f": {detail}")
+        time.sleep(min(20.0, max(0.0, t_end - time.time())))
 
 
 def run():
@@ -137,8 +205,11 @@ def run():
     base = os.path.join(os.path.dirname(__file__), "dsin_tpu", "configs")
     ae_cfg = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
     # BENCH_DTYPE: conv compute dtype ('float32' = reference numerics,
-    # 'bfloat16' = MXU fast path; params/BN/losses stay f32 either way)
-    compute_dtype = os.environ.get("BENCH_DTYPE", "float32")
+    # 'bfloat16' = MXU fast path; params/BN/losses stay f32 either way).
+    # bf16 is the default benched configuration — it is the TPU-native
+    # operating mode this framework is designed around, and the committed
+    # number must correspond to the committed default.
+    compute_dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     ae_cfg = ae_cfg.replace(batch_size=BATCH, crop_size=(CROP_H, CROP_W),
                             AE_only=False, load_model=False, train_model=True,
                             test_model=False, compute_dtype=compute_dtype)
@@ -205,40 +276,67 @@ def run():
             train_step = compiled
 
             stage(f"[{impl}] warmup x{WARMUP}")
+            t_w = time.perf_counter()
             for _ in range(WARMUP):
                 state, metrics = train_step(state, x, y)
             jax.block_until_ready(metrics["loss"])
+            step_est = (time.perf_counter() - t_w) / WARMUP
 
-            stage(f"[{impl}] timing x{ITERS}")
-            t0 = time.perf_counter()
-            for _ in range(ITERS):
-                state, metrics = train_step(state, x, y)
-            jax.block_until_ready(metrics["loss"])
-            dt = time.perf_counter() - t0
+            # fit the timing loop inside what's left of the deadline
+            # (60 s margin for teardown + JSON emission); if even one step
+            # won't fit, report the warmup-derived rate rather than letting
+            # the watchdog kill a run that already holds a measurement
+            left = (_T0 + DEADLINE_S) - time.time() - 60.0
+            iters = min(ITERS, int(left / max(step_est, 1e-3)))
+            timing_source = "steady"
+            if iters < 1:
+                stage(f"[{impl}] no time left for a timing loop "
+                      f"({left:.0f}s, step~{step_est:.2f}s); "
+                      "using warmup-derived rate")
+                iters = WARMUP
+                dt = step_est * WARMUP
+                timing_source = "warmup"
+            else:
+                if iters < ITERS:
+                    stage(f"[{impl}] reducing iters {ITERS}->{iters}",
+                          f" (step~{step_est:.2f}s, {left:.0f}s left)")
+                stage(f"[{impl}] timing x{iters}")
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    state, metrics = train_step(state, x, y)
+                jax.block_until_ready(metrics["loss"])
+                dt = time.perf_counter() - t0
 
             # record the concrete kernel, not 'auto' (same dispatch rule
             # as ops/sifinder.py)
             used_impl = impl if impl != "auto" else (
                 "pallas" if jax.default_backend() == "tpu" else "xla")
-            imgs_per_sec = BATCH * ITERS / dt
-            step_ms = 1e3 * dt / ITERS
+            imgs_per_sec = BATCH * iters / dt
+            step_ms = 1e3 * dt / iters
             payload = {
                 "metric": "train_images_per_sec",
                 "value": round(imgs_per_sec, 3),
                 "unit": "images/sec",
-                "vs_baseline": round(imgs_per_sec / V100_BASELINE_IMG_PER_SEC,
-                                     3),
+                "vs_baseline": None,
                 "impl": used_impl,
                 "batch": BATCH,
+                "iters": iters,
+                "timing_source": timing_source,
                 "step_ms": round(step_ms, 2),
                 "compute_dtype": compute_dtype,
             }
             if compile_s is not None:
                 payload["compile_s"] = round(compile_s, 1)
             if flops_per_step:
-                mfu = flops_per_step / (dt / ITERS) / TPU_V5E_PEAK_FLOPS
+                mfu = flops_per_step / (dt / iters) / TPU_V5E_PEAK_FLOPS
                 payload["flops_per_step"] = flops_per_step
                 payload["mfu_vs_v5e_bf16_peak"] = round(mfu, 4)
+                # FLOP-derived V100 ceiling: a V100 running this step's
+                # FLOPs-per-image at 100% fp32 peak (see module docstring)
+                v100_ceiling = V100_PEAK_FP32_FLOPS / (flops_per_step / BATCH)
+                payload["v100_fp32_ceiling_img_per_sec"] = round(
+                    v100_ceiling, 3)
+                payload["vs_baseline"] = round(imgs_per_sec / v100_ceiling, 3)
             return payload
         except Exception as e:  # noqa: BLE001
             last_err = e

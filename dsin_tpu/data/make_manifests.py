@@ -11,8 +11,14 @@ so this tool regenerates them from a local KITTI download:
     KITTI_stereo lists);
   * general mode: pair frames of the same sequence at a small temporal
     offset, cameras chosen at random — correlated but not co-instant, the
-    reference's KITTI_general lists (whose exact pairing is unpublished;
-    this is a seeded approximation with the same structure).
+    reference's KITTI_general lists. The generating rule is derived from
+    the frozen lists (see `reference_general_splits`); the reference's own
+    shuffle is unrecoverable (unseeded RNG), so our lists match the
+    reference's in pair universe, split sizes, and structure — but our
+    seeded shuffle draws a DIFFERENT val/test membership partition, so
+    metrics on these splits are not directly comparable to numbers
+    computed on the reference's frozen lists (for that, point the loader
+    at the frozen files themselves).
 
 Expected tree (any subset of the standard zips):
     <kitti_root>/data_scene_flow_multiview/{training,testing}/image_{2,3}/
@@ -28,7 +34,7 @@ from __future__ import annotations
 import argparse
 import os
 import re
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -84,6 +90,100 @@ def general_pairs(kitti_root: str, max_offset: int = 2,
             pairs.append((a.replace("image_2", cam_a),
                           b.replace("image_2", cam_b)))
     return pairs
+
+
+# The 20 evaluation sequences of the reference's KITTI_general val/test
+# lists — every pair in the frozen lists (reference data_paths/
+# KITTI_general_{val,test}.txt) draws both frames from one of these
+# `testing`-split sequences.
+REFERENCE_GENERAL_EVAL_SEQS: Dict[str, Tuple[str, ...]] = {
+    "data_scene_flow_multiview": (
+        "000029", "000079", "000085", "000100", "000105",
+        "000110", "000129", "000150", "000158", "000175"),
+    "data_stereo_flow_multiview": (
+        "000004", "000033", "000041", "000044", "000049",
+        "000052", "000122", "000158", "000166", "000167"),
+}
+GENERAL_MAX_OFFSET = 3
+GENERAL_VAL_FRAC = 0.2
+GENERAL_HOLDOUT_GAP = 41
+
+
+def general_pair_universe(kitti_root: str,
+                          split: str,
+                          seqs: Optional[Dict[str, Tuple[str, ...]]] = None,
+                          max_offset: int = GENERAL_MAX_OFFSET
+                          ) -> List[Tuple[str, str]]:
+    """Every ordered same-sequence pair at temporal offset ±1..max_offset,
+    in both camera orientations, in canonical enumeration order (subset
+    alphabetical, sequence ascending, x-frame ascending, offset -3..+3,
+    orientation (x=image_2) before (x=image_3)).
+
+    This is the pair universe underlying the reference's KITTI_general
+    val/test lists: their union covers 4519 of exactly these 4560 pairs
+    for the 20 eval sequences, and nothing outside it. `seqs` restricts to
+    {subset: (seq, ...)}; None takes every sequence found under `split`.
+    Both frames must exist in both cameras.
+    """
+    universe: List[Tuple[str, str]] = []
+    scan = _scan(kitti_root)
+    for (subset, sp, seq), frames in sorted(scan.items()):
+        if sp != split:
+            continue
+        if seqs is not None and seq not in seqs.get(subset, ()):
+            continue
+        for fx in sorted(frames):
+            for off in range(-max_offset, max_offset + 1):
+                if off == 0 or fx + off not in frames:
+                    continue
+                a, b = frames[fx], frames[fx + off]
+                universe.append((a, b.replace("image_2", "image_3")))
+                universe.append((a.replace("image_2", "image_3"), b))
+    return universe
+
+
+def reference_general_splits(kitti_root: str, seed: int = 0
+                             ) -> Dict[str, List[Tuple[str, str]]]:
+    """The reference's KITTI_general split rule, derived from its frozen
+    lists (reference data_paths/KITTI_general_{val,test}.txt, 912/3607
+    pairs; KITTI_general_train.txt is stripped upstream):
+
+      * eval pair universe = the 20 fixed `testing`-split sequences
+        (REFERENCE_GENERAL_EVAL_SEQS) x ordered frame pairs at temporal
+        offset ±1..3 (within the 21 frames) x both camera orientations
+        = 4560 ordered pairs; verified: frozen val ∪ test is 4519 of
+        exactly these pairs, and the two lists are disjoint;
+      * the universe is shuffled; val = the first 20% (912 — the frozen
+        val size is int(0.2 * 4560) exactly), the next 41 pairs are
+        discarded (the frozen test list covers all but 41 of the
+        remainder, and those 41 are uniformly spread — a small dropped
+        slice, not any file- or structure-dependent filter), test = the
+        remaining 3607;
+      * train = the same universe construction over every
+        `training`-split sequence of both subsets, shuffled.
+
+    Reproducing the frozen lists themselves is impossible in principle:
+    both the line order AND the val/test membership partition are one raw
+    RNG draw that no structural rule pins down, and searches over seeded
+    MT19937 / PCG64 / python-random shuffle and sampling procedures found
+    no generating seed — consistent with an unseeded shuffle at creation
+    time. This function's seeded shuffle therefore yields a *different*
+    (equally valid) membership draw; users wanting the reference's exact
+    eval sample should load the frozen files directly. Everything
+    derivable — universe, sizes, split fractions, disjointness — is
+    reproduced and pinned by tests (tests/test_make_manifests.py).
+    """
+    rng = np.random.default_rng(seed)
+    universe = general_pair_universe(kitti_root, "testing",
+                                     REFERENCE_GENERAL_EVAL_SEQS)
+    order = rng.permutation(len(universe))
+    n_val = int(len(universe) * GENERAL_VAL_FRAC)
+    gap = GENERAL_HOLDOUT_GAP if len(universe) > GENERAL_HOLDOUT_GAP else 0
+    val = [universe[i] for i in order[:n_val]]
+    test = [universe[i] for i in order[n_val + gap:]]
+    train_univ = general_pair_universe(kitti_root, "training")
+    train = [train_univ[i] for i in rng.permutation(len(train_univ))]
+    return {"train": train, "val": val, "test": test}
 
 
 def reference_stereo_splits(kitti_root: str) -> Dict[str, List[Tuple[str, str]]]:
@@ -155,32 +255,47 @@ def main(argv=None) -> None:
     p.add_argument("--mode", choices=("stereo", "general"), default="stereo")
     p.add_argument("--split_rule", choices=("reference", "random"),
                    default="reference",
-                   help="'reference' (stereo mode only) reproduces the "
-                        "reference's frozen 1576/790/790 lists exactly; "
-                        "'random' is a seeded fractional split over all "
-                        "frames")
-    p.add_argument("--val_frac", type=float, default=0.2)
-    p.add_argument("--test_frac", type=float, default=0.2)
-    p.add_argument("--max_offset", type=int, default=2)
+                   help="'reference' reproduces the reference's frozen "
+                        "lists: stereo mode line-for-line (1576/790/790); "
+                        "general mode by derived rule (912/3607 eval pairs "
+                        "over the same universe, but a different seeded "
+                        "membership draw — the reference's unseeded "
+                        "shuffle is unrecoverable; load the frozen files "
+                        "for its exact eval sample). 'random' is a seeded "
+                        "fractional split over all frames")
+    p.add_argument("--val_frac", type=float, default=None,
+                   help="random rule only (default 0.2); the reference "
+                        "rule's splits are fixed by derivation")
+    p.add_argument("--test_frac", type=float, default=None,
+                   help="random rule only (default 0.2)")
+    p.add_argument("--max_offset", type=int, default=None,
+                   help="random general rule only (default 2); the "
+                        "reference general rule is fixed at ±3")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
-    if args.mode == "stereo" and args.split_rule == "reference":
-        splits = reference_stereo_splits(args.kitti_root)
-        if not any(splits.values()):
+    if args.split_rule == "reference":
+        ignored = [name for name, v in (("--val_frac", args.val_frac),
+                                        ("--test_frac", args.test_frac),
+                                        ("--max_offset", args.max_offset))
+                   if v is not None]
+        if ignored:
             raise SystemExit(
-                f"no image_2/image_3 pairs under {args.kitti_root}")
-        for split, split_list in splits.items():
-            out = os.path.join(args.out_dir, f"KITTI_stereo_{split}.txt")
-            write_manifest(out, split_list)
-            print(f"{out}: {len(split_list)} pairs")
-        return
-
-    pairs = (stereo_pairs(args.kitti_root) if args.mode == "stereo"
-             else general_pairs(args.kitti_root, args.max_offset, args.seed))
-    if not pairs:
+                f"{', '.join(ignored)} cannot be combined with "
+                "--split_rule reference (its splits are fixed by the "
+                "derived rule); use --split_rule random")
+        splits = (reference_stereo_splits(args.kitti_root)
+                  if args.mode == "stereo"
+                  else reference_general_splits(args.kitti_root, args.seed))
+    else:
+        max_offset = 2 if args.max_offset is None else args.max_offset
+        pairs = (stereo_pairs(args.kitti_root) if args.mode == "stereo"
+                 else general_pairs(args.kitti_root, max_offset, args.seed))
+        splits = split_pairs(
+            pairs, 0.2 if args.val_frac is None else args.val_frac,
+            0.2 if args.test_frac is None else args.test_frac, args.seed)
+    if not any(splits.values()):
         raise SystemExit(f"no image_2/image_3 pairs under {args.kitti_root}")
-    splits = split_pairs(pairs, args.val_frac, args.test_frac, args.seed)
     for split, split_list in splits.items():
         out = os.path.join(args.out_dir,
                            f"KITTI_{args.mode}_{split}.txt")

@@ -270,9 +270,10 @@ class Experiment:
                 ve = get_validate_every(i, iterations, cfg.validate_every,
                                         cfg.get("decrease_val_steps", True))
                 if (i + 1) % ve == 0 or i + 1 == iterations:
-                    val_loss = self.validate(
-                        self._dataset("val", train=False).batches(loop=False),
-                        max_batches=max_val_batches)
+                    with self._dataset("val", train=False) as val_ds:
+                        val_loss = self.validate(
+                            val_ds.batches(loop=False),
+                            max_batches=max_val_batches)
                     val_losses.append(val_loss)
                     improved = val_loss < best_val
                     color_print(f"[{i + 1}] val_loss={val_loss:.4f} "
@@ -339,12 +340,25 @@ class Experiment:
         (reference main.py:101-126). `real_bpp=True` additionally ENCODES
         each bottleneck with the rANS codec and reports the actual
         bitstream's bits/pixel next to the cross-entropy estimate."""
-        from dsin_tpu.eval import ScoreLists, image_output_path, save_image
+        from dsin_tpu.eval import ScoreLists
         cfg = self.ae_config
         lists = ScoreLists(self.images_dir, self.model_name)
         codec = self._bottleneck_codec() if real_bpp else None
-        for idx, (x, y) in enumerate(
-                self._dataset("test", train=False).batches(loop=False)):
+        test_ds = self._dataset("test", train=False)
+        try:
+            self._run_test_loop(test_ds, lists, codec, cfg, max_images,
+                                save_images, save_plots)
+        finally:
+            test_ds.close()
+        means = lists.means()
+        if means:
+            color_print(f"test means: {means}", "magenta", bold=True)
+        return means
+
+    def _run_test_loop(self, test_ds, lists, codec, cfg, max_images,
+                       save_images, save_plots):
+        from dsin_tpu.eval import image_output_path, save_image
+        for idx, (x, y) in enumerate(test_ds.batches(loop=False)):
             if max_images is not None and idx >= max_images:
                 break
             out = self.infer_step(self.state, jnp.asarray(x), jnp.asarray(y))
@@ -375,10 +389,6 @@ class Experiment:
             color_print(f"test[{idx}] bpp={bpp:.4f} "
                         f"psnr={scores['psnr']:.2f} "
                         f"msssim={scores['ms_ssim']:.4f}", "blue")
-        means = lists.means()
-        if means:
-            color_print(f"test means: {means}", "magenta", bold=True)
-        return means
 
 
 def run(ae_config: Config, pc_config: Config, out_root: str = ".",

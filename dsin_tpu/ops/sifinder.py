@@ -102,18 +102,20 @@ def gaussian_position_mask_factors(img_h: int, img_w: int, patch_h: int,
 
 def standard_mask_factors(mask, img_h: int, img_w: int, patch_h: int,
                           patch_w: int):
-    """(gh, gw) if `mask` is (recognizably) the standard Gaussian prior for
-    these shapes, else None.
+    """(gh, gw) if `mask` IS the standard Gaussian prior for these shapes,
+    else None.
 
     Shared by every dispatch branch that wants to stream the prior in
     separable form instead of materializing/carrying the (Hc, Wc, P)
-    tensor. The check samples thin slices — first/middle/last rows and
-    columns — rather than rebuilding the full product (~722 MB of host
-    temporaries at the 320x960 operating point). A crafted mask equal to
-    the Gaussian on all six sampled slices but different elsewhere would be
-    misdetected; callers for whom silent substitution is unacceptable must
-    route custom masks explicitly (the tiled path row-slices them; the
-    materialized path uses them directly).
+    tensor. The genuine mask is exactly f32(gh) * f32(gw) (see
+    gaussian_position_mask), so the test is FULL exact equality — every
+    element is checked, so a custom mask can never be silently replaced by
+    the factored prior, and when the factors are returned, streaming them
+    is bit-identical to using `mask` itself. The compare runs in row
+    blocks (eager device ops): peak extra memory is one
+    (block, Wc, P) product transient (~77 MB at the 320x960 operating
+    point), never a second full (Hc, Wc, P) tensor — masks big enough to
+    need the tiled search stay checkable.
     """
     if mask is None or isinstance(mask, jax.core.Tracer):
         return None
@@ -121,17 +123,13 @@ def standard_mask_factors(mask, img_h: int, img_w: int, patch_h: int,
     hc, wc, p_count = gh.shape[0], gw.shape[0], gh.shape[1]
     if tuple(mask.shape) != (hc, wc, p_count):
         return None
-    # convert ONLY the sampled slices — np.asarray(mask) of the full tensor
-    # would itself be the ~722 MB device-to-host copy this check avoids.
-    # The genuine mask is exactly f32(gh)*f32(gw) (see
-    # gaussian_position_mask), so exact equality is the right test.
-    for h_idx in (0, hc // 2, hc - 1):
-        if not np.array_equal(np.asarray(mask[h_idx, :, :]),
-                              gh[h_idx][None, :] * gw):
-            return None
-    for w_idx in (0, wc // 2, wc - 1):
-        if not np.array_equal(np.asarray(mask[:, w_idx, :]),
-                              gh * gw[w_idx][None, :]):
+    mask_dev = jnp.asarray(mask)
+    gh_dev, gw_dev = jnp.asarray(gh), jnp.asarray(gw)
+    block = 32
+    for r0 in range(0, hc, block):
+        r1 = min(r0 + block, hc)
+        product = gh_dev[r0:r1, None, :] * gw_dev[None, :, :]
+        if not bool(jnp.array_equal(mask_dev[r0:r1], product)):
             return None
     return gh, gw
 
@@ -389,17 +387,20 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
     Implementation dispatch via `config.sifinder_impl` (default 'auto'):
       * 'xla'    — conv + materialized score map (this module);
       * 'pallas' — fused streaming kernel (ops/sifinder_pallas.py), Pearson
-        mode only. Assumes `mask` is either None or the standard
-        `gaussian_position_mask` for these shapes (the kernel rebuilds it in
-        separable form from the static shapes; a custom mask array would be
-        silently ignored — only this module's XLA path honors arbitrary
-        masks);
+        mode only. `mask` must be None or the standard
+        `gaussian_position_mask` for these shapes — verified element-for-
+        element (standard_mask_factors); a concrete custom mask raises
+        rather than being substituted. Only a *traced* mask is assumed
+        standard sight-unseen (documented kernel contract);
       * 'pallas_interpret' — same kernel, Pallas interpreter (tests on CPU);
       * 'xla_tiled' — chunked-scan search (`search_single_tiled`): XLA
         semantics, O(row_chunk·Wc·P) memory, compiles at shapes where the
         materialized map cannot (Pearson only; honors custom masks by
         row-slicing; `sifinder_row_chunk` config tunes the chunk);
-      * 'auto'   — 'pallas' on TPU backends when Pearson, else 'xla'.
+      * 'auto'   — 'pallas' on TPU backends when Pearson AND the mask is
+        kernel-compatible (None / traced / verified-standard); a concrete
+        custom mask routes to 'xla_tiled' instead (which honors it),
+        rather than erroring post-choice. Else 'xla'.
     """
     use_l2 = bool(config.use_L2andLAB)
     impl = getattr(config, "sifinder_impl", "auto")
@@ -407,11 +408,30 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
         raise ValueError(
             f"sifinder_impl={impl!r}: expected one of "
             "'auto', 'xla', 'xla_tiled', 'pallas', 'pallas_interpret'")
+
+    # the full element-for-element verification is ~10 blockwise device
+    # compares — memoize so dispatch + the chosen branch share one run
+    _factors_memo: list = []
+
+    def mask_factors():
+        if not _factors_memo:
+            _factors_memo.append(standard_mask_factors(
+                mask, x_dec.shape[1], x_dec.shape[2], patch_h, patch_w))
+        return _factors_memo[0]
+
     if impl == "auto":
-        impl = ("pallas" if (not use_l2 and
-                             jax.default_backend() == "tpu") else "xla")
+        if use_l2 or jax.default_backend() != "tpu":
+            impl = "xla"
+        elif (mask is None or isinstance(mask, jax.core.Tracer)
+              or mask_factors() is not None):
+            impl = "pallas"
+        else:
+            impl = "xla_tiled"   # custom concrete mask: row-sliced, honored
     if impl in ("pallas", "pallas_interpret"):
-        assert not use_l2, "fused siFinder kernel is Pearson-only"
+        if use_l2:
+            raise ValueError(
+                f"sifinder_impl={impl!r} is Pearson-only; use 'xla' for "
+                "use_L2andLAB")
         from dsin_tpu.ops import sifinder_pallas
         h, w = x_dec.shape[1], x_dec.shape[2]
         if mask is None:
@@ -424,7 +444,7 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
             # (documented kernel contract)
             gh, gw = gaussian_position_mask_factors(h, w, patch_h, patch_w)
         else:
-            factors = standard_mask_factors(mask, h, w, patch_h, patch_w)
+            factors = mask_factors()
             if factors is None:
                 raise ValueError(
                     "sifinder_impl='pallas' only supports the standard "
@@ -443,12 +463,14 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
             patch_h, patch_w, compute_dtype=dtype,
             interpret=(impl == "pallas_interpret"))
     if impl == "xla_tiled":
-        assert not use_l2, "tiled siFinder search is Pearson-only"
-        h, w = x_dec.shape[1], x_dec.shape[2]
+        if use_l2:
+            raise ValueError(
+                "sifinder_impl='xla_tiled' is Pearson-only; use 'xla' for "
+                "use_L2andLAB")
         # standard Gaussian prior -> stream its separable factors (the
         # combined mask IS f32(gh)*f32(gw), so results are bit-equal);
         # anything else -> row-slice the provided array per chunk
-        factors = standard_mask_factors(mask, h, w, patch_h, patch_w)
+        factors = mask_factors()
         fn = partial(search_single_tiled, patch_h=patch_h, patch_w=patch_w,
                      mask_factors=factors,
                      mask=None if factors is not None else mask,

@@ -150,12 +150,17 @@ def build_train_step_fn(model: DSIN, tx: optax.GradientTransformation,
     micro-batch while the update sees the accumulated gradient. The loss's
     batch reductions are means (and the SI /batch rule divides by the
     *static* config batch size, losses.py), so the averaged micro
-    gradients equal the full-batch gradient exactly whenever the forward
-    is per-example — which BatchNorm in train mode is not (it normalizes
-    by the micro-batch's own statistics; the usual grad-accum caveat in
-    every framework). BN batch_stats chain sequentially through the
-    micro-batches (same semantics as running the micros as consecutive
-    reference steps); metrics are averaged."""
+    gradients equal the full-batch gradient exactly whenever the loss is a
+    mean of per-example terms. Two terms are not: BatchNorm in train mode
+    (it normalizes by the micro-batch's own statistics; the usual
+    grad-accum caveat in every framework), and the rate hinge
+    pc_loss = beta * max(H_soft - H_target, 0) (losses.py) — H_soft is a
+    batch mean before the max, so when micro-batch H_soft values straddle
+    the target, some micros contribute zero penalty gradient where the
+    full batch would contribute a scaled-down nonzero one. BN batch_stats
+    chain sequentially through the micro-batches (same semantics as
+    running the micros as consecutive reference steps); metrics are
+    averaged."""
     update_bn = model.ae_config.get("bn_stats", "update") == "update"
 
     def grads_and_aux(params, batch_stats, x, y):

@@ -104,3 +104,31 @@ def test_prefetcher_propagates_errors_and_stops():
 
     pf2 = Prefetcher(iter([7]))
     assert list(pf2) == [7]
+
+
+def test_parallel_decode_bit_identical_to_inline():
+    """decode_workers only overlaps decoding; epoch order and every RNG
+    draw stay on the consumer side, so the emitted batch stream must be
+    bit-identical to inline decoding (and deterministic across runs)."""
+    pairs, decode = _fake_pairs(7)
+
+    def run(workers):
+        ds = PairDataset(pairs, CROP, batch_size=2, train=True,
+                         num_crops_per_img=2, seed=3, decode_fn=decode,
+                         decode_workers=workers)
+        it = ds.batches(loop=True)
+        return [next(it) for _ in range(6)]
+
+    inline, pooled = run(0), run(6)
+    for (xi, yi), (xp, yp) in zip(inline, pooled):
+        np.testing.assert_array_equal(xi, xp)
+        np.testing.assert_array_equal(yi, yp)
+
+
+def test_parallel_decode_eval_order_preserved():
+    pairs, decode = _fake_pairs(5)
+    ds = PairDataset(pairs, CROP, batch_size=1, train=False,
+                     decode_fn=decode, decode_workers=4)
+    got = [(int(x[0, 0, 0, 0]), int(y[0, 0, 0, 0]))
+           for x, y in ds.batches(loop=False)]
+    assert got == [(i, i + 100) for i in range(5)]

@@ -241,6 +241,7 @@ def test_resume_continues_iteration_numbering(tmp_path):
     assert int(exp2.state.step) == 4
 
 
+@pytest.mark.slow
 def test_emergency_checkpoint_on_keyboard_interrupt(tmp_path):
     """Ctrl-C / SIGINT preemption (how long TPU runs usually die) must hit
     the emergency save too — the handler catches BaseException, not just
@@ -300,6 +301,7 @@ def test_resume_seeds_best_val_from_checkpoint(tmp_path):
     assert exp3.restored_best_val == float("inf")
 
 
+@pytest.mark.slow
 def test_real_bpp_measured_bitstream_at_test_time(tmp_path):
     """test(real_bpp=True) encodes each bottleneck with the rANS codec and
     reports the ACTUAL bitstream's bits/pixel: present, finite, and close

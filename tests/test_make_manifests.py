@@ -146,3 +146,97 @@ def test_reference_split_matches_frozen_lists_exactly(tmp_path):
             (i for i, (a, b) in enumerate(zip(generated, frozen)) if a != b),
             f"lengths {len(generated)} vs {len(frozen)}")
         assert generated == frozen, f"{split}: first diff: {first_diff}"
+
+
+def _fake_kitti_general(root, n_train_seq=4):
+    """Fake tree with the reference's 20 general-eval sequences (frames
+    00..20, both cameras, testing split) plus a few training sequences."""
+    from dsin_tpu.data.make_manifests import REFERENCE_GENERAL_EVAL_SEQS
+    for subset, seqs in REFERENCE_GENERAL_EVAL_SEQS.items():
+        for cam in ("image_2", "image_3"):
+            d = os.path.join(root, subset, "testing", cam)
+            os.makedirs(d, exist_ok=True)
+            for seq in seqs:
+                for f in range(21):
+                    open(os.path.join(d, f"{seq}_{f:02d}.png"), "wb").close()
+        for cam in ("image_2", "image_3"):
+            d = os.path.join(root, subset, "training", cam)
+            os.makedirs(d, exist_ok=True)
+            for s in range(n_train_seq):
+                for f in range(21):
+                    open(os.path.join(d, f"{s:06d}_{f:02d}.png"),
+                         "wb").close()
+
+
+def test_general_universe_size_and_structure(tmp_path):
+    """20 seqs x (21 frames x 6 offsets, minus out-of-range) x 2
+    orientations = 4560 ordered pairs, all same-sequence, offset +-1..3."""
+    from dsin_tpu.data.make_manifests import (REFERENCE_GENERAL_EVAL_SEQS,
+                                              general_pair_universe)
+    root = str(tmp_path)
+    _fake_kitti_general(root)
+    univ = general_pair_universe(root, "testing",
+                                 REFERENCE_GENERAL_EVAL_SEQS)
+    assert len(univ) == 4560
+    assert len(set(univ)) == 4560
+    for x, y in univ:
+        sx, fx = os.path.basename(x)[:-4].split("_")
+        sy, fy = os.path.basename(y)[:-4].split("_")
+        assert sx == sy
+        assert 1 <= abs(int(fy) - int(fx)) <= 3
+        assert {x.split(os.sep)[-2], y.split(os.sep)[-2]} == {
+            "image_2", "image_3"}
+
+
+def test_reference_general_splits_sizes_and_disjoint(tmp_path):
+    """Derived rule: val = 912 (20% exactly), test = 3607 (rest minus the
+    41-pair discarded slice), disjoint, all inside the universe; train
+    covers the training-split sequences."""
+    from dsin_tpu.data.make_manifests import (REFERENCE_GENERAL_EVAL_SEQS,
+                                              general_pair_universe,
+                                              reference_general_splits)
+    root = str(tmp_path)
+    _fake_kitti_general(root, n_train_seq=2)
+    splits = reference_general_splits(root, seed=0)
+    assert len(splits["val"]) == 912
+    assert len(splits["test"]) == 3607
+    vs, ts = set(splits["val"]), set(splits["test"])
+    assert not (vs & ts)
+    univ = set(general_pair_universe(root, "testing",
+                                     REFERENCE_GENERAL_EVAL_SEQS))
+    assert vs <= univ and ts <= univ
+    assert len(univ - vs - ts) == 41
+    # train: both subsets' training sequences, 2 seqs x 228 pairs each
+    assert len(splits["train"]) == 2 * 2 * 228
+    assert all("training" in x for x, _ in splits["train"])
+    # determinism
+    assert splits == reference_general_splits(root, seed=0)
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_DATA_PATHS),
+                    reason="reference lists not available")
+def test_reference_general_frozen_lists_match_derived_rule(tmp_path):
+    """The frozen KITTI_general_{val,test}.txt must be exactly a
+    (912, 41-gap, 3607) partition sample of OUR universe: proves the
+    derived rule characterizes the reference lists up to the unseeded
+    shuffle order (which carries no information)."""
+    from dsin_tpu.data.make_manifests import (REFERENCE_GENERAL_EVAL_SEQS,
+                                              general_pair_universe)
+    root = str(tmp_path)
+    _fake_kitti_general(root)
+    univ = set(general_pair_universe(root, "testing",
+                                     REFERENCE_GENERAL_EVAL_SEQS))
+
+    def frozen(name):
+        with open(os.path.join(REFERENCE_DATA_PATHS, name)) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        return [(lines[i], lines[i + 1]) for i in range(0, len(lines), 2)]
+
+    val = frozen("KITTI_general_val.txt")
+    test = frozen("KITTI_general_test.txt")
+    vs, ts = set(val), set(test)
+    assert len(vs) == 912 and len(ts) == 3607
+    assert not (vs & ts)
+    assert vs <= univ and ts <= univ
+    assert len(univ - vs - ts) == 41
+    assert len(vs) == int(len(univ) * 0.2)

@@ -24,6 +24,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(_DIR, "multihost_worker.py")
 
 
+@pytest.mark.slow
 def test_two_process_distributed_train_step(tmp_path):
     outs = [str(tmp_path / f"worker{i}.json") for i in range(2)]
     env = dict(os.environ)

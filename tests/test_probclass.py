@@ -110,6 +110,7 @@ def test_auto_pad_value():
                                  centers) == 0.0
 
 
+@pytest.mark.slow
 def test_kernel_size_5_shapes():
     """The residual skip crop must track kernel_size, not hardcode K=3."""
     cfg = pc_cfg(kernel_size=5, use_centers_for_padding=False)

@@ -94,6 +94,7 @@ def test_fused_finds_planted_patch():
         x[0, pr:pr + PH, pc:pc + PW], atol=1e-3)
 
 
+@pytest.mark.slow
 def test_fused_multiple_column_tiles():
     """A map wider than one 128-lane tile forces the multi-tile path and the
     cross-tile running argmax; result must not depend on the tiling."""

@@ -71,6 +71,7 @@ def test_match_straddling_shard_boundary(mesh):
         x[0, pr:pr + PH, pc:pc + PW], atol=1e-3)
 
 
+@pytest.mark.slow
 def test_spatial_inference_step_matches_single_device(mesh):
     """Full-model width-sharded inference == unsharded inference step."""
     from test_train_step import tiny_ae_cfg, tiny_pc_cfg
@@ -111,6 +112,7 @@ def test_output_sharding(mesh):
     assert spec[0] == mesh_lib.DATA_AXIS
 
 
+@pytest.mark.slow
 def test_spatial_train_step_gradient_parity(mesh):
     """Width-sharded FULL training step == unsharded training step: same
     loss/metrics and (critically) the same updated parameters — proving the

@@ -68,6 +68,7 @@ def synthetic_batch(rng, n, h, w):
     return jnp.asarray(x), jnp.asarray(y.astype(np.float32))
 
 
+@pytest.mark.slow
 def test_ae_only_train_loss_descends():
     ae_cfg, pc_cfg = tiny_ae_cfg(), tiny_pc_cfg()
     model = DSIN(ae_cfg, pc_cfg)
@@ -275,6 +276,7 @@ def test_loss_composition_matches_reference(ae_only, train):
         assert float(metrics["si_l1"]) == 0.0
 
 
+@pytest.mark.slow
 def test_bfloat16_compute_parity_and_descent():
     """Mixed precision (compute_dtype='bfloat16'): conv matmuls in bf16,
     params/BN/losses in f32. Same params must produce a CLOSE forward (bf16
@@ -319,6 +321,7 @@ def test_bfloat16_compute_parity_and_descent():
 
 # -- gradient accumulation ----------------------------------------------------
 
+@pytest.mark.slow
 def test_grad_accum_exact_on_duplicated_microbatches():
     """With the two micro-batches holding identical data, BatchNorm's
     per-micro statistics equal the full-batch statistics, so the
@@ -359,6 +362,7 @@ def test_grad_accum_exact_on_duplicated_microbatches():
         state_a.params, state_b.params)
 
 
+@pytest.mark.slow
 def test_grad_accum_descends_full_si():
     """grad_accum=2 on distinct micro-batches, full SI path: loss descends
     and a step counts once per accumulated update."""
@@ -385,6 +389,7 @@ def test_grad_accum_descends_full_si():
     assert int(state.step) == 10
 
 
+@pytest.mark.slow
 def test_grad_accum_composes_with_data_parallel_mesh():
     """Strided micro-batches under the 8-virtual-device data mesh: the
     sharded accumulated step must compile, run, and descend."""

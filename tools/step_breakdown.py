@@ -1,0 +1,184 @@
+"""Component-level step-time breakdown at the reference operating point.
+
+Answers VERDICT weak #2 with measurements instead of adjectives: times each
+stage of the DSIN training step as its own jitted program (encoder+decoder
+forward, y_dec synthesis, siFinder search, siNet fusion, probclass bitcost,
+full forward+loss, full train step) and derives the backward+optimizer
+remainder. Optionally captures an XLA profiler trace of the warm full step
+(--profile_dir).
+
+Prints ONE JSON object (not the driver bench contract — this is an
+analysis artifact; commit its output under artifacts/).
+
+Usage:
+    python tools/step_breakdown.py [--batch 4] [--dtype bfloat16]
+        [--impl auto] [--iters 10] [--profile_dir artifacts/xla_trace]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CROP_H, CROP_W = 320, 960
+PATCH_H, PATCH_W = 20, 24
+
+
+def _time_compiled(fn_compiled, args, iters, leaf_fn):
+    """Median-of-iters wall time of an AOT-compiled program, ms."""
+    import jax
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn_compiled(*args)
+        jax.block_until_ready(leaf_fn(out))
+        times.append(time.perf_counter() - t0)
+    return 1e3 * float(np.median(times))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--impl", default="auto")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--crop", default=f"{CROP_H},{CROP_W}")
+    p.add_argument("--profile_dir", default=None)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu' for smoke runs); "
+                        "the axon site hook overrides JAX_PLATFORMS at "
+                        "import, so an env var alone cannot")
+    args = p.parse_args(argv)
+    crop_h, crop_w = (int(v) for v in args.crop.split(","))
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".cache", f"jax-{jax.default_backend()}")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import (gaussian_position_mask,
+                                       synthesize_side_image)
+    from dsin_tpu.train import optim as optim_lib
+    from dsin_tpu.train import step as step_lib
+
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    ae_cfg = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
+    ae_cfg = ae_cfg.replace(batch_size=args.batch,
+                            crop_size=(crop_h, crop_w), AE_only=False,
+                            load_model=False, train_model=True,
+                            test_model=False, compute_dtype=args.dtype,
+                            sifinder_impl=args.impl)
+    pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
+    model = DSIN(ae_cfg, pc_cfg)
+    tx = optim_lib.build_optimizer(None, ae_cfg, pc_cfg,
+                                   num_training_imgs=1576)
+
+    shape = (args.batch, crop_h, crop_w, 3)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 255, shape).astype(np.float32))
+    y = jnp.asarray(np.clip(np.asarray(x) + rng.normal(0, 4, shape),
+                            0, 255).astype(np.float32))
+    with jax.default_device(jax.devices("cpu")[0]):
+        state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                            shape, tx)
+    state = jax.device_put(state, jax.devices()[0])
+    mask = jnp.asarray(gaussian_position_mask(crop_h, crop_w,
+                                              PATCH_H, PATCH_W))
+
+    ph, pw = ae_cfg.y_patch_size
+
+    def enc_dec(params, batch_stats, img):
+        enc_out, _ = model.encode(params, batch_stats, img, train=True)
+        x_dec, _ = model.decode(params, batch_stats, enc_out.qbar,
+                                train=True)
+        return x_dec, enc_out.qbar, enc_out.symbols, enc_out.heatmap
+
+    def search(x_dec, y_img, y_dec):
+        return synthesize_side_image(x_dec=x_dec, y_img=y_img, y_dec=y_dec,
+                                     mask=mask, patch_h=ph, patch_w=pw,
+                                     config=ae_cfg)
+
+    def sinet(params, x_dec, y_syn):
+        return model.apply_sinet(params, x_dec, y_syn)
+
+    def bitcost(params, q, symbols):
+        return model.bitcost(params, q, symbols)
+
+    def fwd_loss(params, batch_stats, xx, yy):
+        loss, _ = step_lib._forward_losses(model, params, batch_stats,
+                                           xx, yy, mask, train=True,
+                                           collect_mutations=False)
+        return loss
+
+    train_step = step_lib.make_train_step(model, tx, si_mask=mask,
+                                          donate=False)
+
+    report = {"batch": args.batch, "crop": [crop_h, crop_w],
+              "compute_dtype": args.dtype, "impl": args.impl,
+              "backend": jax.default_backend(), "components_ms": {},
+              "compile_s": {}}
+
+    # prepare intermediates eagerly via jits
+    timings = {}
+
+    def run(name, fn, fn_args, leaf=lambda o: o):
+        t0 = time.perf_counter()
+        compiled = jax.jit(fn).lower(*fn_args).compile()
+        report["compile_s"][name] = round(time.perf_counter() - t0, 1)
+        for _ in range(args.warmup):
+            out = compiled(*fn_args)
+        jax.block_until_ready(leaf(out))
+        timings[name] = _time_compiled(compiled, fn_args, args.iters, leaf)
+        return out
+
+    x_dec, qbar, symbols, _ = run(
+        "ae_forward_x", enc_dec, (state.params, state.batch_stats, x),
+        leaf=lambda o: o[0])
+    y_out = run("ae_forward_ydec", enc_dec,
+                (state.params, state.batch_stats, y), leaf=lambda o: o[0])
+    y_dec = y_out[0]
+    y_syn = run("sifinder_search", search, (x_dec, y, y_dec))
+    run("sinet_fusion", sinet, (state.params, x_dec, y_syn))
+    run("probclass_bitcost", bitcost, (state.params, qbar, symbols))
+    run("full_forward_loss", fwd_loss,
+        (state.params, state.batch_stats, x, y))
+    run("full_train_step", train_step, (state, x, y),
+        leaf=lambda o: o[1]["loss"])
+
+    full = timings["full_train_step"]
+    fwd = timings["full_forward_loss"]
+    timings["derived_backward_plus_optimizer"] = full - fwd
+    report["components_ms"] = {k: round(v, 2) for k, v in timings.items()}
+    report["images_per_sec_full_step"] = round(args.batch / (full / 1e3), 3)
+
+    if args.profile_dir:
+        import jax.profiler
+        os.makedirs(args.profile_dir, exist_ok=True)
+        with jax.profiler.trace(args.profile_dir):
+            for _ in range(5):
+                out = train_step(state, x, y)
+            jax.block_until_ready(out[1]["loss"])
+        report["profile_dir"] = args.profile_dir
+
+    print(json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()
