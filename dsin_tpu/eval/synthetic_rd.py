@@ -30,11 +30,13 @@ from dsin_tpu.utils import color_print
 
 def run_3phase(ae_config, pc_config, out_root: str,
                phase1_steps=None, phase2_steps=None,
-               max_test_images=None) -> dict:
+               max_test_images=None, phase1_until_target=False,
+               rate_window=200) -> dict:
     from dsin_tpu.main import Experiment
 
     t0 = time.time()
-    results = {"config": "ae_synthetic_stereo",
+    results = {"config": os.path.basename(
+                   str(getattr(ae_config, "_name", "config"))),
                "crop": list(ae_config.crop_size),
                "eval_crop": list(ae_config.get("eval_crop_size",
                                                ae_config.crop_size)),
@@ -48,7 +50,9 @@ def run_3phase(ae_config, pc_config, out_root: str,
     exp1 = Experiment(cfg1, pc_config, out_root=out_root)
     exp1.maybe_restore()
     color_print(f"phase 1 (AE_only) -> {exp1.model_name}", "cyan", bold=True)
-    r1 = exp1.train(max_steps=phase1_steps)
+    r1 = exp1.train(max_steps=phase1_steps,
+                    until_rate_target=phase1_until_target,
+                    rate_window=rate_window)
     t1 = exp1.test(max_images=max_test_images, save_images=True)
     results["phase1"] = {"model_name": exp1.model_name, **r1}
     results["ae_only_test"] = t1
@@ -87,6 +91,12 @@ def main(argv=None) -> None:
                    help="synthetic corpus dir (generated if missing)")
     p.add_argument("--phase1_steps", type=int, default=None)
     p.add_argument("--phase2_steps", type=int, default=None)
+    p.add_argument("--phase1_until_target", action="store_true",
+                   help="stop phase 1 as soon as mean H_soft over "
+                        "--rate_window steps reaches H_target (the rate "
+                        "constraint binds) instead of guessing a step "
+                        "budget; --phase1_steps/iterations still cap it")
+    p.add_argument("--rate_window", type=int, default=200)
     p.add_argument("--max_test_images", type=int, default=None)
     p.add_argument("--H_target", type=float, default=None,
                    help="override the config's rate target (bits per "
@@ -115,7 +125,9 @@ def main(argv=None) -> None:
     run_3phase(ae_config, pc_config, args.out_root,
                phase1_steps=args.phase1_steps,
                phase2_steps=args.phase2_steps,
-               max_test_images=args.max_test_images)
+               max_test_images=args.max_test_images,
+               phase1_until_target=args.phase1_until_target,
+               rate_window=args.rate_window)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ CLI (reference main.py:214-224):
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import time
 from typing import Dict, Iterator, Optional, Tuple
@@ -197,14 +198,55 @@ class Experiment:
             losses.append(float(metrics["loss"]))
         return float(np.mean(losses)) if losses else float("inf")
 
+    def _validate_and_maybe_save(self, i: int, iterations: int,
+                                 best_val: float, val_losses, logger,
+                                 max_val_batches: Optional[int],
+                                 force_save: bool = False) -> float:
+        """One validation pass + best-val checkpointing (the scheduled-
+        validation body, shared with the rate-target early stop). Returns
+        the updated best_val. `force_save=True` writes the checkpoint even
+        without improvement — the early stop wants the weights that
+        satisfy the rate constraint, improvement or not."""
+        cfg = self.ae_config
+        with self._dataset("val", train=False) as val_ds:
+            val_loss = self.validate(val_ds.batches(loop=False),
+                                     max_batches=max_val_batches)
+        val_losses.append(val_loss)
+        improved = val_loss < best_val
+        color_print(f"[{i + 1}] val_loss={val_loss:.4f} "
+                    f"(best {min(best_val, val_loss):.4f})",
+                    "green" if improved else "yellow")
+        logger.log(i + 1, {"val_loss": val_loss})
+        if improved:
+            best_val = val_loss
+        if (improved or force_save) and cfg.get("save_model", True):
+            ckpt_lib.save_checkpoint(self.ckpt_dir, self.state,
+                                     best_val=best_val)
+            ckpt_lib.write_sidecars(
+                self.weights_root, self.model_name, cfg, self.pc_config,
+                iteration=i + 1, total_iterations=iterations,
+                best_val=best_val)
+        return best_val
+
     def train(self, max_steps: Optional[int] = None,
               max_val_batches: Optional[int] = None,
               log_path: Optional[str] = None,
-              profile_dir: Optional[str] = None) -> Dict[str, float]:
+              profile_dir: Optional[str] = None,
+              until_rate_target: bool = False,
+              rate_window: int = 200) -> Dict[str, float]:
         """The fetch→step→validate loop (reference main.py:49-91). Returns
         summary stats. `max_steps`/`max_val_batches` bound the run (tests,
         smoke runs); None = full config iterations. `profile_dir` captures
-        an XLA trace of a few warm steps there."""
+        an XLA trace of a few warm steps there.
+
+        `until_rate_target=True` stops early once the codec's defining
+        constraint binds: the mean H_soft over the last `rate_window`
+        steps <= H_target (reference Distortions_imgcomp.py:118-127 —
+        the beta-weighted hinge whose whole purpose is driving H_soft to
+        the target). Use for RD-sweep phase-1 runs whose step budget is
+        otherwise guesswork; iterations/max_steps still cap the run."""
+        if until_rate_target and rate_window < 1:
+            raise ValueError(f"rate_window must be >= 1, got {rate_window}")
         cfg = self.ae_config
         # resume iteration numbering from a restored optimizer step — the
         # reference restarts numbering on resume (SURVEY §5); here a resumed
@@ -228,6 +270,7 @@ class Experiment:
         accum: Dict[str, float] = {}
         n_accum = 0
         val_losses = []
+        h_recent: "collections.deque" = collections.deque(maxlen=rate_window)
 
         try:
             from tqdm import trange
@@ -248,6 +291,24 @@ class Experiment:
                 for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
                     accum[k] = accum.get(k, 0.0) + float(metrics[k])
                 n_accum += 1
+
+                if until_rate_target:
+                    h_recent.append(float(metrics["H_soft"]))
+                    if (len(h_recent) == rate_window
+                            and float(np.mean(h_recent)) <= cfg.H_target):
+                        color_print(
+                            f"[{i + 1}] rate target reached: mean H_soft "
+                            f"over last {rate_window} steps "
+                            f"{float(np.mean(h_recent)):.4f} <= "
+                            f"H_target {cfg.H_target}", "green", bold=True)
+                        # closing validate + FORCED save: the checkpoint
+                        # must hold the weights that satisfy the rate
+                        # constraint (phase 2 warm-starts from them), even
+                        # if an earlier noisy validation scored lower
+                        best_val = self._validate_and_maybe_save(
+                            i, iterations, best_val, val_losses, logger,
+                            max_val_batches, force_save=True)
+                        break
 
                 if (i + 1) % cfg.show_every == 0 or i + 1 == iterations:
                     means = {k: v / n_accum for k, v in accum.items()}
@@ -270,24 +331,9 @@ class Experiment:
                 ve = get_validate_every(i, iterations, cfg.validate_every,
                                         cfg.get("decrease_val_steps", True))
                 if (i + 1) % ve == 0 or i + 1 == iterations:
-                    with self._dataset("val", train=False) as val_ds:
-                        val_loss = self.validate(
-                            val_ds.batches(loop=False),
-                            max_batches=max_val_batches)
-                    val_losses.append(val_loss)
-                    improved = val_loss < best_val
-                    color_print(f"[{i + 1}] val_loss={val_loss:.4f} "
-                                f"(best {min(best_val, val_loss):.4f})",
-                                "green" if improved else "yellow")
-                    logger.log(i + 1, {"val_loss": val_loss})
-                    if improved and cfg.get("save_model", True):
-                        best_val = val_loss
-                        ckpt_lib.save_checkpoint(self.ckpt_dir, self.state,
-                                                 best_val=best_val)
-                        ckpt_lib.write_sidecars(
-                            self.weights_root, self.model_name, cfg,
-                            self.pc_config, iteration=i + 1,
-                            total_iterations=iterations, best_val=best_val)
+                    best_val = self._validate_and_maybe_save(
+                        i, iterations, best_val, val_losses, logger,
+                        max_val_batches)
         except BaseException as e:
             # emergency save: preserve the in-flight state before dying.
             # BaseException, not Exception: Ctrl-C / SIGINT-driven preemption

@@ -342,3 +342,23 @@ def test_spatial_shards_training_through_experiment(tmp_path):
     r = exp.train(max_steps=2, max_val_batches=1)
     assert r["steps"] == 2
     assert np.isfinite(r["best_val"])
+
+
+@pytest.mark.slow
+def test_until_rate_target_stops_early_and_checkpoints(tmp_path):
+    """With an H_target already satisfied at init, until_rate_target must
+    stop after rate_window steps (not the full budget) and still leave a
+    best-val checkpoint for phase-2 warm starts."""
+    from dsin_tpu.main import Experiment
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root, ae_only=True)
+    ae = ae.replace(iterations=30, H_target=50.0, validate_every=1000,
+                    test_model=False)
+    exp = Experiment(ae, pc, out_root=out)
+    r = exp.train(until_rate_target=True, rate_window=2, max_val_batches=1)
+    assert r["steps"] == 2               # stopped at the window, not 30
+    assert np.isfinite(r["best_val"])    # closing validate ran
+    ckpt = os.path.join(out, "weights", exp.model_name)
+    assert os.path.exists(os.path.join(ckpt, "params_encoder.msgpack"))

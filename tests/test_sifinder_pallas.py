@@ -164,3 +164,26 @@ class _cfg:
         self.use_L2andLAB = False
         self.sifinder_impl = impl
         self.sifinder_dtype = dtype
+
+
+def test_custom_mask_never_silently_substituted():
+    """A mask differing from the Gaussian prior anywhere (even one element)
+    must NOT be detected as standard — the exact blockwise check closes the
+    old sampling hole — and explicit pallas must reject it loudly."""
+    x, y = _rand_pair(3, batch=1)
+    mask = np.asarray(sifinder.gaussian_position_mask(H, W, PH, PW)).copy()
+    assert sifinder.standard_mask_factors(mask, H, W, PH, PW) is not None
+    mask[mask.shape[0] // 3, mask.shape[1] // 2, 5] *= 1.0001
+    assert sifinder.standard_mask_factors(mask, H, W, PH, PW) is None
+    with pytest.raises(ValueError, match="standard"):
+        sifinder.synthesize_side_image(
+            x, y, y, jnp.asarray(mask), PH, PW,
+            config=_cfg(impl="pallas_interpret", dtype="float32"))
+    # the tiled path honors the custom mask: row-sliced, same result as xla
+    ref = sifinder.synthesize_side_image(
+        x, y, y, jnp.asarray(mask), PH, PW, config=_cfg(impl="xla"))
+    tiled = sifinder.synthesize_side_image(
+        x, y, y, jnp.asarray(mask), PH, PW,
+        config=_cfg(impl="xla_tiled", dtype=None))
+    np.testing.assert_allclose(np.asarray(tiled), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
