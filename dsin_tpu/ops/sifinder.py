@@ -123,14 +123,20 @@ def standard_mask_factors(mask, img_h: int, img_w: int, patch_h: int,
     hc, wc, p_count = gh.shape[0], gw.shape[0], gh.shape[1]
     if tuple(mask.shape) != (hc, wc, p_count):
         return None
-    mask_dev = jnp.asarray(mask)
-    gh_dev, gw_dev = jnp.asarray(gh), jnp.asarray(gw)
-    block = 32
-    for r0 in range(0, hc, block):
-        r1 = min(r0 + block, hc)
-        product = gh_dev[r0:r1, None, :] * gw_dev[None, :, :]
-        if not bool(jnp.array_equal(mask_dev[r0:r1], product)):
-            return None
+    # ensure_compile_time_eval: dispatch usually runs while TRACING the
+    # caller's jit (the mask is a concrete closed-over constant, but ops
+    # on constants are staged into the trace by default, which would turn
+    # this check into an un-boolable tracer) — inside this context the
+    # concrete compare evaluates eagerly on device
+    with jax.ensure_compile_time_eval():
+        mask_dev = jnp.asarray(mask)
+        gh_dev, gw_dev = jnp.asarray(gh), jnp.asarray(gw)
+        block = 32
+        for r0 in range(0, hc, block):
+            r1 = min(r0 + block, hc)
+            product = gh_dev[r0:r1, None, :] * gw_dev[None, :, :]
+            if not bool(jnp.array_equal(mask_dev[r0:r1], product)):
+                return None
     return gh, gw
 
 

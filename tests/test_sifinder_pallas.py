@@ -6,6 +6,7 @@ small but exercise every structural feature: batch > 1, multiple column
 tiles (tile_w clamp), non-128-multiple map widths, mask / no-mask.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -186,4 +187,32 @@ def test_custom_mask_never_silently_substituted():
         x, y, y, jnp.asarray(mask), PH, PW,
         config=_cfg(impl="xla_tiled", dtype=None))
     np.testing.assert_allclose(np.asarray(tiled), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_dispatch_with_concrete_mask_inside_jit():
+    """Dispatch usually runs while tracing the caller's jit (train_step
+    closes over a concrete mask). The standard-mask verification must
+    evaluate eagerly there (ensure_compile_time_eval) — regression test
+    for the TracerBoolConversionError the r03 bench CPU fallback hit."""
+    x, y = _rand_pair(5, batch=1)
+    mask = jnp.asarray(sifinder.gaussian_position_mask(H, W, PH, PW))
+
+    out = jax.jit(lambda a, b: sifinder.synthesize_side_image(
+        a, b, b, mask, PH, PW, config=_cfg(impl="xla_tiled", dtype=None)))(
+            x, y)
+    ref = sifinder.synthesize_side_image(
+        x, y, y, mask, PH, PW, config=_cfg(impl="xla"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+    custom = np.asarray(mask).copy()
+    custom[1, 2, 3] *= 1.001
+    cmask = jnp.asarray(custom)
+    out2 = jax.jit(lambda a, b: sifinder.synthesize_side_image(
+        a, b, b, cmask, PH, PW, config=_cfg(impl="xla_tiled", dtype=None)))(
+            x, y)
+    ref2 = sifinder.synthesize_side_image(
+        x, y, y, cmask, PH, PW, config=_cfg(impl="xla"))
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                rtol=1e-5, atol=1e-4)
