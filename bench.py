@@ -223,135 +223,158 @@ def run():
                             test_model=False, compute_dtype=compute_dtype)
     pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
 
-    shape = (BATCH, CROP_H, CROP_W, 3)
-    rng = np.random.default_rng(0)
-    x_host = rng.uniform(0, 255, shape).astype(np.float32)
-    y_host = np.clip(x_host + rng.normal(0, 4, shape), 0, 255
-                     ).astype(np.float32)
-
     # explicit BENCH_SIFINDER pins the impl (no silent fallback — a broken
     # pinned impl must fail loudly, not report xla numbers as its own);
     # otherwise try the fused Pallas search first, fall back to XLA so the
     # benchmark always reports a number (and labels which impl produced it)
     pinned = os.environ.get("BENCH_SIFINDER")
     impl_order = [pinned] if pinned else ["auto", "xla"]
-    last_err = None
 
     target = jax.devices()[0]
-    for impl in impl_order:
-        try:
-            stage(f"[{impl}] building model")
-            bench_model = DSIN(ae_cfg.replace(sifinder_impl=impl), pc_cfg)
-            tx = optim_lib.build_optimizer(None, ae_cfg, pc_cfg,
-                                           num_training_imgs=1576)
-            # initialize on the LOCAL cpu backend, then transfer the state
-            # in one device_put: eager full-size init through the axon
-            # relay round-trips every op's activations over the tunnel
-            # (measured 45+ min; local init + one transfer is ~35 s)
-            stage(f"[{impl}] init state on local cpu")
-            with jax.default_device(jax.devices("cpu")[0]):
-                # fresh state per attempt: donation invalidates buffers if
-                # a prior attempt died mid-execution
-                state = step_lib.create_train_state(
-                    bench_model, jax.random.PRNGKey(0), shape, tx)
-                jax.block_until_ready(state.params["centers"])
-            stage(f"[{impl}] transferring state to {target}")
-            state = jax.device_put(state, target)
-            mask = jax.device_put(gaussian_position_mask(
-                CROP_H, CROP_W, PATCH_H, PATCH_W), target)
-            x = jax.device_put(x_host, target)
-            y = jax.device_put(y_host, target)
-            train_step = step_lib.make_train_step(bench_model, tx,
-                                                  si_mask=mask, donate=True)
 
-            # AOT-compile once and keep the executable: warmup/timing call
-
-            # `compiled` directly, so the program is never traced or
-            # compiled a second time
-            stage(f"[{impl}] compiling (first compile may take minutes; "
-                  "cached afterwards)")
-            t_c = time.perf_counter()
-            compiled = train_step.lower(state, x, y).compile()
-            compile_s = time.perf_counter() - t_c
-            flops_per_step = None
+    def attempt_all_impls(batch):
+        shape = (batch, CROP_H, CROP_W, 3)
+        rng = np.random.default_rng(0)
+        x_host = rng.uniform(0, 255, shape).astype(np.float32)
+        y_host = np.clip(x_host + rng.normal(0, 4, shape), 0, 255
+                         ).astype(np.float32)
+        cfg_b = ae_cfg.replace(batch_size=batch)
+        errs = []
+        for impl in impl_order:
             try:
-                cost = compiled.cost_analysis()
-                if isinstance(cost, (list, tuple)):
-                    cost = cost[0] if cost else {}
-                flops_per_step = float(cost.get("flops", 0.0)) or None
-            except Exception as e:  # noqa: BLE001 — cost analysis is optional
-                stage(f"[{impl}] cost analysis unavailable", f": {e!r}")
-            train_step = compiled
+                return one_attempt(cfg_b, impl, batch, shape, x_host, y_host)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+                stage(f"[{impl}] failed", f": {e!r}")
+                traceback.print_exc(file=sys.stderr)
+        # every impl's error goes into the message: the OOM-retry tier
+        # below keys off it, and an OOM in ANY impl should trigger it
+        raise RuntimeError(
+            "all sifinder impls failed: " + "; ".join(map(repr, errs)))
 
-            stage(f"[{impl}] warmup x{WARMUP}")
-            t_w = time.perf_counter()
-            for _ in range(WARMUP):
+    def one_attempt(cfg_b, impl, batch, shape, x_host, y_host):
+        stage(f"[{impl}] building model (batch {batch})")
+        bench_model = DSIN(cfg_b.replace(sifinder_impl=impl), pc_cfg)
+        tx = optim_lib.build_optimizer(None, cfg_b, pc_cfg,
+                                       num_training_imgs=1576)
+        # initialize on the LOCAL cpu backend, then transfer the state
+        # in one device_put: eager full-size init through the axon
+        # relay round-trips every op's activations over the tunnel
+        # (measured 45+ min; local init + one transfer is ~35 s)
+        stage(f"[{impl}] init state on local cpu")
+        with jax.default_device(jax.devices("cpu")[0]):
+            # fresh state per attempt: donation invalidates buffers if
+            # a prior attempt died mid-execution
+            state = step_lib.create_train_state(
+                bench_model, jax.random.PRNGKey(0), shape, tx)
+            jax.block_until_ready(state.params["centers"])
+        stage(f"[{impl}] transferring state to {target}")
+        state = jax.device_put(state, target)
+        mask = jax.device_put(gaussian_position_mask(
+            CROP_H, CROP_W, PATCH_H, PATCH_W), target)
+        x = jax.device_put(x_host, target)
+        y = jax.device_put(y_host, target)
+        train_step = step_lib.make_train_step(bench_model, tx,
+                                              si_mask=mask, donate=True)
+
+        # AOT-compile once and keep the executable: warmup/timing call
+
+        # `compiled` directly, so the program is never traced or
+        # compiled a second time
+        stage(f"[{impl}] compiling (first compile may take minutes; "
+              "cached afterwards)")
+        t_c = time.perf_counter()
+        compiled = train_step.lower(state, x, y).compile()
+        compile_s = time.perf_counter() - t_c
+        flops_per_step = None
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops_per_step = float(cost.get("flops", 0.0)) or None
+        except Exception as e:  # noqa: BLE001 — cost analysis is optional
+            stage(f"[{impl}] cost analysis unavailable", f": {e!r}")
+        train_step = compiled
+
+        stage(f"[{impl}] warmup x{WARMUP}")
+        t_w = time.perf_counter()
+        for _ in range(WARMUP):
+            state, metrics = train_step(state, x, y)
+        jax.block_until_ready(metrics["loss"])
+        step_est = (time.perf_counter() - t_w) / WARMUP
+
+        # fit the timing loop inside what's left of the deadline
+        # (60 s margin for teardown + JSON emission); if even one step
+        # won't fit, report the warmup-derived rate rather than letting
+        # the watchdog kill a run that already holds a measurement
+        left = (_T0 + DEADLINE_S) - time.time() - 60.0
+        iters = min(ITERS, int(left / max(step_est, 1e-3)))
+        timing_source = "steady"
+        if iters < 1:
+            stage(f"[{impl}] no time left for a timing loop "
+                  f"({left:.0f}s, step~{step_est:.2f}s); "
+                  "using warmup-derived rate")
+            iters = WARMUP
+            dt = step_est * WARMUP
+            timing_source = "warmup"
+        else:
+            if iters < ITERS:
+                stage(f"[{impl}] reducing iters {ITERS}->{iters}",
+                      f" (step~{step_est:.2f}s, {left:.0f}s left)")
+            stage(f"[{impl}] timing x{iters}")
+            t0 = time.perf_counter()
+            for _ in range(iters):
                 state, metrics = train_step(state, x, y)
             jax.block_until_ready(metrics["loss"])
-            step_est = (time.perf_counter() - t_w) / WARMUP
+            dt = time.perf_counter() - t0
 
-            # fit the timing loop inside what's left of the deadline
-            # (60 s margin for teardown + JSON emission); if even one step
-            # won't fit, report the warmup-derived rate rather than letting
-            # the watchdog kill a run that already holds a measurement
-            left = (_T0 + DEADLINE_S) - time.time() - 60.0
-            iters = min(ITERS, int(left / max(step_est, 1e-3)))
-            timing_source = "steady"
-            if iters < 1:
-                stage(f"[{impl}] no time left for a timing loop "
-                      f"({left:.0f}s, step~{step_est:.2f}s); "
-                      "using warmup-derived rate")
-                iters = WARMUP
-                dt = step_est * WARMUP
-                timing_source = "warmup"
-            else:
-                if iters < ITERS:
-                    stage(f"[{impl}] reducing iters {ITERS}->{iters}",
-                          f" (step~{step_est:.2f}s, {left:.0f}s left)")
-                stage(f"[{impl}] timing x{iters}")
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    state, metrics = train_step(state, x, y)
-                jax.block_until_ready(metrics["loss"])
-                dt = time.perf_counter() - t0
+        # record the concrete kernel, not 'auto' (same dispatch rule
+        # as ops/sifinder.py)
+        used_impl = impl if impl != "auto" else (
+            "pallas" if jax.default_backend() == "tpu" else "xla")
+        imgs_per_sec = batch * iters / dt
+        step_ms = 1e3 * dt / iters
+        payload = {
+            "metric": "train_images_per_sec",
+            "value": round(imgs_per_sec, 3),
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "impl": used_impl,
+            "batch": batch,
+            "crop": [CROP_H, CROP_W],
+            "iters": iters,
+            "timing_source": timing_source,
+            "step_ms": round(step_ms, 2),
+            "compute_dtype": compute_dtype,
+        }
+        if compile_s is not None:
+            payload["compile_s"] = round(compile_s, 1)
+        if flops_per_step:
+            mfu = flops_per_step / (dt / iters) / TPU_V5E_PEAK_FLOPS
+            payload["flops_per_step"] = flops_per_step
+            payload["mfu_vs_v5e_bf16_peak"] = round(mfu, 4)
+            # FLOP-derived V100 ceiling: a V100 running this step's
+            # FLOPs-per-image at 100% fp32 peak (see module docstring)
+            v100_ceiling = V100_PEAK_FP32_FLOPS / (flops_per_step / batch)
+            payload["v100_fp32_ceiling_img_per_sec"] = round(
+                v100_ceiling, 3)
+            payload["vs_baseline"] = round(imgs_per_sec / v100_ceiling, 3)
+        return payload
 
-            # record the concrete kernel, not 'auto' (same dispatch rule
-            # as ops/sifinder.py)
-            used_impl = impl if impl != "auto" else (
-                "pallas" if jax.default_backend() == "tpu" else "xla")
-            imgs_per_sec = BATCH * iters / dt
-            step_ms = 1e3 * dt / iters
-            payload = {
-                "metric": "train_images_per_sec",
-                "value": round(imgs_per_sec, 3),
-                "unit": "images/sec",
-                "vs_baseline": None,
-                "impl": used_impl,
-                "batch": BATCH,
-                "crop": [CROP_H, CROP_W],
-                "iters": iters,
-                "timing_source": timing_source,
-                "step_ms": round(step_ms, 2),
-                "compute_dtype": compute_dtype,
-            }
-            if compile_s is not None:
-                payload["compile_s"] = round(compile_s, 1)
-            if flops_per_step:
-                mfu = flops_per_step / (dt / iters) / TPU_V5E_PEAK_FLOPS
-                payload["flops_per_step"] = flops_per_step
-                payload["mfu_vs_v5e_bf16_peak"] = round(mfu, 4)
-                # FLOP-derived V100 ceiling: a V100 running this step's
-                # FLOPs-per-image at 100% fp32 peak (see module docstring)
-                v100_ceiling = V100_PEAK_FP32_FLOPS / (flops_per_step / BATCH)
-                payload["v100_fp32_ceiling_img_per_sec"] = round(
-                    v100_ceiling, 3)
-                payload["vs_baseline"] = round(imgs_per_sec / v100_ceiling, 3)
-            return payload
-        except Exception as e:  # noqa: BLE001
-            last_err = e
-            stage(f"[{impl}] failed", f": {e!r}")
-            traceback.print_exc(file=sys.stderr)
-    raise RuntimeError(f"all sifinder impls failed: {last_err!r}")
+    try:
+        return attempt_all_impls(BATCH)
+    except RuntimeError as e:
+        # one retry tier at batch 2 when the configured batch ran the chip
+        # out of memory — batch 2 is the r02-proven configuration, and a
+        # reduced-batch number beats a null artifact (payload records the
+        # actual batch)
+        memoryish = any(s in str(e) for s in ("RESOURCE_EXHAUSTED", "OOM",
+                                              "out of memory",
+                                              "Out of memory"))
+        if not memoryish or BATCH <= 2:
+            raise
+        stage(f"batch {BATCH} exhausted device memory; retrying at batch 2")
+        return attempt_all_impls(2)
 
 
 def _cpu_fallback(tpu_err):

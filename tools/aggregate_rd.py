@@ -33,11 +33,19 @@ def main(argv=None) -> int:
         with open(path) as f:
             r = json.load(f)
         entry = {"source": os.path.relpath(path, ROOT),
+                 "config": r.get("config"),
                  "target_bpp": r.get("target_bpp"),
+                 "phase1_steps": (r.get("phase1") or {}).get("steps"),
                  "ae_only": r.get("ae_only_test"),
                  "with_si": r.get("with_si_test")}
         if "with_si_test_real_bpp" in r:
             entry["with_si_real_bpp"] = r["with_si_test_real_bpp"]
+        tgt = entry["target_bpp"]
+        si = entry["with_si"]
+        if tgt and si and si.get("bpp"):
+            # the rate-control scorecard: 1.0 = measured test bpp exactly
+            # at the trained-for target
+            entry["measured_over_target"] = round(si["bpp"] / tgt, 3)
         points.append(entry)
     if not points:
         print(f"no artifacts match {args.glob}")
@@ -46,15 +54,6 @@ def main(argv=None) -> int:
 
     curve = {
         "dataset": "synthetic stereo corpus (data/synthetic.py)",
-        "note": ("Identical ae_only entries across different targets are "
-                 "expected, not a bug: the rate penalty beta*max(H - "
-                 "H_target, 0) has an H_target-independent gradient while "
-                 "H remains above the target, so with deterministic "
-                 "seeding two targets that both stay unreached in phase 1 "
-                 "produce bit-identical AE trajectories. The points "
-                 "diverge (in phase 2 here) once the looser target is "
-                 "crossed and its penalty switches off - the visible RD "
-                 "tradeoff."),
         "points": points,
         # each series sorted by MEASURED bpp (target order can invert near
         # rate-target saturation, which would make the plot zigzag)
@@ -66,6 +65,20 @@ def main(argv=None) -> int:
             for mode in ("ae_only", "with_si")
         },
     }
+    # only relevant while some phase-1 runs never reached their target:
+    # two unreached targets produce bit-identical AE trajectories (the
+    # hinge gradient is H_target-independent above the target)
+    ae_sigs = [json.dumps(e["ae_only"], sort_keys=True) for e in points
+               if e.get("ae_only")]
+    if len(ae_sigs) != len(set(ae_sigs)):
+        curve["note"] = (
+            "Identical ae_only entries across different targets mean those "
+            "phase-1 runs stopped before reaching their rate target: the "
+            "penalty beta*max(H - H_target, 0) has an H_target-independent "
+            "gradient while H remains above the target, so deterministic "
+            "seeding yields bit-identical AE trajectories. Train longer "
+            "(e.g. --phase1_until_target) to separate them.")
+
     with open(args.out, "w") as f:
         json.dump(curve, f, indent=2)
     print(f"wrote {args.out} with {len(points)} point(s)")
