@@ -102,24 +102,58 @@ def main(argv=None) -> None:
                    help="override the config's rate target (bits per "
                         "bottleneck voxel); target_bpp = H_target / "
                         "(64 / num_chan_bn) — one RD-curve point per value")
+    p.add_argument("--target_bpp", type=float, default=None,
+                   help="rate target in bits per pixel; converted to "
+                        "H_target via the config's num_chan_bn (no "
+                        "hardcoded factor). Mutually exclusive with "
+                        "--H_target")
+    p.add_argument("--iterations", type=int, default=None,
+                   help="override the config's iterations cap — without "
+                        "this, --phase*_steps beyond the config's "
+                        "`iterations` are silently clamped "
+                        "(Experiment.train caps at cfg.iterations)")
     args = p.parse_args(argv)
 
     ae_config = parse_config_file(args.ae_config)
     pc_config = parse_config_file(args.pc_config)
+    if args.H_target is not None and args.target_bpp is not None:
+        p.error("--H_target and --target_bpp are mutually exclusive")
     if args.H_target is not None:
         ae_config = ae_config.replace(H_target=args.H_target)
+    if args.target_bpp is not None:
+        from dsin_tpu.eval.rd_sweep import h_target_for_bpp
+        ae_config = ae_config.replace(H_target=h_target_for_bpp(
+            args.target_bpp, ae_config.num_chan_bn))
+    if args.iterations is not None:
+        ae_config = ae_config.replace(iterations=args.iterations)
     if args.data_dir:
         ae_config = ae_config.replace(root_data=args.data_dir)
 
     manifest = os.path.join(ae_config.root_data,
                             ae_config.file_path_train)
+    synth_manifest = os.path.join(ae_config.root_data,
+                                  "synthetic_stereo_train.txt")
+    if not os.path.exists(manifest) and os.path.exists(synth_manifest):
+        # a synthetic corpus already lives here — rewire instead of
+        # regenerating 40 full-size PNGs per invocation
+        ae_config = ae_config.replace(
+            **{f"file_path_{split}": f"synthetic_stereo_{split}.txt"
+               for split in ("train", "val", "test")})
+        manifest = synth_manifest
     if not os.path.exists(manifest):
         from dsin_tpu.data.synthetic import write_corpus
         eh, ew = ae_config.get("eval_crop_size", ae_config.crop_size)
         color_print(f"generating synthetic corpus in {ae_config.root_data}",
                     "yellow")
-        write_corpus(ae_config.root_data, num_train=40, num_val=8,
-                     num_test=8, height=eh, width=ew)
+        manifests = write_corpus(ae_config.root_data, num_train=40,
+                                 num_val=8, num_test=8, height=eh, width=ew)
+        # point the config at the manifests actually generated — a config
+        # naming KITTI manifests (e.g. ae_kitti_stereo at the reference
+        # geometry) would otherwise FileNotFoundError after generating a
+        # corpus it then ignores
+        ae_config = ae_config.replace(
+            **{f"file_path_{split}": os.path.basename(path)
+               for split, path in manifests.items()})
 
     os.makedirs(args.out_root, exist_ok=True)
     run_3phase(ae_config, pc_config, args.out_root,

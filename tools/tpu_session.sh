@@ -1,0 +1,78 @@
+#!/bin/sh
+# One-shot TPU work queue: run everything that needs the real chip, in
+# priority order, as soon as the axon relay is reachable. Each stage is
+# independently guarded; artifacts land in artifacts/ and repo root.
+#
+#   sh tools/tpu_session.sh [stage ...]     # default: all stages
+#
+# Stages: bench checks breakdown rd_sweep
+# (the reference-geometry trained run is rd_sweep's final point)
+set -x
+cd "$(dirname "$0")/.."
+REPO=$(pwd)
+STAGES=${*:-"bench checks breakdown rd_sweep"}
+FAILED=""
+
+for s in $STAGES; do
+rc=0
+case $s in
+bench)
+  # warms the persistent compile cache for the driver's end-of-round run
+  python bench.py > artifacts/bench_r03_warm.json \
+    2> artifacts/bench_r03_warm.log || rc=$?
+  ;;
+checks)
+  # kernel-only timings incl. 320x960 (VERDICT r02 missing #3 / next #5)
+  python tools/tpu_checks.py 2> artifacts/tpu_checks_r03.log || rc=$?
+  ;;
+breakdown)
+  # step-time breakdown + XLA trace (VERDICT r02 next #2)
+  python tools/step_breakdown.py --batch 4 --dtype bfloat16 \
+    --profile_dir artifacts/xla_trace \
+    > artifacts/step_breakdown_bf16_b4.json \
+    2> artifacts/step_breakdown.log || rc=$?
+  python tools/step_breakdown.py --batch 2 --dtype float32 \
+    > artifacts/step_breakdown_f32_b2.json \
+    2>> artifacts/step_breakdown.log || rc=$?
+  ;;
+rd_sweep)
+  # rate-target-attaining RD points at pipeline scale, then the
+  # reference-geometry run (320x960 train / 320x1224 eval; measured
+  # bitstream bpp comes from synthetic_rd's phase-2 test) — VERDICT r02
+  # next #3 and #4. --iterations lifts the config's 1500-step cap that
+  # silently clamped r02's runs below their rate targets.
+  for bpp in 0.02 0.04 0.16; do
+    python -m dsin_tpu.eval.synthetic_rd \
+      -ae_config dsin_tpu/configs/ae_synthetic_stereo \
+      --out_root "artifacts/rd_tpu_bpp$bpp" --data_dir /tmp/synth_tpu \
+      --target_bpp "$bpp" --phase1_until_target --rate_window 300 \
+      --iterations 60000 --phase1_steps 60000 --phase2_steps 6000 \
+      2> "artifacts/rd_tpu_bpp$bpp.log" || rc=$?
+  done
+  python tools/aggregate_rd.py \
+    --glob "$REPO/artifacts/rd_tpu_bpp*/rd_synthetic.json" \
+    --out "$REPO/artifacts/rd_tpu_curve.json" --plot || rc=$?
+  # reference geometry: full KITTI-shape run on a synthetic corpus (the
+  # config's KITTI manifests are rewired to the generated corpus by
+  # synthetic_rd); the config's own H_target is the 0.02 bpp point
+  python -m dsin_tpu.eval.synthetic_rd \
+    -ae_config dsin_tpu/configs/ae_kitti_stereo \
+    --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom \
+    --phase1_until_target --rate_window 300 \
+    --iterations 40000 --phase1_steps 40000 --phase2_steps 4000 \
+    --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
+  ;;
+*)
+  echo "unknown stage: $s (valid: bench checks breakdown rd_sweep)" >&2
+  rc=2
+  ;;
+esac
+echo "stage $s rc=$rc"
+[ "$rc" -ne 0 ] && FAILED="$FAILED $s"
+done
+
+if [ -n "$FAILED" ]; then
+  echo "TPU_SESSION_FAILED:$FAILED"
+  exit 1
+fi
+echo TPU_SESSION_DONE
