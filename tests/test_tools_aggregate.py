@@ -34,3 +34,40 @@ def test_aggregate_rd_sorts_by_measured_bpp(tmp_path):
     bpps = [e["bpp"] for e in curve["series"]["ae_only"]]
     assert bpps == sorted(bpps), bpps
     assert bpps[0] == 0.20
+
+
+def test_aggregate_rd_attainment_fields_and_conditional_note(tmp_path):
+    """measured_over_target = with-SI bpp / target; the identical-AE-points
+    note appears ONLY when duplicate ae_only entries exist (i.e. some
+    phase-1 runs never reached their target)."""
+    def write(name, target, ae_bpp, si_bpp):
+        d = tmp_path / f"rd_synthetic_{name}"
+        d.mkdir()
+        (d / "rd_synthetic.json").write_text(json.dumps({
+            "target_bpp": target, "config": "cfg",
+            "phase1": {"steps": 100},
+            "ae_only_test": {"bpp": ae_bpp, "psnr": 20.0, "ms_ssim": 0.9,
+                             "l1": 10.0},
+            "with_si_test": {"bpp": si_bpp, "psnr": 23.0, "ms_ssim": 0.95,
+                             "l1": 7.0},
+        }))
+
+    def run(outname):
+        out = tmp_path / outname
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "aggregate_rd.py"),
+             "--glob", str(tmp_path / "rd_synthetic_*" / "rd_synthetic.json"),
+             "--out", str(out)], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return json.loads(out.read_text())
+
+    write("a", 0.04, 0.30, 0.05)
+    write("b", 0.08, 0.20, 0.09)
+    curve = run("c1.json")
+    assert "note" not in curve            # distinct AE points: no caveat
+    ratios = [p["measured_over_target"] for p in curve["points"]]
+    assert ratios == [1.25, 1.125]
+    assert all(p["phase1_steps"] == 100 for p in curve["points"])
+
+    write("c", 0.16, 0.30, 0.05)          # duplicate AE entry of point a
+    assert "note" in run("c2.json")
