@@ -17,6 +17,7 @@ Usage:
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -56,6 +57,15 @@ def main(argv=None):
                         "import, so an env var alone cannot")
     args = p.parse_args(argv)
     crop_h, crop_w = (int(v) for v in args.crop.split(","))
+    # constraints from the model, surfaced before any compile: the AE
+    # subsamples by 8 and the search tiles by the reference patch
+    h_mult = math.lcm(8, PATCH_H)
+    w_mult = math.lcm(8, PATCH_W)
+    if crop_h % h_mult or crop_w % w_mult:
+        p.error(f"--crop {crop_h},{crop_w}: H must be divisible by "
+                f"{h_mult} and W by {w_mult} (lcm of the AE's 8x "
+                f"subsampling and the {PATCH_H}x{PATCH_W} patch) — "
+                "e.g. 120,240 / 160,480 / 320,960")
 
     import jax
     import jax.numpy as jnp
@@ -142,8 +152,11 @@ def main(argv=None):
         t0 = time.perf_counter()
         compiled = jax.jit(fn).lower(*fn_args).compile()
         report["compile_s"][name] = round(time.perf_counter() - t0, 1)
+        out = None
         for _ in range(args.warmup):
             out = compiled(*fn_args)
+        if out is None:   # --warmup 0: still need the outputs (they feed
+            out = compiled(*fn_args)   # later stages as inputs)
         jax.block_until_ready(leaf(out))
         timings[name] = _time_compiled(compiled, fn_args, args.iters, leaf)
         return out
