@@ -161,6 +161,14 @@ def main(argv=None):
         timings[name] = _time_compiled(compiled, fn_args, args.iters, leaf)
         return out
 
+    # Every stage below is timed synchronously (dispatch -> execute ->
+    # block_until_ready), so each measurement carries one full host-device
+    # round trip on top of device compute. Over the axon network relay that
+    # round trip is tens of ms — time it explicitly on a trivial program so
+    # per-stage device compute can be read as (stage_ms - dispatch_floor_ms).
+    tiny = jnp.zeros((8,), jnp.float32)
+    run("dispatch_floor", lambda t: t + 1.0, (tiny,))
+
     x_dec, qbar, symbols, _ = run(
         "ae_forward_x", enc_dec, (state.params, state.batch_stats, x),
         leaf=lambda o: o[0])
