@@ -1,0 +1,176 @@
+"""MFU roofline sweep: how throughput scales with the conv-trunk width.
+
+Answers the "why does the DSIN op mix cap MFU" question with measurements:
+compiles the FULL training step at several trunk widths (`arch_param_N` —
+the reference fixes N=128, autoencoder_imgcomp.py:211) and reports, per
+width, the compiled step's own FLOPs and bytes-accessed (XLA cost
+analysis), measured step time, achieved TFLOP/s, MFU vs v5e bf16 peak,
+arithmetic intensity, and achieved HBM bandwidth vs the chip's peak.
+
+If the achieved bandwidth sits near HBM peak while MFU is low at the
+reference width and MFU grows with N, the cap is the op mix's arithmetic
+intensity (a property of the reference architecture), not the framework's
+execution of it.
+
+Usage (real chip):
+    python tools/mfu_sweep.py [--widths 64,128,256] [--batch 4]
+        [--crop 320,960] [--dtype bfloat16] [--iters 8]
+
+Prints ONE JSON object; commit under artifacts/.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+TPU_V5E_PEAK_BF16_FLOPS = 197e12
+TPU_V5E_HBM_BYTES_PER_S = 819e9
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--widths", default="64,128,256",
+                   help="comma-separated arch_param_N values (128 = ref)")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--crop", default="320,960")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+    crop_h, crop_w = (int(v) for v in args.crop.split(","))
+    # same upfront constraint check as step_breakdown.py: the AE subsamples
+    # by 8 and the search tiles by the 20x24 reference patch
+    h_mult, w_mult = math.lcm(8, 20), math.lcm(8, 24)
+    if crop_h % h_mult or crop_w % w_mult:
+        p.error(f"--crop {crop_h},{crop_w}: H must be divisible by "
+                f"{h_mult} and W by {w_mult} — e.g. 120,240 / 320,960")
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".cache", f"jax-{jax.default_backend()}")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    from dsin_tpu.train import optim as optim_lib
+    from dsin_tpu.train import step as step_lib
+
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
+
+    shape = (args.batch, crop_h, crop_w, 3)
+    rng = np.random.default_rng(0)
+    x_np = rng.uniform(0, 255, shape).astype(np.float32)
+    y_np = np.clip(x_np + rng.normal(0, 4, shape), 0, 255).astype(np.float32)
+
+    report = {"batch": args.batch, "crop": [crop_h, crop_w],
+              "compute_dtype": args.dtype,
+              "backend": jax.default_backend(),
+              "peak_flops": TPU_V5E_PEAK_BF16_FLOPS,
+              "peak_hbm_bytes_per_s": TPU_V5E_HBM_BYTES_PER_S,
+              "widths": {}}
+
+    for n in (int(v) for v in args.widths.split(",")):
+        try:
+            report["widths"][str(n)] = _one_width(
+                args, n, base, pc_cfg, shape, x_np, y_np, crop_h, crop_w)
+        except Exception as e:  # noqa: BLE001 — a width that OOMs (the
+            # largest is the most likely) must not discard the widths
+            # already measured: record the error and keep the report
+            report["widths"][str(n)] = {"error": repr(e)[:300]}
+        print(f"N={n}: {report['widths'][str(n)]}", file=sys.stderr,
+              flush=True)
+
+    print(json.dumps(report), flush=True)
+
+
+def _one_width(args, n, base, pc_cfg, shape, x_np, y_np, crop_h, crop_w):
+    import jax
+    import jax.numpy as jnp
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    from dsin_tpu.train import optim as optim_lib
+    from dsin_tpu.train import step as step_lib
+
+    ae_cfg = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
+    ae_cfg = ae_cfg.replace(batch_size=args.batch,
+                            crop_size=(crop_h, crop_w), AE_only=False,
+                            load_model=False, train_model=True,
+                            test_model=False, compute_dtype=args.dtype,
+                            arch_param_N=n)
+    model = DSIN(ae_cfg, pc_cfg)
+    tx = optim_lib.build_optimizer(None, ae_cfg, pc_cfg,
+                                   num_training_imgs=1576)
+    with jax.default_device(jax.devices("cpu")[0]):
+        state = step_lib.create_train_state(
+            model, jax.random.PRNGKey(0), shape, tx)
+    state = jax.device_put(state, jax.devices()[0])
+    mask = jnp.asarray(gaussian_position_mask(
+        crop_h, crop_w, *ae_cfg.y_patch_size))
+    x = jax.device_put(jnp.asarray(x_np))
+    y = jax.device_put(jnp.asarray(y_np))
+    train_step = step_lib.make_train_step(model, tx, si_mask=mask,
+                                          donate=False)
+
+    entry = {}
+    t0 = time.perf_counter()
+    compiled = jax.jit(train_step).lower(state, x, y).compile()
+    entry["compile_s"] = round(time.perf_counter() - t0, 1)
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        entry["flops_per_step"] = float(ca.get("flops", 0.0))
+        entry["bytes_per_step"] = float(ca.get("bytes accessed", 0.0))
+    except Exception as e:  # noqa: BLE001 — keep timing anyway
+        entry["cost_analysis_error"] = repr(e)[:200]
+
+    out = None
+    for _ in range(args.warmup):
+        out = compiled(state, x, y)
+    if out is None:   # --warmup 0
+        out = compiled(state, x, y)
+    jax.block_until_ready(out[1]["loss"])
+    # steady-state: launch iters back-to-back, block once — matches a
+    # training loop's pipelined dispatch (bench.py methodology)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = compiled(state, x, y)
+    jax.block_until_ready(out[1]["loss"])
+    step_s = (time.perf_counter() - t0) / args.iters
+    entry["step_ms"] = round(step_s * 1e3, 2)
+    entry["images_per_sec"] = round(args.batch / step_s, 3)
+    if entry.get("flops_per_step"):
+        tfps = entry["flops_per_step"] / step_s
+        entry["achieved_tflops_per_s"] = round(tfps / 1e12, 2)
+        entry["mfu"] = round(tfps / TPU_V5E_PEAK_BF16_FLOPS, 4)
+    if entry.get("bytes_per_step"):
+        bw = entry["bytes_per_step"] / step_s
+        entry["achieved_hbm_gb_per_s"] = round(bw / 1e9, 1)
+        entry["hbm_utilization"] = round(bw / TPU_V5E_HBM_BYTES_PER_S, 4)
+    if entry.get("flops_per_step") and entry.get("bytes_per_step"):
+        entry["arithmetic_intensity_flops_per_byte"] = round(
+            entry["flops_per_step"] / entry["bytes_per_step"], 1)
+    return entry
+
+
+if __name__ == "__main__":
+    main()
