@@ -92,6 +92,12 @@ class Experiment:
         n_dev = jax.local_device_count()
         spatial = int(ae_config.get("spatial_shards", 1))
         grad_accum = int(ae_config.get("grad_accum_steps", 1) or 1)
+        if grad_accum > 1:
+            color_print(
+                f"grad_accum_steps={grad_accum}: BatchNorm statistics and "
+                f"the rate hinge are evaluated per micro-batch (see "
+                f"train/step.py docstring for when this differs from the "
+                f"full-batch step)", "yellow")
         if use_mesh is None:
             use_mesh = (spatial > 1
                         or (n_dev > 1 and ae_config.batch_size % n_dev == 0))
@@ -114,6 +120,11 @@ class Experiment:
                            if ae_config.batch_size % d == 0)
             self.mesh = mesh_lib.make_mesh(num_devices=data_par * spatial,
                                            spatial=spatial)
+            color_print(
+                f"mesh: data={data_par} x spatial={spatial} "
+                f"({data_par * spatial}/{jax.device_count()} devices; "
+                f"data axis auto-sized to the largest divisor of "
+                f"batch_size={ae_config.batch_size})", "yellow")
             self.state = mesh_lib.replicate_state(self.mesh, self.state)
             self.train_step = dp.make_spatial_train_step(
                 self.model, self.tx, self.mesh, ch, cw,
