@@ -124,7 +124,8 @@ class Experiment:
                 f"mesh: data={data_par} x spatial={spatial} "
                 f"({data_par * spatial}/{jax.device_count()} devices; "
                 f"data axis auto-sized to the largest divisor of "
-                f"batch_size={ae_config.batch_size})", "yellow")
+                f"batch_size={ae_config.batch_size} that fits the "
+                f"remaining devices)", "yellow")
             self.state = mesh_lib.replicate_state(self.mesh, self.state)
             self.train_step = dp.make_spatial_train_step(
                 self.model, self.tx, self.mesh, ch, cw,
