@@ -256,7 +256,15 @@ class Experiment:
         steps <= H_target (reference Distortions_imgcomp.py:118-127 —
         the beta-weighted hinge whose whole purpose is driving H_soft to
         the target). Use for RD-sweep phase-1 runs whose step budget is
-        otherwise guesswork; iterations/max_steps still cap the run."""
+        otherwise guesswork; iterations/max_steps still cap the run.
+
+        Metric processing lags dispatch by one step: step i+1 is dispatched
+        before step i's metrics are pulled to the host, so host work (batch
+        decode, logging, the device->host round trip — tens of ms over the
+        axon relay) overlaps device compute instead of serializing with it.
+        Consequences: the rate-target stop overshoots by exactly one
+        (constrained) step, and a validation/checkpoint at boundary j reads
+        the state after step j+1 — both harmless, both covered by tests."""
         if until_rate_target and rate_window < 1:
             raise ValueError(f"rate_window must be >= 1, got {rate_window}")
         cfg = self.ae_config
@@ -291,61 +299,84 @@ class Experiment:
         except ImportError:
             rng_iter = range(start, iterations)
 
+        def process(j, metrics):
+            """Host-side handling of step j's metrics (step j+1 may already
+            be in flight — see the docstring's lag-1 note). Updates
+            best_val/accum via nonlocal; returns ONLY whether the
+            rate-target stop fired."""
+            nonlocal accum, n_accum, best_val
+            timer.tick()
+            for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
+                accum[k] = accum.get(k, 0.0) + float(metrics[k])
+            n_accum += 1
+
+            if until_rate_target:
+                h_recent.append(float(metrics["H_soft"]))
+                if (len(h_recent) == rate_window
+                        and float(np.mean(h_recent)) <= cfg.H_target):
+                    color_print(
+                        f"[{j + 1}] rate target reached: mean H_soft "
+                        f"over last {rate_window} steps "
+                        f"{float(np.mean(h_recent)):.4f} <= "
+                        f"H_target {cfg.H_target}", "green", bold=True)
+                    # closing validate + FORCED save: the checkpoint
+                    # must hold the weights that satisfy the rate
+                    # constraint (phase 2 warm-starts from them), even
+                    # if an earlier noisy validation scored lower
+                    best_val = self._validate_and_maybe_save(
+                        j, iterations, best_val, val_losses, logger,
+                        max_val_batches, force_save=True)
+                    return True
+
+            if (j + 1) % cfg.show_every == 0 or j + 1 == iterations:
+                means = {k: v / n_accum for k, v in accum.items()}
+                accum, n_accum = {}, 0
+                ips = timer.images_per_sec(cfg.batch_size)
+                color_print(
+                    f"[{j + 1}/{iterations}] loss={means['loss']:.4f} "
+                    f"bpp={means['bpp']:.4f} d={means['d_loss']:.4f} "
+                    f"{ips:.2f} img/s", "cyan")
+                logger.log(j + 1, means, images_per_sec=ips)
+
+            # periodic (non-best) checkpoint: bounds work lost to a
+            # crash — the reference loses everything since the last
+            # val improvement (SURVEY §5)
+            if checkpoint_every and (j + 1) % checkpoint_every == 0:
+                ckpt_lib.save_checkpoint(
+                    os.path.join(self.ckpt_dir, "periodic"), self.state,
+                    extra_meta={"kind": "periodic"})
+
+            ve = get_validate_every(j, iterations, cfg.validate_every,
+                                    cfg.get("decrease_val_steps", True))
+            if (j + 1) % ve == 0 or j + 1 == iterations:
+                best_val = self._validate_and_maybe_save(
+                    j, iterations, best_val, val_losses, logger,
+                    max_val_batches)
+            return False
+
+        pending = None   # (step index, device metrics) awaiting processing
         try:
             for i in rng_iter:
                 x, y = next(train_it)
+                # drain the in-flight step before the profiler would close
+                # its trace window: with the lag-1 loop the final traced
+                # step could otherwise still be executing at stop_trace
+                if (pending is not None and profiler.active
+                        and i >= profiler.stop_step):
+                    if process(*pending):
+                        pending = None
+                        break
+                    pending = None
                 profiler.step(i)
                 with profiler.annotation(i):
                     self.state, metrics = self.train_step(self.state,
                                                           *self._put(x, y))
-                    loss = float(metrics["loss"])  # blocks; honest timer
-                timer.tick()
-                for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
-                    accum[k] = accum.get(k, 0.0) + float(metrics[k])
-                n_accum += 1
-
-                if until_rate_target:
-                    h_recent.append(float(metrics["H_soft"]))
-                    if (len(h_recent) == rate_window
-                            and float(np.mean(h_recent)) <= cfg.H_target):
-                        color_print(
-                            f"[{i + 1}] rate target reached: mean H_soft "
-                            f"over last {rate_window} steps "
-                            f"{float(np.mean(h_recent)):.4f} <= "
-                            f"H_target {cfg.H_target}", "green", bold=True)
-                        # closing validate + FORCED save: the checkpoint
-                        # must hold the weights that satisfy the rate
-                        # constraint (phase 2 warm-starts from them), even
-                        # if an earlier noisy validation scored lower
-                        best_val = self._validate_and_maybe_save(
-                            i, iterations, best_val, val_losses, logger,
-                            max_val_batches, force_save=True)
-                        break
-
-                if (i + 1) % cfg.show_every == 0 or i + 1 == iterations:
-                    means = {k: v / n_accum for k, v in accum.items()}
-                    accum, n_accum = {}, 0
-                    ips = timer.images_per_sec(cfg.batch_size)
-                    color_print(
-                        f"[{i + 1}/{iterations}] loss={means['loss']:.4f} "
-                        f"bpp={means['bpp']:.4f} d={means['d_loss']:.4f} "
-                        f"{ips:.2f} img/s", "cyan")
-                    logger.log(i + 1, means, images_per_sec=ips)
-
-                # periodic (non-best) checkpoint: bounds work lost to a
-                # crash — the reference loses everything since the last
-                # val improvement (SURVEY §5)
-                if checkpoint_every and (i + 1) % checkpoint_every == 0:
-                    ckpt_lib.save_checkpoint(
-                        os.path.join(self.ckpt_dir, "periodic"), self.state,
-                        extra_meta={"kind": "periodic"})
-
-                ve = get_validate_every(i, iterations, cfg.validate_every,
-                                        cfg.get("decrease_val_steps", True))
-                if (i + 1) % ve == 0 or i + 1 == iterations:
-                    best_val = self._validate_and_maybe_save(
-                        i, iterations, best_val, val_losses, logger,
-                        max_val_batches)
+                if pending is not None and process(*pending):
+                    pending = None
+                    break
+                pending = (i, metrics)
+            if pending is not None:
+                process(*pending)
         except BaseException as e:
             # emergency save: preserve the in-flight state before dying.
             # BaseException, not Exception: Ctrl-C / SIGINT-driven preemption
@@ -356,7 +387,11 @@ class Experiment:
             # Guarded: device-side crashes can leave self.state donated or
             # error-poisoned, in which case the save itself raises — never
             # let that mask the original error.
-            if (cfg.get("save_model", True) and timer.total_steps > 0
+            # `pending is not None` counts alongside total_steps: with the
+            # lag-1 loop a crash at the NEXT dispatch arrives before the
+            # completed step was ever processed/ticked.
+            if (cfg.get("save_model", True)
+                    and (timer.total_steps > 0 or pending is not None)
                     and not isinstance(e, GeneratorExit)):
                 emergency = os.path.join(self.ckpt_dir, "emergency")
                 try:

@@ -40,6 +40,12 @@ class StepProfiler:
         elif self._active and i >= self.stop_step:
             self.stop()
 
+    @property
+    def active(self) -> bool:
+        """True while a trace window is open (callers that pipeline device
+        work must drain it before the window closes)."""
+        return self._active
+
     def stop(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
