@@ -28,13 +28,62 @@ from dsin_tpu.config import parse_config_file
 from dsin_tpu.utils import color_print
 
 
+def _latest_resumable(out_root: str, ae_config, ae_only: bool):
+    """Newest prior attempt of this phase (same target + mode) holding the
+    highest-step restorable checkpoint under out_root/weights. Returns
+    (name relative to the weights root — possibly '<dir>/periodic' or
+    '<dir>/emergency' — , step), or (None, 0).
+
+    This is what makes a multi-hour RD run retryable on a flaky chip
+    relay: a killed attempt leaves best-val / periodic / emergency
+    checkpoints behind, and the retry continues from the furthest one
+    instead of repeating hours of training.
+    """
+    from dsin_tpu.train import checkpoint as ckpt_lib
+
+    weights = os.path.join(out_root, "weights")
+    # derive the prefix from the one naming authority (an empty timestamp
+    # yields exactly the 'target_bpp<x>_<mode>_' prefix) so a format change
+    # there cannot silently break resume discovery here
+    prefix = ckpt_lib.model_name_for(
+        ae_config.replace(AE_only=ae_only), "")
+    best_name, best_step = None, 0
+    if not os.path.isdir(weights):
+        return None, 0
+    for d in sorted(os.listdir(weights)):
+        if not d.startswith(prefix):
+            continue
+        for sub in ("", "periodic", "emergency"):
+            cand = os.path.join(weights, d, sub) if sub else \
+                os.path.join(weights, d)
+            try:
+                step = int(ckpt_lib.load_meta(cand)["step"])
+            except (OSError, KeyError, ValueError, json.JSONDecodeError):
+                continue
+            if step > best_step:
+                best_name = os.path.join(d, sub) if sub else d
+                best_step = step
+    return best_name, best_step
+
+
 def run_3phase(ae_config, pc_config, out_root: str,
                phase1_steps=None, phase2_steps=None,
                max_test_images=None, phase1_until_target=False,
                rate_window=200) -> dict:
+    """Both phases are retry-safe: a completed phase 1 leaves a
+    `phase1_done.json` marker in out_root and is skipped wholesale on
+    retry; an interrupted phase warm-resumes from the furthest checkpoint
+    a prior attempt left behind (`_latest_resumable`), with the phase's
+    TOTAL step budget preserved. Phase 2 has no marker — its completion is
+    the final `rd_synthetic.json`; a retry after a crash in the closing
+    test re-resumes phase 2 (min 1 step) and re-tests. Periodic
+    checkpoints (default every 2000 steps unless the config says
+    otherwise, including an explicit "off") bound the re-done work."""
     from dsin_tpu.main import Experiment
+    from dsin_tpu.train import checkpoint as ckpt_lib
 
     t0 = time.time()
+    os.makedirs(out_root, exist_ok=True)
     results = {"config": os.path.basename(
                    str(getattr(ae_config, "_name", "config"))),
                "crop": list(ae_config.crop_size),
@@ -43,29 +92,78 @@ def run_3phase(ae_config, pc_config, out_root: str,
                "H_target": ae_config.H_target,
                "target_bpp": ae_config.H_target /
                (64.0 / ae_config.num_chan_bn)}
+    # default only the truly-unset case: an explicit 0/None means the
+    # config deliberately disabled periodic checkpoints
+    ckpt_every = (ae_config.get("checkpoint_every")
+                  if "checkpoint_every" in ae_config else 2000)
 
     # -- phase 1: AE_only ---------------------------------------------------
-    cfg1 = ae_config.replace(AE_only=True, load_model=False,
-                             train_model=True, test_model=False)
-    exp1 = Experiment(cfg1, pc_config, out_root=out_root)
-    exp1.maybe_restore()
-    color_print(f"phase 1 (AE_only) -> {exp1.model_name}", "cyan", bold=True)
-    r1 = exp1.train(max_steps=phase1_steps,
-                    until_rate_target=phase1_until_target,
-                    rate_window=rate_window)
-    t1 = exp1.test(max_images=max_test_images, save_images=True)
-    results["phase1"] = {"model_name": exp1.model_name, **r1}
-    results["ae_only_test"] = t1
+    marker1 = os.path.join(out_root, "phase1_done.json")
+    if os.path.exists(marker1):
+        with open(marker1) as f:
+            done = json.load(f)
+        results["phase1"] = done["phase1"]
+        results["ae_only_test"] = done["ae_only_test"]
+        phase1_name = done["phase1"]["model_name"]
+        color_print(f"phase 1 already complete ({phase1_name}); skipping",
+                    "green")
+    else:
+        prior, prior_step = _latest_resumable(out_root, ae_config,
+                                              ae_only=True)
+        if prior:
+            color_print(f"phase 1 resumes from {prior} (step {prior_step})",
+                        "yellow")
+        cfg1 = ae_config.replace(AE_only=True, load_model=prior is not None,
+                                 load_model_name=prior or "",
+                                 load_train_step=prior is not None,
+                                 train_model=True, test_model=False,
+                                 checkpoint_every=ckpt_every)
+        exp1 = Experiment(cfg1, pc_config, out_root=out_root)
+        exp1.maybe_restore()
+        color_print(f"phase 1 (AE_only) -> {exp1.model_name}", "cyan",
+                    bold=True)
+        # max_steps counts steps to RUN from the restored position — keep
+        # the phase's TOTAL budget by deducting already-done work (min 1:
+        # 0 would mean "uncapped", and the closing validate must still run)
+        steps1 = (max(phase1_steps - prior_step, 1)
+                  if prior and phase1_steps else phase1_steps)
+        r1 = exp1.train(max_steps=steps1,
+                        until_rate_target=phase1_until_target,
+                        rate_window=rate_window)
+        # a RESUMED phase 1 may never beat the restored best_val in its
+        # short tail, in which case no checkpoint was written under the
+        # NEW model_name — and phase 2 (plus the done-marker) point there.
+        # Guarantee the dir holds the final trained state.
+        if not os.path.exists(os.path.join(exp1.ckpt_dir, "meta.json")):
+            ckpt_lib.save_checkpoint(exp1.ckpt_dir, exp1.state,
+                                     extra_meta={"kind": "phase1_final"})
+        t1 = exp1.test(max_images=max_test_images, save_images=True)
+        results["phase1"] = {"model_name": exp1.model_name, **r1}
+        results["ae_only_test"] = t1
+        phase1_name = exp1.model_name
+        with open(marker1, "w") as f:
+            json.dump({"phase1": results["phase1"],
+                       "ae_only_test": t1}, f, indent=2)
 
     # -- phase 2: warm-start AE, fresh siNet --------------------------------
+    # (resume-of-phase-2 restores siNet + optimizer from the prior attempt;
+    # a fresh phase 2 partial-restores only the AE partitions from phase 1)
+    prior2, prior2_step = _latest_resumable(out_root, ae_config,
+                                            ae_only=False)
+    if prior2:
+        color_print(f"phase 2 resumes from {prior2} (step {prior2_step})",
+                    "yellow")
     cfg2 = ae_config.replace(AE_only=False, load_model=True,
-                             load_model_name=exp1.model_name,
-                             load_train_step=False,
-                             train_model=True, test_model=False)
+                             load_model_name=prior2 or phase1_name,
+                             load_train_step=prior2 is not None,
+                             train_model=True, test_model=False,
+                             checkpoint_every=ckpt_every)
     exp2 = Experiment(cfg2, pc_config, out_root=out_root)
     exp2.maybe_restore()
     color_print(f"phase 2 (+siNet) -> {exp2.model_name}", "cyan", bold=True)
-    r2 = exp2.train(max_steps=phase2_steps)
+    steps2 = (max(phase2_steps - prior2_step, 1)
+              if prior2 and phase2_steps else phase2_steps)
+    r2 = exp2.train(max_steps=steps2)
     t2 = exp2.test(max_images=max_test_images, save_images=True,
                    real_bpp=True)
     results["phase2"] = {"model_name": exp2.model_name, **r2}

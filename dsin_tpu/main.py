@@ -208,7 +208,15 @@ class Experiment:
                 break
             metrics = self.val_step(self.state, *self._put(x, y))
             losses.append(float(metrics["loss"]))
-        return float(np.mean(losses)) if losses else float("inf")
+        if not losses:
+            # inf never "improves", so best-val checkpoints silently stop
+            # being written — say why (typical cause: a val split smaller
+            # than one batch)
+            color_print("validation saw ZERO batches (val split smaller "
+                        "than batch_size?) — val_loss=inf, no best-val "
+                        "checkpoint will be saved", "red")
+            return float("inf")
+        return float(np.mean(losses))
 
     def _validate_and_maybe_save(self, i: int, iterations: int,
                                  best_val: float, val_losses, logger,

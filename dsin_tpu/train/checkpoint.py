@@ -57,8 +57,18 @@ def _restore_like(template, loaded):
 
 def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
                     extra_meta: Optional[Dict[str, Any]] = None) -> None:
-    """Save a TrainState (params/batch_stats/opt_state/step) partitioned."""
+    """Save a TrainState (params/batch_stats/opt_state/step) partitioned.
+
+    Overwrite ordering makes a torn write non-discoverable instead of
+    silently corrupt: meta.json is removed FIRST and rewritten LAST, so a
+    kill mid-overwrite (e.g. the relay watcher's kill-after escalation)
+    leaves a dir without meta — which `load_meta`-driven discovery
+    (resume, `_latest_resumable`) skips — never a dir whose old meta
+    points at half-written msgpacks."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    meta_path = os.path.join(ckpt_dir, "meta.json")
+    if os.path.exists(meta_path):
+        os.remove(meta_path)
     for part, sub in state.params.items():
         _write_msgpack(os.path.join(ckpt_dir, f"params_{part}.msgpack"), sub)
     _write_msgpack(os.path.join(ckpt_dir, "batch_stats.msgpack"),

@@ -58,3 +58,55 @@ def test_determinism():
     b = make_stereo_pair(np.random.default_rng(42), 32, 64)
     np.testing.assert_array_equal(a[0], b[0])
     np.testing.assert_array_equal(a[1], b[1])
+
+
+@pytest.mark.slow
+def test_run_3phase_resumes_instead_of_restarting(tmp_path):
+    """A retried run_3phase must (a) skip a completed phase 1 via its
+    done-marker and (b) warm-resume an interrupted phase from the furthest
+    checkpoint a prior attempt left, deducting done steps from the phase
+    budget — hours of re-training on a flaky chip relay hinge on this."""
+    pytest.importorskip("PIL")
+    import json as json_lib
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.eval.synthetic_rd import _latest_resumable, run_3phase
+    from dsin_tpu.main import Experiment
+
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    data = str(tmp_path / "data")
+    # num_val=2: batch_size is 2, and a val split smaller than one batch
+    # validates to inf and never writes the checkpoint this test resumes from
+    write_corpus(data, num_train=3, num_val=2, num_test=1,
+                 height=48, width=144)
+    ae = parse_config_file(os.path.join(base, "ae_synthetic_micro"))
+    ae = ae.replace(root_data=data,
+                    **{f"file_path_{s}": f"synthetic_stereo_{s}.txt"
+                       for s in ("train", "val", "test")})
+    pc = parse_config_file(os.path.join(base, "pc_default"))
+
+    # -- (b) interrupted phase 1: a prior attempt trained 2 steps ----------
+    out = str(tmp_path / "run")
+    cfg1 = ae.replace(AE_only=True, load_model=False, train_model=True,
+                      test_model=False)
+    prior = Experiment(cfg1, pc, out_root=out)
+    prior.train(max_steps=2, max_val_batches=1)
+    name, step = _latest_resumable(out, ae, ae_only=True)
+    assert name is not None and step == 2, (name, step)
+
+    r = run_3phase(ae, pc, out, phase1_steps=3, phase2_steps=2,
+                   max_test_images=1)
+    # 3-step budget minus 2 already done -> exactly 1 step run
+    assert r["phase1"]["steps"] == 1, r["phase1"]
+    assert os.path.exists(os.path.join(out, "phase1_done.json"))
+
+    # -- (a) retry: phase 1 skipped wholesale, phase 2 resumed -------------
+    r2 = run_3phase(ae, pc, out, phase1_steps=3, phase2_steps=2,
+                    max_test_images=1)
+    assert r2["phase1"]["model_name"] == r["phase1"]["model_name"]
+    assert r2["phase1"]["steps"] == r["phase1"]["steps"]  # from the marker
+    # phase-2 budget already exhausted by the first run -> min 1 step
+    assert r2["phase2"]["steps"] == 1, r2["phase2"]
+    with open(os.path.join(out, "rd_synthetic.json")) as f:
+        assert json_lib.load(f)["phase2"]["steps"] == 1

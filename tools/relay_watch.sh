@@ -42,7 +42,10 @@ run_stage() {
     return 0
   fi
   echo "[watch $(date +%H:%M:%S)] stage $name starting (budget ${budget}s)"
-  if timeout "$budget" sh -c "$1"; then
+  # -s INT: python sees KeyboardInterrupt, so training stages write their
+  # emergency checkpoint (which the rd stages resume from on retry);
+  # --kill-after covers a process the INT cannot unstick
+  if timeout -s INT --kill-after=120 "$budget" sh -c "$1"; then
     echo "$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name done"
     return 0
