@@ -218,9 +218,14 @@ def run():
     # operating mode this framework is designed around, and the committed
     # number must correspond to the committed default.
     compute_dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    # BENCH_REMAT=1: rematerialize the AE residual blocks in the backward
+    # (identical numerics and param tree; trades forward FLOPs for
+    # activation HBM traffic — artifacts/PERF_ANALYSIS.md lever #3)
+    remat = int(os.environ.get("BENCH_REMAT", "0") or 0) != 0
     ae_cfg = ae_cfg.replace(batch_size=BATCH, crop_size=(CROP_H, CROP_W),
                             AE_only=False, load_model=False, train_model=True,
-                            test_model=False, compute_dtype=compute_dtype)
+                            test_model=False, compute_dtype=compute_dtype,
+                            remat=remat)
     pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
 
     # explicit BENCH_SIFINDER pins the impl (no silent fallback — a broken
@@ -346,6 +351,7 @@ def run():
             "timing_source": timing_source,
             "step_ms": round(step_ms, 2),
             "compute_dtype": compute_dtype,
+            "remat": remat,
         }
         if compile_s is not None:
             payload["compile_s"] = round(compile_s, 1)

@@ -125,21 +125,37 @@ class _ResBlock(nn.Module):
 class _ResGroupStack(nn.Module):
     """B groups of three residual blocks, each group with its own skip,
     followed by a no-activation residual block and an outer skip
-    (reference autoencoder_imgcomp.py:226-235, 253-263)."""
+    (reference autoencoder_imgcomp.py:226-235, 253-263).
+
+    `remat=True` rematerializes each residual block in the backward pass
+    (jax.checkpoint via nn.remat): activations inside the block are not
+    stored, trading ~1 extra forward's FLOPs for the trunk's activation
+    HBM traffic — the backward is the step's largest consumer
+    (artifacts/PERF_ANALYSIS.md). Numerics are unchanged."""
     features: int
     num_groups: int
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
+        # Rematted blocks are explicitly named with the baseline's
+        # auto-generated names so the param tree (and every existing
+        # checkpoint) is IDENTICAL across remat on/off — toggling a
+        # memory knob must never invalidate trained weights.
+        block_cls = (nn.remat(_ResBlock, static_argnums=(2,))
+                     if self.remat else _ResBlock)
+        idx = 0
         outer = x
         for _ in range(self.num_groups):
             inner = x
             for _ in range(3):
-                x = _ResBlock(self.features, dtype=self.dtype)(x, train)
+                x = block_cls(self.features, dtype=self.dtype,
+                              name=f"_ResBlock_{idx}")(x, train)
+                idx += 1
             x = x + inner
-        x = _ResBlock(self.features, relu_first=False,
-                      dtype=self.dtype)(x, train)
+        x = block_cls(self.features, relu_first=False, dtype=self.dtype,
+                      name=f"_ResBlock_{idx}")(x, train)
         return x + outer
 
 
@@ -155,7 +171,8 @@ class Encoder(nn.Module):
         x = normalize_image(x, cfg.normalization)
         x = _ConvBN(n // 2, 5, stride=2, dtype=dt)(x, train)
         x = _ConvBN(n, 5, stride=2, dtype=dt)(x, train)
-        x = _ResGroupStack(n, cfg.arch_param_B, dtype=dt)(x, train)
+        x = _ResGroupStack(n, cfg.arch_param_B, dtype=dt,
+                          remat=bool(cfg.get("remat", False)))(x, train)
         c_out = cfg.num_chan_bn + 1 if cfg.heatmap else cfg.num_chan_bn
         x = _ConvBN(c_out, 5, stride=2, relu=False, dtype=dt)(x, train)
         return x
@@ -171,7 +188,8 @@ class Decoder(nn.Module):
         n = cfg.get("arch_param_N", ARCH_PARAM_N)
         dt = jnp.dtype(cfg.get("compute_dtype", "float32"))
         x = _ConvBN(n, 3, stride=2, transpose=True, dtype=dt)(q, train)
-        x = _ResGroupStack(n, cfg.arch_param_B, dtype=dt)(x, train)
+        x = _ResGroupStack(n, cfg.arch_param_B, dtype=dt,
+                          remat=bool(cfg.get("remat", False)))(x, train)
         x = _ConvBN(n // 2, 5, stride=2, transpose=True, dtype=dt)(x, train)
         x = _ConvBN(3, 5, stride=2, transpose=True, relu=False,
                     dtype=dt)(x, train)

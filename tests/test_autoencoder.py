@@ -112,3 +112,33 @@ def test_gradients_reach_all_encoder_params(ae_setup):
     assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
     nonzero = sum(float(jnp.sum(jnp.abs(l))) > 0 for l in leaves)
     assert nonzero > len(leaves) * 0.5
+
+
+def test_remat_matches_baseline_forward_and_grads():
+    """remat=True must be a pure memory/time trade: identical forward
+    outputs and (numerically) identical gradients vs the baseline (same
+    params are valid for both — remat does not change the param tree)."""
+    from dsin_tpu.models.autoencoder import Encoder
+
+    cfg = small_cfg(arch_param_N=16)
+    enc = Encoder(cfg)
+    x = jnp.asarray(np.random.default_rng(0).uniform(
+        0, 255, (1, 16, 16, 3)).astype(np.float32))
+    vs = enc.init(jax.random.PRNGKey(0), x, True)
+
+    enc_r = Encoder(small_cfg(arch_param_N=16, remat=True))
+
+    def loss(params, module):
+        out = module.apply({"params": params,
+                            "batch_stats": vs["batch_stats"]}, x, True,
+                           mutable=["batch_stats"])[0]
+        return jnp.sum(out ** 2)
+
+    l0, g0 = jax.value_and_grad(loss)(vs["params"], enc)
+    l1, g1 = jax.value_and_grad(loss)(vs["params"], enc_r)
+    assert float(l0) == float(l1)
+    flat0 = jax.tree_util.tree_leaves(g0)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    for a, b in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)

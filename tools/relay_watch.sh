@@ -45,7 +45,7 @@ run_stage() {
   # -s INT: python sees KeyboardInterrupt, so training stages write their
   # emergency checkpoint (which the rd stages resume from on retry);
   # --kill-after covers a process the INT cannot unstick
-  if timeout -s INT --kill-after=120 "$budget" sh -c "$1"; then
+  if timeout -s INT --kill-after=120 "$budget" sh -c "$1" 9>&-; then
     echo "$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name done"
     return 0
@@ -67,13 +67,17 @@ run_stage() {
 }
 
 probe() {
+  # 9>&- : children must not inherit the flock fd — an orphaned probe or
+  # stage would otherwise hold the single-instance lock after the watcher
+  # itself is gone, blocking restarts
   timeout 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
-    > /dev/null 2>&1
+    > /dev/null 2>&1 9>&-
 }
 
 all_done() {
-  for s in breakdown_bf16 breakdown_f32 bench_b8 mfu_sweep checks \
-           rd_refgeom rd_tpu_0.02 rd_tpu_0.04 rd_tpu_0.16 rd_aggregate; do
+  for s in breakdown_bf16 breakdown_f32 bench_b8 mfu_sweep bench_remat \
+           checks rd_refgeom rd_tpu_0.02 rd_tpu_0.04 rd_tpu_0.16 \
+           rd_aggregate; do
     stage_done "$s" || return 1
   done
   return 0
@@ -93,6 +97,7 @@ while :; do
     run_stage breakdown_f32 2400 'python tools/step_breakdown.py --batch 2 --dtype float32 > artifacts/step_breakdown_f32_b2.json 2>> artifacts/step_breakdown.log' || continue
     run_stage bench_b8 2400 'BENCH_BATCH=8 python bench.py > artifacts/bench_b8.json 2> artifacts/bench_b8.log' || continue
     run_stage mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/mfu_sweep.json 2> artifacts/mfu_sweep.log' || continue
+    run_stage bench_remat 2400 'BENCH_REMAT=1 python bench.py > artifacts/bench_remat.json 2> artifacts/bench_remat.log' || continue
     run_stage checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r03b.log' || continue
     run_stage rd_refgeom 25200 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_kitti_stereo --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom --phase1_until_target --rate_window 300 --iterations 40000 --phase1_steps 40000 --phase2_steps 4000 --max_test_images 8 2> artifacts/rd_refgeom.log' || continue
     for bpp in 0.02 0.04 0.16; do
