@@ -5,12 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: bench checks breakdown rd_sweep
+# Stages: bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
+# NOTE: tools/relay_watch.sh is the authoritative round-3 queue (per-stage
+# state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"bench checks breakdown rd_sweep"}
+STAGES=${*:-"bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -34,6 +36,15 @@ breakdown)
   python tools/step_breakdown.py --batch 2 --dtype float32 \
     > artifacts/step_breakdown_f32_b2.json \
     2>> artifacts/step_breakdown.log || rc=$?
+  ;;
+mfu)
+  # MFU roofline sweep + remat A/B (artifacts/PERF_ANALYSIS.md levers)
+  python tools/mfu_sweep.py > artifacts/mfu_sweep.json \
+    2> artifacts/mfu_sweep.log || rc=$?
+  BENCH_REMAT=1 python bench.py > artifacts/bench_remat.json \
+    2> artifacts/bench_remat.log || rc=$?
+  BENCH_BATCH=8 python bench.py > artifacts/bench_b8.json \
+    2> artifacts/bench_b8.log || rc=$?
   ;;
 rd_sweep)
   # rate-target-attaining RD points at pipeline scale, then the
@@ -63,7 +74,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: bench checks breakdown rd_sweep)" >&2
+  echo "unknown stage: $s (valid: bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
