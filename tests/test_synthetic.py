@@ -110,3 +110,51 @@ def test_run_3phase_resumes_instead_of_restarting(tmp_path):
     assert r2["phase2"]["steps"] == 1, r2["phase2"]
     with open(os.path.join(out, "rd_synthetic.json")) as f:
         assert json_lib.load(f)["phase2"]["steps"] == 1
+
+
+def test_latest_resumable_selection_and_torn_skip(tmp_path):
+    """Pure-filesystem contract of the resume discovery: pick the highest
+    step across best/periodic/emergency subdirs of matching attempts,
+    ignore other modes/targets, and skip torn checkpoints (no meta.json —
+    the tear-safe overwrite ordering guarantees torn == meta-less)."""
+    import json as json_lib
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.eval.synthetic_rd import _latest_resumable
+
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    ae = parse_config_file(os.path.join(base, "ae_synthetic_micro"))
+    target_bpp = ae.H_target / (64.0 / ae.num_chan_bn)
+    weights = tmp_path / "weights"
+
+    def mk(name, sub, step, torn=False):
+        d = weights / name / sub if sub else weights / name
+        d.mkdir(parents=True, exist_ok=True)
+        if not torn:
+            (d / "meta.json").write_text(json_lib.dumps({"step": step}))
+
+    out = str(tmp_path)
+    assert _latest_resumable(out, ae, ae_only=True) == (None, 0)
+
+    a = f"target_bpp{target_bpp}_AE_only_20260101_000000"
+    b = f"target_bpp{target_bpp}_AE_only_20260101_000001"
+    other_mode = f"target_bpp{target_bpp}_sinet_20260101_000000"
+    other_target = f"target_bpp{target_bpp * 2}_AE_only_20260101_000000"
+    mk(a, "", 100)
+    mk(a, "periodic", 400)
+    mk(a, "emergency", 700)
+    mk(b, "", 600)
+    mk(other_mode, "", 9000)          # wrong mode: ignored for ae_only
+    mk(other_target, "", 9000)        # wrong target: ignored
+    name, step = _latest_resumable(out, ae, ae_only=True)
+    assert step == 700 and name == os.path.join(a, "emergency"), (name, step)
+
+    # torn overwrite of the winner (meta removed first): next-best wins
+    os.remove(str(weights / a / "emergency" / "meta.json"))
+    name, step = _latest_resumable(out, ae, ae_only=True)
+    assert step == 600 and name == b, (name, step)
+
+    # the sinet mode sees only its own attempts
+    name, step = _latest_resumable(out, ae, ae_only=False)
+    assert step == 9000 and name == other_mode
