@@ -99,7 +99,7 @@ while :; do
     run_stage mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/mfu_sweep.json 2> artifacts/mfu_sweep.log' || continue
     run_stage bench_remat 2400 'BENCH_REMAT=1 python bench.py > artifacts/bench_remat.json 2> artifacts/bench_remat.log' || continue
     run_stage checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r03b.log' || continue
-    run_stage rd_refgeom 25200 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_kitti_stereo --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom --phase1_until_target --rate_window 300 --iterations 40000 --phase1_steps 40000 --phase2_steps 4000 --max_test_images 8 2> artifacts/rd_refgeom.log' || continue
+    run_stage rd_refgeom 25200 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_kitti_stereo --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 4000 --max_test_images 8 2> artifacts/rd_refgeom.log' || continue
     for bpp in 0.02 0.04 0.16; do
       run_stage "rd_tpu_$bpp" 14400 "python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_synthetic_stereo --out_root artifacts/rd_tpu_bpp$bpp --data_dir /tmp/synth_tpu --target_bpp $bpp --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 6000 2> artifacts/rd_tpu_bpp$bpp.log"
     done

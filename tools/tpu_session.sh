@@ -70,7 +70,7 @@ rd_sweep)
     -ae_config dsin_tpu/configs/ae_kitti_stereo \
     --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom \
     --phase1_until_target --rate_window 300 \
-    --iterations 40000 --phase1_steps 40000 --phase2_steps 4000 \
+    --iterations 60000 --phase1_steps 60000 --phase2_steps 4000 \
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
