@@ -28,6 +28,34 @@ fi
 
 stage_done() { grep -qx "$1" "$STATE" || grep -qx "skip:$1" "$STATE"; }
 
+# Optional hard deadline (epoch seconds in artifacts/.watch_deadline,
+# written by the launcher BEFORE starting the watcher): the driver's
+# end-of-round bench needs the chip to itself, so no stage may still be
+# running when it fires. Stage budgets are clipped to the remaining time
+# minus a 300 s margin (INT → emergency checkpoint → kill-after all land
+# before the deadline), stages are not started inside the final 10
+# minutes, and the loop idles out the tail then exits. Stages killed at
+# a clipped budget take the same resumable -INT path as any other
+# timeout but are NOT counted toward the 3-strike skip — the kill says
+# nothing about the stage. A deadline that predates the watcher's own
+# launch is stale state from a previous round and is ignored, so a
+# watcher restart next session still drains the queue.
+start_ts=$(date +%s)
+read_deadline() {
+  deadline=0
+  [ -f artifacts/.watch_deadline ] \
+    && deadline=$(cat artifacts/.watch_deadline 2>/dev/null)
+  case "$deadline" in ''|*[!0-9]*) deadline=0 ;; esac
+  if [ "$deadline" -gt 0 ] && [ "$deadline" -le "$start_ts" ]; then
+    if [ "${stale_warned:-0}" -eq 0 ]; then
+      echo "[watch] ignoring stale deadline $deadline (predates launch)"
+      stale_warned=1
+    fi
+    deadline=0
+  fi
+}
+read_deadline
+
 # run_stage NAME TIMEOUT_S COMMAND — the timeout guards against the
 # relay's hang-don't-fail failure mode (the reason probe() itself needs
 # `timeout 75`): a stalled remote-execute RPC would otherwise block the
@@ -35,6 +63,22 @@ stage_done() { grep -qx "$1" "$STATE" || grep -qx "skip:$1" "$STATE"; }
 run_stage() {
   name=$1; budget=$2; shift 2
   stage_done "$name" && return 0
+  clipped=0
+  # Re-read here, not just at the loop top: stages chain within one loop
+  # iteration, so a deadline written while an earlier stage ran must
+  # still bound every later stage of the same iteration.
+  read_deadline
+  if [ "$deadline" -gt 0 ]; then
+    left=$(( deadline - $(date +%s) ))
+    if [ "$left" -lt 600 ]; then
+      echo "[watch $(date +%H:%M:%S)] deadline ${left}s away; not starting $name"
+      return 1
+    fi
+    if [ "$budget" -gt $(( left - 300 )) ]; then
+      budget=$(( left - 300 ))
+      clipped=1
+    fi
+  fi
   fails=$(grep -cx "fail:$name" "$STATE")
   if [ "$fails" -ge 3 ]; then
     echo "skip:$name" >> "$STATE"
@@ -45,7 +89,9 @@ run_stage() {
   # -s INT: python sees KeyboardInterrupt, so training stages write their
   # emergency checkpoint (which the rd stages resume from on retry);
   # --kill-after covers a process the INT cannot unstick
-  if timeout -s INT --kill-after=120 "$budget" sh -c "$1" 9>&-; then
+  timeout -s INT --kill-after=120 "$budget" sh -c "$1" 9>&-
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     echo "$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name done"
     return 0
@@ -54,8 +100,13 @@ run_stage() {
   # reachable afterwards: a stage killed by a mid-run relay drop (the
   # exact event this watcher exists to ride out) says nothing about the
   # stage itself, and the multi-hour rd stages would otherwise be
-  # silently cancelled by the flakiness they are queued behind.
-  if probe; then
+  # silently cancelled by the flakiness they are queued behind. The same
+  # logic covers a deadline-clipped budget (rc 124 timeout / 137
+  # kill-after): the kill reflects the session ending, not the stage.
+  if [ "$clipped" -eq 1 ] && { [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; }; then
+    echo "[watch $(date +%H:%M:%S)] stage $name killed at the" \
+         "deadline-clipped budget (not counted)"
+  elif probe; then
     echo "fail:$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name failed with the relay up" \
          "(attempt $((fails + 1)))"
@@ -84,6 +135,23 @@ all_done() {
 }
 
 while :; do
+  read_deadline
+  if [ "$deadline" -gt 0 ]; then
+    now=$(date +%s)
+    if [ "$now" -ge "$deadline" ]; then
+      echo "[watch $(date +%H:%M:%S)] deadline reached; exiting"
+      break
+    fi
+    # Idle out the final window rather than re-probing the relay every
+    # few seconds through run_stage refusals right before the bench
+    # that wants the chip quiet.
+    if [ $(( deadline - now )) -lt 600 ]; then
+      echo "[watch $(date +%H:%M:%S)] inside the final $(( deadline - now ))s" \
+           "pre-deadline window; idling"
+      sleep $(( deadline - now ))
+      continue
+    fi
+  fi
   if all_done; then
     echo "[watch $(date +%H:%M:%S)] queue complete"
     break
