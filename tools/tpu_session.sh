@@ -29,13 +29,19 @@ checks)
   ;;
 breakdown)
   # step-time breakdown + XLA trace (VERDICT r02 next #2)
+  # temp+rename (as in relay_watch.sh): an interrupted run must not
+  # truncate the committed headline artifacts
   python tools/step_breakdown.py --batch 4 --dtype bfloat16 \
     --profile_dir artifacts/xla_trace \
-    > artifacts/step_breakdown_bf16_b4.json \
-    2> artifacts/step_breakdown.log || rc=$?
+    > artifacts/.step_breakdown_bf16_b4.json.tmp \
+    2> artifacts/step_breakdown.log \
+    && mv artifacts/.step_breakdown_bf16_b4.json.tmp \
+          artifacts/step_breakdown_bf16_b4.json || rc=$?
   python tools/step_breakdown.py --batch 2 --dtype float32 \
-    > artifacts/step_breakdown_f32_b2.json \
-    2>> artifacts/step_breakdown.log || rc=$?
+    > artifacts/.step_breakdown_f32_b2.json.tmp \
+    2>> artifacts/step_breakdown.log \
+    && mv artifacts/.step_breakdown_f32_b2.json.tmp \
+          artifacts/step_breakdown_f32_b2.json || rc=$?
   ;;
 mfu)
   # MFU roofline sweep + remat A/B (artifacts/PERF_ANALYSIS.md levers)
