@@ -228,6 +228,39 @@ def reference_stereo_splits(kitti_root: str) -> Dict[str, List[Tuple[str, str]]]
     return splits
 
 
+def cityscapes_stereo_splits(root: str) -> Dict[str, List[Tuple[str, str]]]:
+    """Left/right frame pairs in Cityscapes' own train/val/test partition.
+
+    Expected tree (the standard leftImg8bit/rightImg8bit zips):
+        <root>/leftImg8bit/<split>/<city>/<stem>_leftImg8bit.png
+        <root>/rightImg8bit/<split>/<city>/<stem>_rightImg8bit.png
+
+    Pairs are (left, right) paths relative to <root> — left is the encoder
+    input x, right the decoder-only side information y, the same camera
+    convention as the KITTI image_2/image_3 pairing above. Cityscapes
+    publishes its own city-disjoint split directories, so unlike KITTI no
+    split rule is applied here; frames whose right image is missing are
+    skipped. Ordering is lexicographic (deterministic across hosts)."""
+    splits: Dict[str, List[Tuple[str, str]]] = {}
+    for split in ("train", "val", "test"):
+        pairs: List[Tuple[str, str]] = []
+        left_root = os.path.join(root, "leftImg8bit", split)
+        for dirpath, _dirnames, files in sorted(os.walk(left_root)):
+            for fname in sorted(files):
+                if "_leftImg8bit." not in fname:
+                    continue
+                left_rel = os.path.relpath(os.path.join(dirpath, fname), root)
+                # swap both occurrences (split dir + file suffix) on the
+                # root-relative path so a "leftImg8bit" substring in the
+                # root path itself stays untouched
+                right_rel = left_rel.replace("leftImg8bit", "rightImg8bit")
+                if not os.path.isfile(os.path.join(root, right_rel)):
+                    continue
+                pairs.append((left_rel, right_rel))
+        splits[split] = pairs
+    return splits
+
+
 def split_pairs(pairs: List[Tuple[str, str]], val_frac: float,
                 test_frac: float, seed: int = 0):
     """Deterministic shuffled split into train/val/test."""
@@ -249,8 +282,18 @@ def write_manifest(path: str, pairs: List[Tuple[str, str]]) -> None:
 
 
 def main(argv=None) -> None:
-    p = argparse.ArgumentParser(description="KITTI pair-manifest generator")
-    p.add_argument("--kitti_root", required=True)
+    p = argparse.ArgumentParser(
+        description="pair-manifest generator (KITTI, Cityscapes)")
+    p.add_argument("--kitti_root", required=True,
+                   help="dataset root (name kept for compatibility; for "
+                        "--dataset cityscapes pass the Cityscapes root "
+                        "holding leftImg8bit/ and rightImg8bit/)")
+    p.add_argument("--dataset", choices=("kitti", "cityscapes"),
+                   default="kitti",
+                   help="cityscapes uses the dataset's own city-disjoint "
+                        "train/val/test directories (stereo only; no "
+                        "split rule applies) and writes the manifests "
+                        "configs/ae_cityscapes_stereo points at")
     p.add_argument("--out_dir", default="data_paths")
     p.add_argument("--mode", choices=("stereo", "general"), default="stereo")
     p.add_argument("--split_rule", choices=("reference", "random"),
@@ -273,6 +316,29 @@ def main(argv=None) -> None:
                         "reference general rule is fixed at ±3")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+
+    if args.dataset == "cityscapes":
+        if args.mode != "stereo":
+            raise SystemExit("--dataset cityscapes supports only "
+                             "--mode stereo (no general-pairing rule "
+                             "exists for it)")
+        bad = [name for name, v in (("--val_frac", args.val_frac),
+                                    ("--test_frac", args.test_frac),
+                                    ("--max_offset", args.max_offset))
+               if v is not None]
+        if bad:
+            raise SystemExit(
+                f"{', '.join(bad)} cannot be combined with --dataset "
+                "cityscapes: it ships its own train/val/test directories")
+        splits = cityscapes_stereo_splits(args.kitti_root)
+        if not any(splits.values()):
+            raise SystemExit("no leftImg8bit/rightImg8bit pairs under "
+                             f"{args.kitti_root}")
+        for split, split_list in splits.items():
+            out = os.path.join(args.out_dir, f"cityscapes_stereo_{split}.txt")
+            write_manifest(out, split_list)
+            print(f"{out}: {len(split_list)} pairs")
+        return
 
     if args.split_rule == "reference":
         ignored = [name for name, v in (("--val_frac", args.val_frac),

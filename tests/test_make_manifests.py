@@ -240,3 +240,68 @@ def test_reference_general_frozen_lists_match_derived_rule(tmp_path):
     assert vs <= univ and ts <= univ
     assert len(univ - vs - ts) == 41
     assert len(vs) == int(len(univ) * 0.2)
+
+
+def _fake_cityscapes(root):
+    """Two cities in train, one each in val/test; one frame lacks its
+    right image and must be skipped."""
+    made = []
+    frames = {"train": [("aachen", "000000_000019"),
+                        ("aachen", "000001_000019"),
+                        ("bochum", "000000_000019")],
+              "val": [("frankfurt", "000000_000294")],
+              "test": [("berlin", "000000_000019")]}
+    for split, entries in frames.items():
+        for city, stem in entries:
+            for side in ("left", "right"):
+                if (split, city, stem) == ("train", "bochum",
+                                           "000000_000019") \
+                        and side == "right":
+                    continue   # orphan left frame
+                p = os.path.join(root, f"{side}Img8bit", split, city,
+                                 f"{city}_{stem}_{side}Img8bit.png")
+                os.makedirs(os.path.dirname(p), exist_ok=True)
+                open(p, "w").close()
+                made.append(p)
+    return made
+
+
+def test_cityscapes_splits_native_and_orphan_skipped(tmp_path):
+    from dsin_tpu.data.make_manifests import cityscapes_stereo_splits
+    root = str(tmp_path / "cs")
+    _fake_cityscapes(root)
+    splits = cityscapes_stereo_splits(root)
+    assert {k: len(v) for k, v in splits.items()} == \
+        {"train": 2, "val": 1, "test": 1}
+    for x, y in splits["train"]:
+        assert "_leftImg8bit" in x and "_rightImg8bit" in y
+        assert x.startswith("leftImg8bit/train/")
+        assert y.startswith("rightImg8bit/train/")
+    # deterministic lexicographic order
+    assert splits == cityscapes_stereo_splits(root)
+
+
+def test_cityscapes_cli_writes_config_manifest_names(tmp_path):
+    root = str(tmp_path / "cs")
+    out = str(tmp_path / "data_paths")
+    _fake_cityscapes(root)
+    main(["--kitti_root", root, "--dataset", "cityscapes",
+          "--out_dir", out])
+    # the names ae_cityscapes_stereo's file_path_* keys point at
+    for split, n in (("train", 2), ("val", 1), ("test", 1)):
+        manifest = os.path.join(out, f"cityscapes_stereo_{split}.txt")
+        pairs = read_pair_manifest(manifest, root=root)
+        assert len(pairs) == n
+        for x, y in pairs:
+            assert os.path.exists(x) and os.path.exists(y)
+
+
+def test_cityscapes_cli_rejects_general_and_fracs(tmp_path):
+    root = str(tmp_path / "cs")
+    _fake_cityscapes(root)
+    with pytest.raises(SystemExit):
+        main(["--kitti_root", root, "--dataset", "cityscapes",
+              "--mode", "general"])
+    with pytest.raises(SystemExit):
+        main(["--kitti_root", root, "--dataset", "cityscapes",
+              "--val_frac", "0.1"])
