@@ -167,11 +167,14 @@ while :; do
     # artifact predates the dispatch_floor stage and must be regenerated
     # once. Writes via temp+rename so a killed run cannot truncate the
     # committed headline artifact.
+    # Cheap stages that can change the end-of-round bench defaults
+    # (batch / remat) run FIRST — if the next relay window is short,
+    # their answers matter more than the diagnostic stages.
     run_stage breakdown_bf16_floor 2400 'python tools/step_breakdown.py --batch 4 --dtype bfloat16 --profile_dir artifacts/xla_trace > artifacts/.step_breakdown_bf16_b4.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_bf16_b4.json.tmp artifacts/step_breakdown_bf16_b4.json' || continue
-    run_stage breakdown_f32 2400 'python tools/step_breakdown.py --batch 2 --dtype float32 > artifacts/.step_breakdown_f32_b2.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_f32_b2.json.tmp artifacts/step_breakdown_f32_b2.json' || continue
     run_stage bench_b8 2400 'BENCH_BATCH=8 python bench.py > artifacts/bench_b8.json 2> artifacts/bench_b8.log' || continue
-    run_stage mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/mfu_sweep.json 2> artifacts/mfu_sweep.log' || continue
     run_stage bench_remat 2400 'BENCH_REMAT=1 python bench.py > artifacts/bench_remat.json 2> artifacts/bench_remat.log' || continue
+    run_stage breakdown_f32 2400 'python tools/step_breakdown.py --batch 2 --dtype float32 > artifacts/.step_breakdown_f32_b2.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_f32_b2.json.tmp artifacts/step_breakdown_f32_b2.json' || continue
+    run_stage mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/mfu_sweep.json 2> artifacts/mfu_sweep.log' || continue
     run_stage checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r03b.log' || continue
     run_stage rd_refgeom 25200 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_kitti_stereo --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 4000 --max_test_images 8 2> artifacts/rd_refgeom.log' || continue
     for bpp in 0.02 0.04 0.16; do
