@@ -128,7 +128,7 @@ probe() {
 all_done() {
   for s in breakdown_bf16_floor breakdown_f32 \
            bench_b8 mfu_sweep bench_remat \
-           checks rd_refgeom rd_tpu_0.02 rd_tpu_0.04 rd_tpu_0.16 \
+           checks rd_refgeom rd_tpu_0.02 rd_tpu_0.04 \
            rd_aggregate; do
     stage_done "$s" || return 1
   done
@@ -177,14 +177,16 @@ while :; do
     run_stage mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/mfu_sweep.json 2> artifacts/mfu_sweep.log' || continue
     run_stage checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r03b.log' || continue
     run_stage rd_refgeom 25200 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_kitti_stereo --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 4000 --max_test_images 8 2> artifacts/rd_refgeom.log' || continue
-    for bpp in 0.02 0.04 0.16; do
+    # 0.16 was dropped from the chip sweep: CPU pipeline-scale points
+    # already land on-target at 0.16 (and 0.08), so the scarce relay
+    # time goes to the low-rate targets the CPU cannot reach in-session.
+    for bpp in 0.02 0.04; do
       run_stage "rd_tpu_$bpp" 14400 "python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_synthetic_stereo --out_root artifacts/rd_tpu_bpp$bpp --data_dir /tmp/synth_tpu --target_bpp $bpp --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 6000 2> artifacts/rd_tpu_bpp$bpp.log"
     done
     # Aggregate only once every rd point is resolved (done or skipped) —
     # marking it done while a point is still pending would freeze the
     # curve without that point forever.
-    if stage_done rd_tpu_0.02 && stage_done rd_tpu_0.04 \
-        && stage_done rd_tpu_0.16; then
+    if stage_done rd_tpu_0.02 && stage_done rd_tpu_0.04; then
       run_stage rd_aggregate 600 'python tools/aggregate_rd.py --glob "artifacts/rd_tpu_bpp*/rd_synthetic.json" --out artifacts/rd_tpu_curve.json --plot'
     fi
   else
