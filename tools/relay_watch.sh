@@ -94,6 +94,13 @@ run_stage() {
   if [ "$rc" -eq 0 ]; then
     echo "$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name done"
+    # Commit the landed JSON evidence immediately: a relay drop, session
+    # death, or end-of-round cleanup must not lose a captured artifact.
+    # (Image/score-list directories are curated into git manually.)
+    git add -- artifacts/*.json artifacts/*/rd_synthetic.json \
+        TPU_CHECKS.json 2>/dev/null
+    git commit -q -m "Land chip-queue stage output: $name" 2>/dev/null \
+      || true
     return 0
   fi
   # Only count a failure toward the 3-strike skip when the relay is still
