@@ -437,7 +437,8 @@ def _cpu_fallback(tpu_err):
         "note": "TPU relay unreachable for the whole init window; this is "
                 "the same full train step measured on the host CPU at a "
                 f"REDUCED {fb_h}x{fb_w} crop — not comparable to TPU "
-                "numbers (r02 TPU measurement: 9.095 img/s at 320x960).",
+                "numbers (last on-chip measurement: 10.64 img/s at "
+                "320x960 bf16/b4, artifacts/bench_r03_warm.json).",
     })
     return payload
 
