@@ -75,3 +75,13 @@ def test_mfu_sweep_preserves_widths_on_partial_failure():
     report = json.loads(r.stdout)
     assert "step_ms" in report["widths"]["16"]
     assert "error" in report["widths"]["-3"]
+
+
+@pytest.mark.parametrize("script", ["relay_watch.sh", "tpu_session.sh"])
+def test_shell_runners_parse(script):
+    """The queue runners are edited live during rounds; pin their syntax
+    so a broken edit is caught by the suite, not by a silent watcher
+    death mid-round."""
+    r = subprocess.run(["sh", "-n", os.path.join(REPO, "tools", script)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
