@@ -40,7 +40,7 @@ from dsin_tpu.train import checkpoint as ckpt_lib
 from dsin_tpu.train import optim as optim_lib
 from dsin_tpu.train import step as step_lib
 from dsin_tpu.utils import (JsonlLogger, StepProfiler, StepTimer,
-                            color_print)
+                            color_print, install_interrupt_handlers)
 
 
 def get_validate_every(iteration: int, total_iterations: int,
@@ -275,6 +275,10 @@ class Experiment:
         the state after step j+1 — both harmless, both covered by tests."""
         if until_rate_target and rate_window < 1:
             raise ValueError(f"rate_window must be >= 1, got {rate_window}")
+        # SIGINT may be inherited ignored (async-job launch) and SIGTERM
+        # default-kills without unwinding — both must reach the
+        # BaseException emergency save below (dsin_tpu/utils/signals.py)
+        install_interrupt_handlers()
         cfg = self.ae_config
         # resume iteration numbering from a restored optimizer step — the
         # reference restarts numbering on resume (SURVEY §5); here a resumed

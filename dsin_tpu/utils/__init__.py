@@ -4,6 +4,7 @@ XLA trace capture."""
 from dsin_tpu.utils.logging import (JsonlLogger, StepTimer, color_print,
                                     device_memory_stats)
 from dsin_tpu.utils.profiling import StepProfiler
+from dsin_tpu.utils.signals import install_interrupt_handlers
 
 __all__ = ["JsonlLogger", "StepTimer", "color_print", "device_memory_stats",
-           "StepProfiler"]
+           "StepProfiler", "install_interrupt_handlers"]

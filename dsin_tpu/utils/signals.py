@@ -1,0 +1,47 @@
+"""Interrupt hardening for long training runs.
+
+The reference is a single interactive process with no signal story
+(reference main.py:21-126 — a Ctrl-C just kills it). Here, multi-hour
+runs are routinely ended from outside — `timeout -s INT` watchdogs, the
+relay watcher's deadline kill, driver cleanup — and the emergency
+checkpoint in `Experiment.train` only fires if the signal unwinds Python
+as an exception. Two launch quirks silently break that:
+
+- A POSIX shell starting a run as an async (`&`) job with job control
+  off sets SIGINT to SIG_IGN in the child (POSIX 2.11), and CPython then
+  does NOT install its KeyboardInterrupt handler — `timeout -s INT`
+  delivers a signal that is simply dropped, and the follow-up
+  `--kill-after` SIGKILL loses everything since the last periodic
+  checkpoint. Reinstalling `default_int_handler` unconditionally undoes
+  the inherited ignore.
+- SIGTERM's default action terminates the process without unwinding
+  Python at all, so a plain `kill` (or `timeout` with its default
+  signal) also skips the emergency save. Mapping it to KeyboardInterrupt
+  routes it down the exact same tested path.
+
+Installed at the top of `Experiment.train`; signal.signal is only legal
+in the main thread, so installation is skipped (harmless) elsewhere —
+e.g. when a test drives train() from a worker thread.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+
+def _raise_keyboard_interrupt(signum, frame):  # noqa: ARG001
+    raise KeyboardInterrupt(f"signal {signum}")
+
+
+def install_interrupt_handlers() -> bool:
+    """Make SIGINT and SIGTERM unwind the process as KeyboardInterrupt.
+
+    Returns True when handlers were installed (main thread), False when
+    skipped. Idempotent; safe to call once per train() invocation.
+    """
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    signal.signal(signal.SIGINT, signal.default_int_handler)
+    signal.signal(signal.SIGTERM, _raise_keyboard_interrupt)
+    return True

@@ -1,21 +1,29 @@
 #!/bin/sh
-# Relay watcher: probe the axon TPU relay on a short cycle; while it is
-# reachable, drain the remaining round-3 chip queue in priority order.
+# Relay watcher (round 4): probe the axon TPU relay on a short cycle;
+# while it is reachable, drain the chip queue in priority order.
 #
 #   sh tools/relay_watch.sh >> artifacts/relay_watch.log 2>&1 &
 #
-# Stage completion is recorded in artifacts/queue_state_r03.txt so a
+# Stage completion is recorded in artifacts/queue_state_r04.txt so a
 # watcher restart (or a mid-stage relay drop) never repeats finished
 # work; a stage that fails 3 times is skipped (recorded as skip:NAME)
 # so one broken stage cannot starve the rest of the queue.
 #
-# Queue rationale (VERDICT r02 "next round" items):
-#   breakdown/bench probes  — #2 MFU evidence, minutes each
-#   checks                  — #5 kernel timings incl. the tiled 320x960 row
-#   rd_refgeom              — #3/#4 the reference-geometry trained run
-#   rd_tpu_* + aggregate    — #3 pipeline-scale rate-target sweep
+# Queue rationale (VERDICT r03 "next round" items):
+#   bench_verbatim      — #4 run `python bench.py` verbatim in the FIRST
+#                         window: warms the XLA cache at the exact
+#                         bench-default config for the driver's
+#                         end-of-round capture, and banks an on-chip
+#                         number as backup evidence
+#   bench_b8/bench_remat— #2 the bench-default-informing A/Bs
+#   breakdown_bf16_floor— #5 dispatch-floor-corrected stage timings
+#   mfu_sweep           — #2 width/batch roofline
+#   checks              — #3 tiled-XLA vs Pallas parity at 320x960
+#   rd_refgeom          — #1 the reference-geometry trained point
+#   rd_tpu_0.02         — #7 low-rate chip RD point (0.04 is covered by
+#                         the in-flight CPU pipeline-scale run)
 cd "$(dirname "$0")/.." || exit 1
-STATE=artifacts/queue_state_r03.txt
+STATE=artifacts/queue_state_r04.txt
 touch "$STATE"
 
 # Single instance: a restart while the old watcher is mid-stage would
@@ -28,18 +36,70 @@ fi
 
 stage_done() { grep -qx "$1" "$STATE" || grep -qx "skip:$1" "$STATE"; }
 
+# The long-running CPU backstop RD run (pid in artifacts/.cpu_rd.pid) is
+# SIGSTOPped around timing-sensitive chip stages so host-side dispatch
+# latency is measured on a quiet core, and SIGCONTed right after — the
+# backstop loses wall-clock but no work.
+cpu_rd_pid() {
+  [ -f artifacts/.cpu_rd.pid ] || return 1
+  pid=$(cat artifacts/.cpu_rd.pid 2>/dev/null)
+  case "$pid" in ''|*[!0-9]*) return 1 ;; esac
+  kill -0 "$pid" 2>/dev/null || return 1
+  # the pid file is never deleted when the backstop exits, so guard
+  # against pid recycling before signalling: the target must actually be
+  # the synthetic_rd run, not whatever later process drew the number
+  grep -q synthetic_rd "/proc/$pid/cmdline" 2>/dev/null || return 1
+  echo "$pid"
+}
+pause_cpu() {
+  pid=$(cpu_rd_pid) || return 0
+  echo "[watch $(date +%H:%M:%S)] pausing CPU backstop (pid $pid)"
+  kill -STOP "$pid" 2>/dev/null
+}
+resume_cpu() {
+  pid=$(cpu_rd_pid) || return 0
+  echo "[watch $(date +%H:%M:%S)] resuming CPU backstop (pid $pid)"
+  kill -CONT "$pid" 2>/dev/null
+}
+# A watcher killed mid-run_quiet (restart, session death, crash) must not
+# leave the multi-hour backstop frozen: CONT is idempotent and harmless
+# when nothing is stopped. The signal traps must still TERMINATE (a bare
+# handler would swallow the signal and leave the watcher unkillable by
+# pid — the documented restart procedure); exiting there fires no EXIT
+# trap in POSIX sh, so resume_cpu runs explicitly first. Because POSIX sh
+# defers traps while a foreground command runs, run_stage backgrounds the
+# stage and `wait`s on it (wait IS interruptible by trapped signals) —
+# otherwise a kill during a 7 h rd stage would sit pending, the lock
+# would stay held, and a replacement watcher could not start. The pending
+# stage gets an INT on the way out so training writes its emergency
+# checkpoint.
+stage_pid=""
+trap resume_cpu EXIT
+# NOTE: under the documented async launch (`sh tools/relay_watch.sh … &`)
+# SIGINT arrives ignored and cannot be trapped (POSIX 2.11) — kill the
+# watcher with TERM (or HUP); the INT entry only serves foreground runs.
+# The stage subtree inherits SIGINT ignored from the async launch; the
+# python inside re-enables it (dsin_tpu.utils.signals, installed at
+# train() start), but the timeout/sh wrappers never do — so signal the
+# whole process GROUP (timeout makes its child a group leader), which
+# reaches python directly rather than asking the wrappers to forward.
+trap 'resume_cpu
+      if [ -n "$stage_pid" ]; then
+        kill -INT -- "-$stage_pid" 2>/dev/null \
+          || kill -INT "$stage_pid" 2>/dev/null
+      fi
+      trap - EXIT; exit 130' HUP INT TERM
+
 # Optional hard deadline (epoch seconds in artifacts/.watch_deadline,
 # written by the launcher BEFORE starting the watcher): the driver's
 # end-of-round bench needs the chip to itself, so no stage may still be
 # running when it fires. Stage budgets are clipped to the remaining time
 # minus a 300 s margin (INT → emergency checkpoint → kill-after all land
 # before the deadline), stages are not started inside the final 10
-# minutes, and the loop idles out the tail then exits. Stages killed at
-# a clipped budget take the same resumable -INT path as any other
-# timeout but are NOT counted toward the 3-strike skip — the kill says
-# nothing about the stage. A deadline that predates the watcher's own
-# launch is stale state from a previous round and is ignored, so a
-# watcher restart next session still drains the queue.
+# minutes, and the loop idles out the tail then exits. A deadline that
+# predates the watcher's own launch is stale state from a previous round
+# and is ignored, so a watcher restart next session still drains the
+# queue.
 start_ts=$(date +%s)
 read_deadline() {
   deadline=0
@@ -55,6 +115,36 @@ read_deadline() {
   fi
 }
 read_deadline
+
+# Commit landed evidence immediately: a relay drop, session death, or
+# end-of-round cleanup must not lose a captured artifact. Each pathspec
+# gets its own `git add` (one empty glob would otherwise abort the whole
+# add with nothing staged — git add exits 128 on a no-match pathspec) and
+# failures go to the watch log, not /dev/null: silently losing the
+# evidence-preservation commit is exactly the failure this exists to
+# prevent. The commit itself is restricted BY PATHSPEC so whatever the
+# interactive session has staged at that moment is left alone (git
+# commit with pathspecs ignores other staged content).
+commit_evidence() {
+  name=$1
+  # Quoted so git (not the shell) expands the glob: git's fnmatch lets
+  # '*' cross '/', so 'artifacts/*.json' covers nested stage outputs
+  # (e.g. rd_*/rd_synthetic.json) as well as top-level JSONs — one spec,
+  # identical for add and commit, so nothing can end up staged but
+  # uncommitted. Scoping the commit by pathspec keeps whatever else the
+  # interactive session has staged out of the evidence commit
+  # (`git commit -- p` commits working-tree content of tracked matches,
+  # which is why a broad `-- artifacts` form was rejected). The glob
+  # always matches tracked files, so the no-match commit abort cannot
+  # fire for it; TPU_CHECKS.json joins only while it exists.
+  for spec in 'artifacts/*.json' TPU_CHECKS.json; do
+    git add -- "$spec" 2>&1 | sed "s|^|[watch] git add $spec: |"
+  done
+  set -- 'artifacts/*.json'
+  [ -f TPU_CHECKS.json ] && set -- "$@" TPU_CHECKS.json
+  git commit -q -m "Land chip-queue stage output: $name" -- "$@" 2>&1 \
+    | sed 's|^|[watch] git commit: |'
+}
 
 # run_stage NAME TIMEOUT_S COMMAND — the timeout guards against the
 # relay's hang-don't-fail failure mode (the reason probe() itself needs
@@ -86,42 +176,60 @@ run_stage() {
     return 0
   fi
   echo "[watch $(date +%H:%M:%S)] stage $name starting (budget ${budget}s)"
+  stage_t0=$(date +%s)
   # -s INT: python sees KeyboardInterrupt, so training stages write their
   # emergency checkpoint (which the rd stages resume from on retry);
-  # --kill-after covers a process the INT cannot unstick
-  timeout -s INT --kill-after=120 "$budget" sh -c "$1" 9>&-
+  # --kill-after covers a process the INT cannot unstick. Backgrounded +
+  # wait (not foreground) so the watcher's signal traps run promptly
+  # mid-stage — see the trap comment above.
+  timeout -s INT --kill-after=120 "$budget" sh -c "$1" 9>&- &
+  stage_pid=$!
+  wait "$stage_pid"
   rc=$?
+  stage_pid=""
   if [ "$rc" -eq 0 ]; then
     echo "$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name done"
-    # Commit the landed JSON evidence immediately: a relay drop, session
-    # death, or end-of-round cleanup must not lose a captured artifact.
-    # (Image/score-list directories are curated into git manually.)
-    git add -- artifacts/*.json artifacts/*/rd_synthetic.json \
-        TPU_CHECKS.json 2>/dev/null
-    git commit -q -m "Land chip-queue stage output: $name" 2>/dev/null \
-      || true
+    commit_evidence "$name"
     return 0
   fi
   # Only count a failure toward the 3-strike skip when the relay is still
   # reachable afterwards: a stage killed by a mid-run relay drop (the
   # exact event this watcher exists to ride out) says nothing about the
   # stage itself, and the multi-hour rd stages would otherwise be
-  # silently cancelled by the flakiness they are queued behind. The same
-  # logic covers a deadline-clipped budget (rc 124 timeout / 137
-  # kill-after): the kill reflects the session ending, not the stage.
-  if [ "$clipped" -eq 1 ] && { [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; }; then
+  # silently cancelled by the flakiness they are queued behind. A
+  # deadline-clipped kill (rc 124 timeout / 137 kill-after) is likewise
+  # exempt — but ONLY when the stage actually ran to the clipped budget:
+  # 137 is also what an OOM-killed stage returns, and an early 137 must
+  # keep accumulating its 3-strike skip even while a deadline is active.
+  elapsed=$(( $(date +%s) - stage_t0 ))
+  if [ "$clipped" -eq 1 ] && { [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; } \
+      && [ "$elapsed" -ge $(( budget - 30 )) ]; then
     echo "[watch $(date +%H:%M:%S)] stage $name killed at the" \
          "deadline-clipped budget (not counted)"
   elif probe; then
     echo "fail:$name" >> "$STATE"
     echo "[watch $(date +%H:%M:%S)] stage $name failed with the relay up" \
-         "(attempt $((fails + 1)))"
+         "(attempt $((fails + 1)), rc $rc, ${elapsed}s elapsed)"
   else
     echo "[watch $(date +%H:%M:%S)] stage $name died during a relay drop" \
          "(not counted)"
   fi
   return 1
+}
+
+# run_quiet — run_stage with the CPU backstop paused: chip stages whose
+# numbers feed PERF_ANALYSIS / bench defaults must not time host-side
+# dispatch against a contended core. resume happens on every exit path.
+run_quiet() {
+  # done/skipped stages must not churn STOP/CONT (and two log lines)
+  # every loop iteration for a no-op
+  stage_done "$1" && return 0
+  pause_cpu
+  run_stage "$@"
+  rq_rc=$?
+  resume_cpu
+  return $rq_rc
 }
 
 probe() {
@@ -133,10 +241,8 @@ probe() {
 }
 
 all_done() {
-  for s in breakdown_bf16_floor breakdown_f32 \
-           bench_b8 mfu_sweep bench_remat \
-           checks rd_refgeom rd_tpu_0.02 rd_tpu_0.04 \
-           rd_aggregate; do
+  for s in bench_verbatim bench_b8 bench_remat breakdown_bf16_floor \
+           mfu_sweep checks rd_refgeom rd_tpu_0.02 rd_aggregate; do
     stage_done "$s" || return 1
   done
   return 0
@@ -148,6 +254,11 @@ while :; do
     now=$(date +%s)
     if [ "$now" -ge "$deadline" ]; then
       echo "[watch $(date +%H:%M:%S)] deadline reached; exiting"
+      # The driver's bench also wants a quiet HOST: if the CPU backstop
+      # is still running this close to round end it cannot finish
+      # anyway — INT it so it writes its emergency checkpoint and any
+      # partial artifact before the end-of-round capture.
+      pid=$(cpu_rd_pid) && kill -INT "$pid" 2>/dev/null
       break
     fi
     # Idle out the final window rather than re-probing the relay every
@@ -167,33 +278,32 @@ while :; do
   if probe; then
     echo "[watch $(date +%H:%M:%S)] relay up"
     # Stage commands mirror tools/tpu_session.sh (kept as the manual
-    # one-shot runner); this watcher is the authoritative round-3 queue —
+    # one-shot runner); this watcher is the authoritative round-4 queue —
     # change flags here first, then mirror them there.
+    # bench_verbatim runs FIRST and exactly as the driver will run it:
+    # the warm compile cache it leaves is what makes the end-of-round
+    # BENCH_r04 land inside its deadline.
+    run_quiet bench_verbatim 2400 'python bench.py > artifacts/.bench_r04_warm.json.tmp 2> artifacts/bench_r04_warm.log && mv artifacts/.bench_r04_warm.json.tmp artifacts/bench_r04_warm.json' || continue
+    run_quiet bench_b8 2400 'BENCH_BATCH=8 python bench.py > artifacts/.bench_b8.json.tmp 2> artifacts/bench_b8.log && mv artifacts/.bench_b8.json.tmp artifacts/bench_b8.json' || continue
+    run_quiet bench_remat 2400 'BENCH_REMAT=1 python bench.py > artifacts/.bench_remat.json.tmp 2> artifacts/bench_remat.log && mv artifacts/.bench_remat.json.tmp artifacts/bench_remat.json' || continue
     # Named _floor (not breakdown_bf16) so the already-done marker from
     # the pre-dispatch_floor run does not satisfy it: the committed
     # artifact predates the dispatch_floor stage and must be regenerated
     # once. Writes via temp+rename so a killed run cannot truncate the
     # committed headline artifact.
-    # Cheap stages that can change the end-of-round bench defaults
-    # (batch / remat) run FIRST — if the next relay window is short,
-    # their answers matter more than the diagnostic stages.
-    run_stage breakdown_bf16_floor 2400 'python tools/step_breakdown.py --batch 4 --dtype bfloat16 --profile_dir artifacts/xla_trace > artifacts/.step_breakdown_bf16_b4.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_bf16_b4.json.tmp artifacts/step_breakdown_bf16_b4.json' || continue
-    run_stage bench_b8 2400 'BENCH_BATCH=8 python bench.py > artifacts/bench_b8.json 2> artifacts/bench_b8.log' || continue
-    run_stage bench_remat 2400 'BENCH_REMAT=1 python bench.py > artifacts/bench_remat.json 2> artifacts/bench_remat.log' || continue
-    run_stage breakdown_f32 2400 'python tools/step_breakdown.py --batch 2 --dtype float32 > artifacts/.step_breakdown_f32_b2.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_f32_b2.json.tmp artifacts/step_breakdown_f32_b2.json' || continue
-    run_stage mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/mfu_sweep.json 2> artifacts/mfu_sweep.log' || continue
-    run_stage checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r03b.log' || continue
+    run_quiet breakdown_bf16_floor 2400 'python tools/step_breakdown.py --batch 4 --dtype bfloat16 --profile_dir artifacts/xla_trace > artifacts/.step_breakdown_bf16_b4.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_bf16_b4.json.tmp artifacts/step_breakdown_bf16_b4.json' || continue
+    run_quiet mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/.mfu_sweep.json.tmp 2> artifacts/mfu_sweep.log && mv artifacts/.mfu_sweep.json.tmp artifacts/mfu_sweep.json' || continue
+    run_quiet checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r04.log' || continue
+    # The big one: reference geometry (320x960 train / 320x1224 eval,
+    # 0.02 bpp), resumable across relay drops via the emergency/periodic
+    # checkpoints synthetic_rd discovers on retry. Runs with the CPU
+    # backstop live (throughput there does not feed perf claims).
     run_stage rd_refgeom 25200 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_kitti_stereo --out_root artifacts/rd_refgeom_bpp0.02 --data_dir /tmp/synth_refgeom --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 4000 --max_test_images 8 2> artifacts/rd_refgeom.log' || continue
-    # 0.16 was dropped from the chip sweep: CPU pipeline-scale points
-    # already land on-target at 0.16 (and 0.08), so the scarce relay
-    # time goes to the low-rate targets the CPU cannot reach in-session.
-    for bpp in 0.02 0.04; do
-      run_stage "rd_tpu_$bpp" 14400 "python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_synthetic_stereo --out_root artifacts/rd_tpu_bpp$bpp --data_dir /tmp/synth_tpu --target_bpp $bpp --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 6000 2> artifacts/rd_tpu_bpp$bpp.log"
-    done
-    # Aggregate only once every rd point is resolved (done or skipped) —
-    # marking it done while a point is still pending would freeze the
-    # curve without that point forever.
-    if stage_done rd_tpu_0.02 && stage_done rd_tpu_0.04; then
+    run_stage rd_tpu_0.02 14400 'python -m dsin_tpu.eval.synthetic_rd -ae_config dsin_tpu/configs/ae_synthetic_stereo --out_root artifacts/rd_tpu_bpp0.02 --data_dir /tmp/synth_tpu --target_bpp 0.02 --phase1_until_target --rate_window 300 --iterations 60000 --phase1_steps 60000 --phase2_steps 6000 2> artifacts/rd_tpu_bpp0.02.log' || continue
+    # Aggregate only once the rd point is resolved (done or skipped) —
+    # marking rd_aggregate done while the point is pending would freeze
+    # the curve without it forever.
+    if stage_done rd_tpu_0.02; then
       run_stage rd_aggregate 600 'python tools/aggregate_rd.py --glob "artifacts/rd_tpu_bpp*/rd_synthetic.json" --out artifacts/rd_tpu_curve.json --plot'
     fi
   else

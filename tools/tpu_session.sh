@@ -7,7 +7,7 @@
 #
 # Stages: bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
-# NOTE: tools/relay_watch.sh is the authoritative round-3 queue (per-stage
+# NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
@@ -19,13 +19,16 @@ for s in $STAGES; do
 rc=0
 case $s in
 bench)
-  # warms the persistent compile cache for the driver's end-of-round run
-  python bench.py > artifacts/bench_r03_warm.json \
-    2> artifacts/bench_r03_warm.log || rc=$?
+  # warms the persistent compile cache for the driver's end-of-round run;
+  # temp+rename so a mid-run kill cannot truncate committed evidence
+  python bench.py > artifacts/.bench_r04_warm.json.tmp \
+    2> artifacts/bench_r04_warm.log \
+    && mv artifacts/.bench_r04_warm.json.tmp \
+          artifacts/bench_r04_warm.json || rc=$?
   ;;
 checks)
-  # kernel-only timings incl. 320x960 (VERDICT r02 missing #3 / next #5)
-  python tools/tpu_checks.py 2> artifacts/tpu_checks_r03.log || rc=$?
+  # kernel parity + timings incl. the tiled-XLA 320x960 row (r03 weak #3)
+  python tools/tpu_checks.py 2> artifacts/tpu_checks_r04.log || rc=$?
   ;;
 breakdown)
   # step-time breakdown + XLA trace (VERDICT r02 next #2)
@@ -44,21 +47,27 @@ breakdown)
           artifacts/step_breakdown_f32_b2.json || rc=$?
   ;;
 mfu)
-  # MFU roofline sweep + remat A/B (artifacts/PERF_ANALYSIS.md levers)
-  python tools/mfu_sweep.py > artifacts/mfu_sweep.json \
-    2> artifacts/mfu_sweep.log || rc=$?
-  BENCH_REMAT=1 python bench.py > artifacts/bench_remat.json \
-    2> artifacts/bench_remat.log || rc=$?
-  BENCH_BATCH=8 python bench.py > artifacts/bench_b8.json \
-    2> artifacts/bench_b8.log || rc=$?
+  # MFU roofline sweep + remat A/B (artifacts/PERF_ANALYSIS.md levers);
+  # temp+rename throughout, mirroring relay_watch.sh
+  python tools/mfu_sweep.py > artifacts/.mfu_sweep.json.tmp \
+    2> artifacts/mfu_sweep.log \
+    && mv artifacts/.mfu_sweep.json.tmp artifacts/mfu_sweep.json || rc=$?
+  BENCH_REMAT=1 python bench.py > artifacts/.bench_remat.json.tmp \
+    2> artifacts/bench_remat.log \
+    && mv artifacts/.bench_remat.json.tmp artifacts/bench_remat.json \
+    || rc=$?
+  BENCH_BATCH=8 python bench.py > artifacts/.bench_b8.json.tmp \
+    2> artifacts/bench_b8.log \
+    && mv artifacts/.bench_b8.json.tmp artifacts/bench_b8.json || rc=$?
   ;;
 rd_sweep)
-  # rate-target-attaining RD points at pipeline scale, then the
+  # the remaining low-rate chip RD point (0.04 is covered by the CPU
+  # pipeline-scale backstop; 0.08/0.12/0.16 landed in r03), then the
   # reference-geometry run (320x960 train / 320x1224 eval; measured
-  # bitstream bpp comes from synthetic_rd's phase-2 test) — VERDICT r02
-  # next #3 and #4. --iterations lifts the config's 1500-step cap that
+  # bitstream bpp comes from synthetic_rd's phase-2 test) — VERDICT r03
+  # next #1/#7. --iterations lifts the config's 1500-step cap that
   # silently clamped r02's runs below their rate targets.
-  for bpp in 0.02 0.04 0.16; do
+  for bpp in 0.02; do
     python -m dsin_tpu.eval.synthetic_rd \
       -ae_config dsin_tpu/configs/ae_synthetic_stereo \
       --out_root "artifacts/rd_tpu_bpp$bpp" --data_dir /tmp/synth_tpu \
