@@ -85,3 +85,35 @@ def test_shell_runners_parse(script):
     r = subprocess.run(["sh", "-n", os.path.join(REPO, "tools", script)],
                        capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.slow
+def test_codec_bench_smoke_json_contract(tmp_path):
+    """Tiny-shape roundtrip through the real codec bench CLI; --out keeps
+    the committed CODEC_BENCH.json untouched."""
+    out = tmp_path / "codec.json"
+    r = _run("codec_bench.py", "--shapes", "8,16,24", "--out", str(out))
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(out.read_text())
+    (entry,) = report["entries"]
+    assert entry["shape"] == [8, 16, 24]
+    assert entry["symbols"] == 8 * 16 * 24
+    assert entry["encode_sym_per_s"] > 0
+    # image geometry is the bottleneck extent times the AE's 8x
+    assert entry["image"] == [128, 192]
+
+
+@pytest.mark.slow
+def test_cityscapes_exec_smoke(tmp_path):
+    """One EXECUTED width-sharded step at the smallest geometry the
+    ae_cityscapes_stereo contracts admit (32x128: 16|32 patch rows,
+    (128/4)%32==0 shard tiling) — pins the tool the full-geometry
+    artifact comes from."""
+    out = tmp_path / "exec.json"
+    r = _run("cityscapes_exec.py", "--steps", "1", "--crop", "32,128",
+             "--out", str(out))
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(out.read_text())
+    assert report["final_opt_step"] == 1
+    (step,) = report["steps"]
+    assert step["loss"] is not None and step["bpp"] > 0

@@ -85,7 +85,7 @@ trap resume_cpu EXIT
 # reaches python directly rather than asking the wrappers to forward.
 trap 'resume_cpu
       if [ -n "$stage_pid" ]; then
-        kill -INT -- "-$stage_pid" 2>/dev/null \
+        kill -INT "-$stage_pid" 2>/dev/null \
           || kill -INT "$stage_pid" 2>/dev/null
       fi
       trap - EXIT; exit 130' HUP INT TERM
