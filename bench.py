@@ -194,15 +194,10 @@ def run():
 
     _init_backend_with_retry(jax)
 
-    # per-platform cache dir: XLA:CPU AOT cache entries embed the compile
-    # machine's CPU features, and through the axon relay the compiling
-    # machine differs from this host — sharing one dir poisons the cache
-    # (feature-mismatch load errors, SIGILL risk)
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".cache", f"jax-{jax.default_backend()}")
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # per-platform cache dir (policy in dsin_tpu/utils/cache.py: relay
+    # cross-machine poisoning is why the dir is keyed by backend)
+    from dsin_tpu.utils import enable_compilation_cache
+    enable_compilation_cache()
 
     from dsin_tpu.config import parse_config_file
     from dsin_tpu.models.dsin import DSIN

@@ -1,10 +1,12 @@
 """Observability: step timing, scalar logging, device memory stats,
 XLA trace capture."""
 
+from dsin_tpu.utils.cache import enable_compilation_cache
 from dsin_tpu.utils.logging import (JsonlLogger, StepTimer, color_print,
                                     device_memory_stats)
 from dsin_tpu.utils.profiling import StepProfiler
 from dsin_tpu.utils.signals import install_interrupt_handlers
 
 __all__ = ["JsonlLogger", "StepTimer", "color_print", "device_memory_stats",
-           "StepProfiler", "install_interrupt_handlers"]
+           "StepProfiler", "install_interrupt_handlers",
+           "enable_compilation_cache"]

@@ -1,0 +1,35 @@
+"""One shared setup for jax's persistent compilation cache.
+
+Every measurement entry point (bench.py, tools/*, __graft_entry__)
+needs the same three lines; the policy they encode is subtle enough that
+the copies had already started to drift, so it lives here once:
+
+- the cache dir is keyed by BACKEND (``.cache/jax-<backend>``): XLA:CPU
+  AOT cache entries embed the compile machine's CPU features, and
+  through the axon relay the compiling machine differs from this host —
+  sharing one dir across backends poisons the cache (feature-mismatch
+  load errors, SIGILL risk);
+- ``.cache/`` is gitignored, so the driver's between-session clean
+  leaves it alone and second compiles stay warm across rounds;
+- the 1 s min-compile-time floor keeps thousands of trivial executables
+  out of the cache.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(tag: str | None = None) -> str:
+    """Point jax's persistent compilation cache at repo ``.cache/jax-<tag>``
+    (default tag: the default backend name). Returns the directory."""
+    import jax
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    cache_dir = os.path.join(repo, ".cache",
+                             f"jax-{tag or jax.default_backend()}")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

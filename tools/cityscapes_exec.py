@@ -30,8 +30,21 @@ import time
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# XLA's CPU collective rendezvous aborts the PROCESS if participants
+# don't all arrive within 40s (rendezvous.cc "Termination timeout ...
+# Exiting to ensure a consistent program state"). With 4+ virtual
+# devices timesharing ONE core, each device thread's pre-collective
+# segment at 1024x2048 runs for minutes, so the defaults are lethal for
+# exactly the geometry this tool exists to execute. Raise both the warn
+# and terminate thresholds well past the worst per-shard segment.
+for flag, val in (("xla_cpu_collective_call_warn_stuck_timeout_seconds",
+                   3600),
+                  ("xla_cpu_collective_call_terminate_timeout_seconds",
+                   14400)):
+    if flag not in _flags:
+        _flags += f" --{flag}={val}"
+os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -52,6 +65,8 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")
     assert jax.default_backend() == "cpu" and len(jax.devices()) >= 8
+    from dsin_tpu.utils import enable_compilation_cache
+    enable_compilation_cache()
 
     from dsin_tpu.config import parse_config_file
     from dsin_tpu.models.dsin import DSIN

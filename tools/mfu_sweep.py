@@ -58,12 +58,8 @@ def main(argv=None):
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    cache_dir = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".cache", f"jax-{jax.default_backend()}")
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from dsin_tpu.utils import enable_compilation_cache
+    enable_compilation_cache()
 
     from dsin_tpu.config import parse_config_file
     from dsin_tpu.models.dsin import DSIN
