@@ -23,6 +23,7 @@ import argparse
 import json
 import os
 import time
+from typing import Optional
 
 from dsin_tpu.config import parse_config_file
 from dsin_tpu.utils import color_print
@@ -64,6 +65,21 @@ def _latest_resumable(out_root: str, ae_config, ae_only: bool):
                 best_name = os.path.join(d, sub) if sub else d
                 best_step = step
     return best_name, best_step
+
+
+def _prior_best_dir(out_root: str, prior: Optional[str]):
+    """Candidate for Experiment.restore_best_for_test on a RESUMED phase:
+    the prior attempt's best-val dir. `prior` is what _latest_resumable
+    returned — possibly '<dir>/periodic' or '<dir>/emergency', whose
+    parent holds the prior best-val checkpoint (untouched by the new
+    attempt, which writes under its own timestamped name)."""
+    if not prior:
+        return ()
+    root = prior
+    for sub in ("periodic", "emergency"):
+        if root.endswith("/" + sub):
+            root = root[: -len(sub) - 1]
+    return (os.path.join(out_root, "weights", root),)
 
 
 def run_3phase(ae_config, pc_config, out_root: str,
@@ -137,6 +153,8 @@ def run_3phase(ae_config, pc_config, out_root: str,
         if not os.path.exists(os.path.join(exp1.ckpt_dir, "meta.json")):
             ckpt_lib.save_checkpoint(exp1.ckpt_dir, exp1.state,
                                      extra_meta={"kind": "phase1_final"})
+        exp1.restore_best_for_test(
+            extra_candidates=_prior_best_dir(out_root, prior))
         t1 = exp1.test(max_images=max_test_images, save_images=True)
         results["phase1"] = {"model_name": exp1.model_name, **r1}
         results["ae_only_test"] = t1
@@ -164,6 +182,8 @@ def run_3phase(ae_config, pc_config, out_root: str,
     steps2 = (max(phase2_steps - prior2_step, 1)
               if prior2 and phase2_steps else phase2_steps)
     r2 = exp2.train(max_steps=steps2)
+    exp2.restore_best_for_test(
+        extra_candidates=_prior_best_dir(out_root, prior2))
     t2 = exp2.test(max_images=max_test_images, save_images=True,
                    real_bpp=True)
     results["phase2"] = {"model_name": exp2.model_name, **r2}

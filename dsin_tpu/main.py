@@ -437,6 +437,48 @@ class Experiment:
         return BottleneckCodec.for_model(self.model,
                                          jax.device_get(self.state.params))
 
+    def restore_best_for_test(self, extra_candidates=()) -> Optional[str]:
+        """Test the state this run SHIPS, not the last training iterate.
+
+        Training can drift past its best validation (observed live on the
+        0.04 pipeline point: phase-2 best_val 24.2 at step 751, diverged
+        to 47.7 by 1500 — and the closing test silently scored the
+        diverged weights). The run's artifact is its best-val checkpoint,
+        and the reference likewise tests a RESTORED checkpoint, never the
+        in-memory tail of training (reference main.py:101-126 +
+        AE.load_model AE.py:158-175).
+
+        Candidates: this run's own ckpt_dir plus `extra_candidates`
+        (e.g. a prior attempt's best-val dir when this run RESUMED from
+        its periodic/emergency checkpoint — the resumed tail may never
+        beat the prior best, whose dir is untouched by the new attempt).
+        The candidate with the lowest recorded best_val wins; unreadable
+        or torn meta.json files are skipped, not fatal (a kill mid-save
+        can truncate one — same defense as synthetic_rd's
+        _latest_resumable). Returns the restored dir, or None when the
+        live state already is the best (or nothing restorable exists).
+        """
+        best_dir, best_val, best_meta = None, float("inf"), None
+        for cand in (self.ckpt_dir, *extra_candidates):
+            try:
+                meta = ckpt_lib.load_meta(cand)
+                val = float(meta["best_val"])
+            except (OSError, KeyError, ValueError):
+                continue
+            if val < best_val:
+                best_dir, best_val, best_meta = cand, val, meta
+        if best_dir is None:
+            return None
+        if (best_dir == self.ckpt_dir
+                and int(best_meta.get("step", -1)) == int(self.state.step)):
+            return None
+        self.state = ckpt_lib.restore_partitions(
+            best_dir, self.state, best_meta["partitions"])
+        color_print(f"test restores the best-val checkpoint {best_dir} "
+                    f"(step {best_meta.get('step')}, val {best_val}) over "
+                    f"the last training iterate", "yellow", bold=True)
+        return best_dir
+
     def test(self, max_images: Optional[int] = None,
              save_images: bool = True,
              save_plots: bool = False,
@@ -511,6 +553,10 @@ def run(ae_config: Config, pc_config: Config, out_root: str = ".",
                                  max_val_batches=max_val_batches,
                                  profile_dir=profile_dir))
     if ae_config.test_model:
+        if ae_config.train_model:
+            # never score the in-memory training tail (it may have
+            # diverged past its best validation) — test what the run ships
+            exp.restore_best_for_test()
         results.update(exp.test(max_images=max_test_images,
                                 real_bpp=real_bpp))
     return results

@@ -362,3 +362,49 @@ def test_until_rate_target_stops_early_and_checkpoints(tmp_path):
     assert np.isfinite(r["best_val"])    # closing validate ran
     ckpt = os.path.join(out, "weights", exp.model_name)
     assert os.path.exists(os.path.join(ckpt, "params_encoder.msgpack"))
+
+
+def test_restore_best_for_test_prefers_shipped_checkpoint(tmp_path):
+    """Training can diverge AFTER its best validation; the closing test
+    must score the best-val checkpoint (what the run ships), not the
+    in-memory tail — observed live on the 0.04 pipeline point (phase-2
+    best_val 24.2 at step 751, diverged to 47.7 by 1500)."""
+    import jax
+
+    from dsin_tpu.train import checkpoint as ckpt_lib
+
+    root = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _make_dataset(root)
+    ae, pc = _configs(root)
+
+    exp = Experiment(ae, pc, out_root=out)
+    exp.train(max_steps=2, max_val_batches=1)  # writes a best-val ckpt
+    saved_centers = np.asarray(exp.state.params["centers"]).copy()
+
+    # simulate post-best divergence of the live state
+    exp.state = exp.state.replace(
+        params={**exp.state.params,
+                "centers": exp.state.params["centers"] + 100.0},
+        step=exp.state.step + 5)
+    restored = exp.restore_best_for_test()
+    assert restored == exp.ckpt_dir
+    np.testing.assert_allclose(
+        np.asarray(exp.state.params["centers"]), saved_centers)
+
+    # torn meta must be skipped, not fatal
+    with open(os.path.join(exp.ckpt_dir, "meta.json"), "w") as f:
+        f.write('{"truncated')
+    assert exp.restore_best_for_test() is None
+
+    # an extra candidate (prior attempt's best dir) with a better val wins
+    prior_dir = os.path.join(out, "weights", "prior_attempt")
+    ckpt_lib.save_checkpoint(prior_dir, exp.state, best_val=-1.0)
+    prior_centers = np.asarray(exp.state.params["centers"]).copy()
+    exp.state = exp.state.replace(
+        params={**exp.state.params,
+                "centers": exp.state.params["centers"] + 7.0})
+    assert exp.restore_best_for_test(
+        extra_candidates=(prior_dir,)) == prior_dir
+    np.testing.assert_allclose(
+        np.asarray(exp.state.params["centers"]), prior_centers)
