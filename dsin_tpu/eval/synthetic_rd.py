@@ -153,12 +153,19 @@ def run_3phase(ae_config, pc_config, out_root: str,
         if not os.path.exists(os.path.join(exp1.ckpt_dir, "meta.json")):
             ckpt_lib.save_checkpoint(exp1.ckpt_dir, exp1.state,
                                      extra_meta={"kind": "phase1_final"})
-        exp1.restore_best_for_test(
+        best1 = exp1.restore_best_for_test(
             extra_candidates=_prior_best_dir(out_root, prior))
         t1 = exp1.test(max_images=max_test_images, save_images=True)
-        results["phase1"] = {"model_name": exp1.model_name, **r1}
+        # phase 2 (and the done-marker) must point at the checkpoint the
+        # test just SCORED: on a resumed phase 1 that never beat the prior
+        # attempt's best_val, that is the prior attempt's dir — while
+        # exp1.model_name's dir holds only the last-iterate phase1_final
+        # weights, and warm-starting phase 2 from those would silently
+        # build on weights worse than the reported phase-1 quality.
+        phase1_name = (os.path.relpath(best1, exp1.weights_root)
+                       if best1 else exp1.model_name)
+        results["phase1"] = {"model_name": phase1_name, **r1}
         results["ae_only_test"] = t1
-        phase1_name = exp1.model_name
         with open(marker1, "w") as f:
             json.dump({"phase1": results["phase1"],
                        "ae_only_test": t1}, f, indent=2)
@@ -182,11 +189,20 @@ def run_3phase(ae_config, pc_config, out_root: str,
     steps2 = (max(phase2_steps - prior2_step, 1)
               if prior2 and phase2_steps else phase2_steps)
     r2 = exp2.train(max_steps=steps2)
-    exp2.restore_best_for_test(
+    # same two guarantees as phase 1: the new model_name dir always holds
+    # SOMETHING restorable (a resumed tail that never improves saves no
+    # checkpoint there otherwise), and the recorded name points at the
+    # checkpoint the closing test actually scored
+    if not os.path.exists(os.path.join(exp2.ckpt_dir, "meta.json")):
+        ckpt_lib.save_checkpoint(exp2.ckpt_dir, exp2.state,
+                                 extra_meta={"kind": "phase2_final"})
+    best2 = exp2.restore_best_for_test(
         extra_candidates=_prior_best_dir(out_root, prior2))
     t2 = exp2.test(max_images=max_test_images, save_images=True,
                    real_bpp=True)
-    results["phase2"] = {"model_name": exp2.model_name, **r2}
+    phase2_name = (os.path.relpath(best2, exp2.weights_root)
+                   if best2 else exp2.model_name)
+    results["phase2"] = {"model_name": phase2_name, **r2}
     results["with_si_test"] = t2
     results["wall_clock_s"] = round(time.time() - t0, 1)
 

@@ -303,6 +303,22 @@ class Experiment:
         n_accum = 0
         val_losses = []
         h_recent: "collections.deque" = collections.deque(maxlen=rate_window)
+        # Divergence guard: stop when val loss sits above
+        # divergence_factor x best_val for divergence_patience CONSECUTIVE
+        # validations. Training past its best validation is normal noise;
+        # a sustained multiple of it is divergence (observed live on the
+        # 0.04 pipeline point's phase 2: best_val 24.2 at step 751,
+        # 47.7 by 1500 — every post-best step there was wasted compute,
+        # and only restore_best_for_test kept it out of the scores). The
+        # best-val checkpoint already holds the run's artifact, so
+        # stopping loses nothing; divergence_patience=0 disables. The 1.5
+        # default factor is set BELOW that observed 1.97x excursion: a
+        # guard calibrated at 2.0 would have slept through the exact case
+        # that motivated it.
+        div_factor = float(cfg.get("divergence_factor", 1.5))
+        div_patience = int(cfg.get("divergence_patience", 3) or 0)
+        div_bad = 0
+        diverged = False
 
         try:
             from tqdm import trange
@@ -314,9 +330,10 @@ class Experiment:
         def process(j, metrics):
             """Host-side handling of step j's metrics (step j+1 may already
             be in flight — see the docstring's lag-1 note). Updates
-            best_val/accum via nonlocal; returns ONLY whether the
-            rate-target stop fired."""
-            nonlocal accum, n_accum, best_val
+            best_val/accum via nonlocal; returns ONLY whether an early
+            stop fired (rate target reached, or the divergence guard —
+            distinguishable afterwards via the `diverged` flag)."""
+            nonlocal accum, n_accum, best_val, div_bad, diverged
             timer.tick()
             for k in ("loss", "bpp", "H_real", "d_loss", "si_l1"):
                 accum[k] = accum.get(k, 0.0) + float(metrics[k])
@@ -364,6 +381,27 @@ class Experiment:
                 best_val = self._validate_and_maybe_save(
                     j, iterations, best_val, val_losses, logger,
                     max_val_batches)
+                val_loss = val_losses[-1]
+                # only finite-over-finite counts toward the guard: an inf
+                # val_loss means the val split produced zero batches (its
+                # own loud warning), not divergence
+                if (div_patience and np.isfinite(val_loss)
+                        and np.isfinite(best_val)
+                        and val_loss > div_factor * best_val):
+                    div_bad += 1
+                    if div_bad >= div_patience:
+                        diverged = True
+                        color_print(
+                            f"[{j + 1}] DIVERGENCE STOP: val_loss above "
+                            f"{div_factor:g}x best_val "
+                            f"({best_val:.4f}) for {div_bad} consecutive "
+                            f"validations — stopping; the best-val "
+                            f"checkpoint is the run's artifact "
+                            f"(restore_best_for_test scores it)",
+                            "red", bold=True)
+                        return True
+                else:
+                    div_bad = 0
             return False
 
         pending = None   # (step index, device metrics) awaiting processing
@@ -424,6 +462,7 @@ class Experiment:
 
         return {"steps": timer.total_steps, "best_val": best_val,
                 "last_val": val_losses[-1] if val_losses else float("inf"),
+                "diverged_stop": diverged,
                 "images_per_sec": timer.images_per_sec(cfg.batch_size)}
 
     # -- test ---------------------------------------------------------------
@@ -443,10 +482,12 @@ class Experiment:
         Training can drift past its best validation (observed live on the
         0.04 pipeline point: phase-2 best_val 24.2 at step 751, diverged
         to 47.7 by 1500 — and the closing test silently scored the
-        diverged weights). The run's artifact is its best-val checkpoint,
-        and the reference likewise tests a RESTORED checkpoint, never the
-        in-memory tail of training (reference main.py:101-126 +
-        AE.load_model AE.py:158-175).
+        diverged weights). The run's artifact is its best-val checkpoint.
+        This intentionally diverges from the reference's combined
+        train+test run (reference main.py:45-126 scores the LIVE session
+        weights there) and instead matches its separate-test workflow
+        (load_model=True: reference main.py:101-126 + AE.load_model
+        AE.py:158-175), which restores a checkpoint before scoring.
 
         Candidates: this run's own ckpt_dir plus `extra_candidates`
         (e.g. a prior attempt's best-val dir when this run RESUMED from

@@ -9,6 +9,14 @@ the copies had already started to drift, so it lives here once:
   through the axon relay the compiling machine differs from this host —
   sharing one dir across backends poisons the cache (feature-mismatch
   load errors, SIGILL risk);
+- CPU-backed dirs are additionally keyed by a HOST CPU-FEATURE
+  FINGERPRINT: ``.cache/`` survives the driver's between-session clean
+  (gitignored), and consecutive rounds can land on hosts with different
+  CPU features — an AOT entry compiled on last round's host then loads
+  here with a machine-feature-mismatch error and explicit SIGILL risk
+  in the tail of driver artifacts (seen in MULTICHIP_r04.json). Keying
+  the dir by the feature set makes a mismatched entry unfindable
+  instead of load-and-hope;
 - ``.cache/`` is gitignored, so the driver's between-session clean
   leaves it alone and second compiles stay warm across rounds;
 - the 1 s min-compile-time floor keeps thousands of trivial executables
@@ -17,18 +25,45 @@ the copies had already started to drift, so it lives here once:
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 
+def host_cpu_fingerprint() -> str:
+    """8-hex digest of this host's CPU feature flags (/proc/cpuinfo).
+
+    Order-normalized so kernels that list the same features differently
+    still share a cache dir. Falls back to "nofp" where /proc/cpuinfo
+    is unavailable (non-Linux), collapsing to the old per-backend key.
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(flags.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    return "nofp"
+
+
 def enable_compilation_cache(tag: str | None = None) -> str:
-    """Point jax's persistent compilation cache at repo ``.cache/jax-<tag>``
-    (default tag: the default backend name). Returns the directory."""
+    """Point jax's persistent compilation cache at repo
+    ``.cache/jax-<tag>[-<host fingerprint>]`` (default tag: the default
+    backend name; the fingerprint joins for CPU-executed code, where
+    XLA AOT-compiles to this host's machine features). Returns the
+    directory."""
     import jax
 
     repo = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    cache_dir = os.path.join(repo, ".cache",
-                             f"jax-{tag or jax.default_backend()}")
+    tag = tag or jax.default_backend()
+    # Any cpu-tagged cache (including the dryrun's explicit "dryrun-cpu")
+    # holds host-feature-specific AOT results; TPU executables are
+    # compiled relay-side for the chip and are host-portable.
+    if "cpu" in tag:
+        tag = f"{tag}-{host_cpu_fingerprint()}"
+    cache_dir = os.path.join(repo, ".cache", f"jax-{tag}")
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,175 @@
+"""Run the full Cityscapes-geometry train step on ONE real TPU chip.
+
+VERDICT r04 #6: the 1024x2048 width-sharded step has executed on the
+8-virtual-device CPU mesh (tools/cityscapes_exec.py) but never on real
+hardware. Multi-chip hardware does not exist in this environment, so the
+reachable on-chip form is single-chip: the SAME ae_cityscapes_stereo
+operating point (bf16 compute, remat'd residual trunk, (16,32) patch
+grid) with spatial_shards=1 and the row-chunked search engine
+(`sifinder_impl='xla_tiled'`, ops/sifinder.py search_single_tiled) —
+the O(row_chunk * Wc * P) memory design that exists precisely so this
+extent fits one chip where the materialized score map
+(~Hc*Wc*P ~ 8.3e12 elements) cannot.
+
+Writes artifacts/cityscapes_chip.json: compile time, per-step wall
+times, and the device's own memory accounting (peak/in-use HBM bytes).
+On RESOURCE_EXHAUSTED it retries with a smaller `sifinder_row_chunk`
+and, failing everything, records the measured account of why the
+geometry does not fit — either outcome is the evidence VERDICT asked
+for.
+
+Usage (relay must be up — the watcher gates this):
+    python tools/cityscapes_chip.py [--steps 3] [--crop 1024,2048]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mem_stats(dev):
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:  # noqa: BLE001 — optional API, absent on some backends
+        return {}
+    return {k: int(v) for k, v in stats.items()
+            if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                     "largest_alloc_size")}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--crop", default="1024,2048")
+    p.add_argument("--out", default="artifacts/cityscapes_chip.json")
+    p.add_argument("--allow_cpu", action="store_true",
+                   help="smoke-test the tool wiring on CPU at a tiny crop "
+                        "(never evidence; the artifact is marked)")
+    args = p.parse_args(argv)
+    crop_h, crop_w = (int(v) for v in args.crop.split(","))
+
+    if args.allow_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.allow_cpu:
+        # the env var alone is NOT enough here: this environment
+        # pre-imports jax (site hook) with JAX_PLATFORMS=axon baked in,
+        # so only a config.update before the first backend init actually
+        # repins — without it jax.devices() hangs on the downed relay
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    assert args.allow_cpu or dev.platform == "tpu", (
+        f"needs the real chip, got {dev.platform}")
+    from dsin_tpu.utils import enable_compilation_cache
+    enable_compilation_cache()
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    from dsin_tpu.train import optim as optim_lib
+    from dsin_tpu.train import step as step_lib
+
+    base = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "dsin_tpu", "configs")
+    pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
+
+    rng = np.random.default_rng(0)
+
+    def frame(shift):
+        yy, xx = np.mgrid[0:crop_h, 0:crop_w]
+        base_img = (128 + 80 * np.sin(2 * np.pi * (xx + shift) / 256)
+                    * np.cos(2 * np.pi * yy / 128))
+        noise = rng.normal(0, 8, (crop_h, crop_w, 3))
+        return np.clip(base_img[..., None] + noise, 0, 255).astype(
+            np.float32)[None]
+
+    x_np, y_np = frame(0), frame(17)
+
+    report = {"config": "ae_cityscapes_stereo (spatial_shards=1)",
+              "crop": [crop_h, crop_w], "platform": str(dev.platform),
+              "device": str(dev.device_kind),
+              "note": ("single-chip on-chip execution of the BASELINE.md "
+                       "stretch geometry via the row-chunked search "
+                       "(multi-chip hardware unavailable; the width-"
+                       "sharded form of this program is executed on the "
+                       "virtual mesh in artifacts/cityscapes_exec.json)"),
+              "attempts": []}
+
+    for row_chunk in (32, 16, 8):
+        ae_cfg = parse_config_file(
+            os.path.join(base, "ae_cityscapes_stereo")).replace(
+            spatial_shards=1, sifinder_impl="xla_tiled",
+            sifinder_row_chunk=row_chunk,
+            crop_size=(crop_h, crop_w), eval_crop_size=(crop_h, crop_w))
+        attempt = {"sifinder_row_chunk": row_chunk, "remat": True,
+                   "compute_dtype": str(ae_cfg.compute_dtype)}
+        report["attempts"].append(attempt)
+        try:
+            model = DSIN(ae_cfg, pc_cfg)
+            tx = optim_lib.build_optimizer(None, ae_cfg, pc_cfg,
+                                           num_training_imgs=100)
+            state = step_lib.create_train_state(
+                model, jax.random.PRNGKey(0), (1, 80, 96, 3), tx)
+            mask = jnp.asarray(gaussian_position_mask(
+                crop_h, crop_w, *ae_cfg.y_patch_size))
+            step = step_lib.make_train_step(model, tx, si_mask=mask)
+            x = jax.device_put(jnp.asarray(x_np))
+            y = jax.device_put(jnp.asarray(y_np))
+
+            t0 = time.time()
+            state, metrics = step(state, x, y)
+            loss0 = float(metrics["loss"])
+            attempt["compile_plus_first_step_s"] = round(time.time() - t0, 1)
+            attempt["first_loss"] = loss0
+            assert np.isfinite(loss0), metrics
+            walls = []
+            for i in range(args.steps):
+                t1 = time.time()
+                state, metrics = step(state, x, y)
+                jax.block_until_ready(metrics["loss"])
+                walls.append(round(time.time() - t1, 2))
+                print(f"[chip] step {i}: {walls[-1]}s "
+                      f"loss={float(metrics['loss']):.2f}",
+                      file=sys.stderr, flush=True)
+            attempt["step_wall_s"] = walls
+            attempt["loss_final"] = float(metrics["loss"])
+            attempt["bpp"] = float(metrics["bpp"])
+            attempt["memory"] = _mem_stats(dev)
+            attempt["ok"] = True
+            report["ok"] = True
+            break
+        except Exception as e:  # noqa: BLE001 — OOM class varies by backend
+            msg = repr(e)
+            attempt["ok"] = False
+            attempt["error"] = msg[:2000]
+            attempt["memory"] = _mem_stats(dev)
+            oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            print(f"[chip] row_chunk={row_chunk} failed "
+                  f"({'OOM' if oom else 'error'}): {msg[:300]}",
+                  file=sys.stderr, flush=True)
+            if not oom:
+                raise
+    else:
+        report["ok"] = False
+        report["note"] += (" — did not fit one chip at any row_chunk; "
+                           "the attempts[] list is the measured account")
+
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, args.out)
+    print(json.dumps({"metric": "cityscapes_chip_ok",
+                      "value": bool(report.get("ok")), "out": args.out}))
+    return 0 if report.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

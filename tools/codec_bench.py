@@ -12,11 +12,11 @@ CODEC_BENCH.json. Two shapes by default:
 
 Symbols are uniform-random — the worst case for the context model, so
 the byte count is an upper bound, not a rate claim. The engine is
-per-image sequential by design (the symbol stream is causal), but
-embarrassingly parallel ACROSS images/sides: a test-split encode farms
-one volume per worker with no shared state, so multi-core hosts scale
-throughput linearly. This 1-core driver container cannot demonstrate
-that scaling; the per-image number here is the per-worker cost.
+per-image sequential by design (the symbol stream is causal); volumes
+share no state, so a test-split encode CAN farm one volume per worker,
+but this 1-core container cannot measure that scaling and no scaling
+factor is claimed (VERDICT r04 #9) — the number here is the measured
+per-image, single-worker cost.
 
 Usage:  python tools/codec_bench.py [--shapes 32,40,120 32,128,256]
         (CPU only; forces JAX_PLATFORMS=cpu)
@@ -121,11 +121,12 @@ def main(argv=None) -> int:
         "host": "1-core CPU (driver container)",
         "note": ("full-image bottleneck roundtrips; symbols uniform-random "
                  "(worst case for the context model, so bytes ~= upper "
-                 "bound). Per-image coding is sequential by causality but "
-                 "independent across images/sides — multi-core hosts "
-                 "scale throughput linearly by farming one volume per "
-                 "worker. Previous jit wavefront engine: 44.8s enc / "
-                 "44.5s dec at (32,40,120)."),
+                 "bound). Per-image coding is sequential by causality; "
+                 "volumes share no state (one volume per worker is "
+                 "possible), but this 1-core host cannot measure that "
+                 "scaling and none is claimed — these are measured "
+                 "per-image, single-worker costs. Previous jit wavefront "
+                 "engine: 44.8s enc / 44.5s dec at (32,40,120)."),
         "entries": entries,
     }
     path = args.out

@@ -1,0 +1,134 @@
+"""Re-run an RD point's phase 2 (+siNet) with the divergence guard active.
+
+VERDICT r04 weak #4 / next #4: the 0.04 pipeline point's phase 2
+diverged after its best validation (24.2 at step 751 -> 47.7 by 1500,
+a 1.97x post-best excursion) and round 4 only fixed the SCORING
+(restore_best_for_test). This tool addresses the divergence itself: it
+warm-starts phase 2 from the SAME phase-1 best-val checkpoint the
+original run used (copied into a fresh out_root so the original
+artifact's provenance is untouched) and trains with
+`Experiment.train`'s divergence guard (main.py: stop after
+`divergence_patience` consecutive validations above
+`divergence_factor` x best_val), then scores the shipped checkpoint.
+
+The emitted JSON holds the full validation curve, so "no sustained
+post-best blowup survived into the result" is checkable directly.
+
+Usage:
+  python tools/phase2_guard_rerun.py --src artifacts/rd_pipe_bpp0.04 \
+      --data_dir /tmp/synth_pipe [--phase2_steps 1500]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+# hard override, not setdefault: the driver environment pre-imports jax
+# with JAX_PLATFORMS=axon; dsin_tpu re-applies this env var via
+# config.update at import, which is what actually repins
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    p.add_argument("-ae_config",
+                   default=os.path.join(base, "ae_synthetic_stereo"))
+    p.add_argument("-pc_config", default=os.path.join(base, "pc_default"))
+    p.add_argument("--src", required=True,
+                   help="finished RD point dir (holds rd_synthetic.json)")
+    p.add_argument("--out_root", default=None,
+                   help="default: <src>_ph2guard")
+    p.add_argument("--data_dir", default=None)
+    p.add_argument("--phase2_steps", type=int, default=1500)
+    p.add_argument("--max_test_images", type=int, default=None)
+    args = p.parse_args(argv)
+    out_root = args.out_root or args.src.rstrip("/") + "_ph2guard"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.main import Experiment
+    from dsin_tpu.utils import color_print
+
+    with open(os.path.join(args.src, "rd_synthetic.json")) as f:
+        src_results = json.load(f)
+    phase1_name = src_results["phase1"]["model_name"]
+
+    # fresh out_root with ONLY the phase-1 warm-start checkpoint: new
+    # sinet checkpoints must not enter the original artifact's weights
+    # dir, where retest_rd_point's best-val discovery would pick them up
+    src_ckpt = os.path.join(args.src, "weights", phase1_name)
+    dst_ckpt = os.path.join(out_root, "weights", phase1_name)
+    if not os.path.exists(dst_ckpt):
+        os.makedirs(os.path.dirname(dst_ckpt), exist_ok=True)
+        shutil.copytree(src_ckpt, dst_ckpt)
+
+    ae_config = parse_config_file(args.ae_config).replace(
+        H_target=src_results["H_target"], AE_only=False,
+        load_model=True, load_model_name=phase1_name,
+        load_train_step=False, train_model=True, test_model=False,
+        iterations=60000, checkpoint_every=500)
+    pc_config = parse_config_file(args.pc_config)
+    if args.data_dir:
+        ae_config = ae_config.replace(root_data=args.data_dir)
+        synth = os.path.join(args.data_dir, "synthetic_stereo_train.txt")
+        if os.path.exists(synth):
+            ae_config = ae_config.replace(
+                **{f"file_path_{s}": f"synthetic_stereo_{s}.txt"
+                   for s in ("train", "val", "test")})
+
+    exp = Experiment(ae_config, pc_config, out_root=out_root)
+    exp.maybe_restore()
+    color_print(f"guarded phase-2 rerun (+siNet) -> {exp.model_name}",
+                "cyan", bold=True)
+    log_path = os.path.join(out_root, "logs", f"{exp.model_name}.jsonl")
+    r2 = exp.train(max_steps=args.phase2_steps, log_path=log_path)
+    exp.restore_best_for_test()
+    t2 = exp.test(max_images=args.max_test_images, save_images=True,
+                  real_bpp=True)
+
+    # JsonlLogger writes flat {ts, step, **scalars} records; validation
+    # passes are the ones carrying val_loss
+    val_curve = []
+    with open(log_path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "val_loss" in rec:
+                val_curve.append({"step": rec["step"],
+                                  "val_loss": rec["val_loss"]})
+
+    report = {
+        "src": args.src,
+        "phase1_warm_start": phase1_name,
+        "H_target": src_results["H_target"],
+        "divergence_factor": ae_config.get("divergence_factor", 1.5),
+        "divergence_patience": ae_config.get("divergence_patience", 3),
+        "phase2": {"model_name": exp.model_name, **r2},
+        "val_curve": val_curve,
+        "with_si_test": t2,
+        "original_phase2": {
+            "best_val": src_results["phase2"]["best_val"],
+            "last_val": src_results["phase2"]["last_val"],
+            "with_si_test": src_results["with_si_test"]},
+    }
+    out_path = out_root.rstrip("/") + ".json"
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
+    print(json.dumps({"out": out_path,
+                      "diverged_stop": r2.get("diverged_stop"),
+                      "steps": r2.get("steps"),
+                      "best_val": r2.get("best_val"),
+                      "last_val": r2.get("last_val"),
+                      "with_si_psnr": t2.get("psnr")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

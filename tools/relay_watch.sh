@@ -1,5 +1,5 @@
 #!/bin/sh
-# Relay watcher (round 4): probe the axon TPU relay on a short cycle;
+# Relay watcher (round 5): probe the axon TPU relay on a short cycle;
 # while it is reachable, drain the chip queue in priority order.
 #
 #   sh tools/relay_watch.sh >> artifacts/relay_watch.log 2>&1 &
@@ -19,11 +19,13 @@
 #   breakdown_bf16_floor— #5 dispatch-floor-corrected stage timings
 #   mfu_sweep           — #2 width/batch roofline
 #   checks              — #3 tiled-XLA vs Pallas parity at 320x960
-#   rd_refgeom          — #1 the reference-geometry trained point
-#   rd_tpu_0.02         — #7 low-rate chip RD point (0.04 is covered by
-#                         the in-flight CPU pipeline-scale run)
+#   cityscapes_chip     — r04 #6 the 1024x2048 step on the real chip
+#                         (single-chip, row-chunked search)
+#   rd_refgeom          — #2 the reference-geometry trained point
+#   rd_tpu_0.02         — low-rate chip RD point (the CPU backstop covers
+#                         pipeline-scale 0.02 in parallel)
 cd "$(dirname "$0")/.." || exit 1
-STATE=artifacts/queue_state_r04.txt
+STATE=artifacts/queue_state_r05.txt
 touch "$STATE"
 
 # Single instance: a restart while the old watcher is mid-stage would
@@ -51,15 +53,59 @@ cpu_rd_pid() {
   grep -q synthetic_rd "/proc/$pid/cmdline" 2>/dev/null || return 1
   echo "$pid"
 }
+# Secondary CPU jobs (the 0.04 phase-2 rerun, the long-horizon micro
+# run) register one pid per line in artifacts/.cpu_aux.pids; they get the
+# same STOP/CONT treatment so chip-stage timings always see a quiet core.
+cpu_aux_pids() {
+  [ -f artifacts/.cpu_aux.pids ] || return 0
+  while read -r pid; do
+    case "$pid" in ''|*[!0-9]*) continue ;; esac
+    kill -0 "$pid" 2>/dev/null || continue
+    # same pid-recycling guard as cpu_rd_pid — but aux jobs are repo
+    # TOOLS (tools/phase2_guard_rerun.py etc.), whose cmdlines carry
+    # 'tools/' rather than 'dsin_tpu'
+    grep -qE 'dsin_tpu|tools/' "/proc/$pid/cmdline" 2>/dev/null || continue
+    echo "$pid"
+  done < artifacts/.cpu_aux.pids
+}
+all_cpu_pids() { cpu_rd_pid; cpu_aux_pids; }
 pause_cpu() {
-  pid=$(cpu_rd_pid) || return 0
-  echo "[watch $(date +%H:%M:%S)] pausing CPU backstop (pid $pid)"
-  kill -STOP "$pid" 2>/dev/null
+  for pid in $(all_cpu_pids); do
+    echo "[watch $(date +%H:%M:%S)] pausing CPU job (pid $pid)"
+    kill -STOP "$pid" 2>/dev/null
+  done
 }
 resume_cpu() {
-  pid=$(cpu_rd_pid) || return 0
-  echo "[watch $(date +%H:%M:%S)] resuming CPU backstop (pid $pid)"
-  kill -CONT "$pid" 2>/dev/null
+  for pid in $(all_cpu_pids); do
+    echo "[watch $(date +%H:%M:%S)] resuming CPU job (pid $pid)"
+    kill -CONT "$pid" 2>/dev/null
+  done
+}
+# Deadline quiesce (ADVICE r04): an async-launched python that has not
+# yet entered train() inherits SIGINT ignored and would silently drop
+# the INT — poll briefly, escalate to TERM (mapped onto the same
+# KeyboardInterrupt unwind once install_interrupt_handlers has run, a
+# default kill before that), and finally STOP, so the end-of-round
+# capture is GUARANTEED a quiet host either way.
+quiesce_cpu() {
+  pids=$(all_cpu_pids)
+  [ -n "$pids" ] || return 0
+  echo "[watch $(date +%H:%M:%S)] quiescing CPU jobs: $pids"
+  for pid in $pids; do kill -CONT "$pid" 2>/dev/null;                        kill -INT "$pid" 2>/dev/null; done
+  for sig in TERM STOP; do
+    i=0
+    while [ "$i" -lt 12 ]; do
+      alive=""
+      for pid in $pids; do
+        kill -0 "$pid" 2>/dev/null && alive="$alive $pid"
+      done
+      [ -z "$alive" ] && return 0
+      sleep 5; i=$((i + 1))
+    done
+    echo "[watch $(date +%H:%M:%S)] escalating to $sig:$alive"
+    for pid in $alive; do kill -"$sig" "$pid" 2>/dev/null; done
+    pids=$alive
+  done
 }
 # A watcher killed mid-run_quiet (restart, session death, crash) must not
 # leave the multi-hour backstop frozen: CONT is idempotent and harmless
@@ -242,7 +288,8 @@ probe() {
 
 all_done() {
   for s in bench_verbatim bench_b8 bench_remat breakdown_bf16_floor \
-           mfu_sweep checks rd_refgeom rd_tpu_0.02 rd_aggregate; do
+           mfu_sweep checks cityscapes_chip rd_refgeom rd_tpu_0.02 \
+           rd_aggregate; do
     stage_done "$s" || return 1
   done
   return 0
@@ -254,11 +301,15 @@ while :; do
     now=$(date +%s)
     if [ "$now" -ge "$deadline" ]; then
       echo "[watch $(date +%H:%M:%S)] deadline reached; exiting"
-      # The driver's bench also wants a quiet HOST: if the CPU backstop
-      # is still running this close to round end it cannot finish
-      # anyway — INT it so it writes its emergency checkpoint and any
-      # partial artifact before the end-of-round capture.
-      pid=$(cpu_rd_pid) && kill -INT "$pid" 2>/dev/null
+      # The driver's bench also wants a quiet HOST: any CPU job still
+      # running this close to round end cannot finish anyway — INT it so
+      # it writes its emergency checkpoint and any partial artifact, and
+      # escalate until the host is actually quiet (ADVICE r04). The EXIT
+      # trap's resume_cpu would CONT the very pids the STOP escalation
+      # just froze — clear it on this path (and only this path: mid-run
+      # kills still want a live backstop resumed).
+      quiesce_cpu
+      trap - EXIT
       break
     fi
     # Idle out the final window rather than re-probing the relay every
@@ -282,8 +333,8 @@ while :; do
     # change flags here first, then mirror them there.
     # bench_verbatim runs FIRST and exactly as the driver will run it:
     # the warm compile cache it leaves is what makes the end-of-round
-    # BENCH_r04 land inside its deadline.
-    run_quiet bench_verbatim 2400 'python bench.py > artifacts/.bench_r04_warm.json.tmp 2> artifacts/bench_r04_warm.log && mv artifacts/.bench_r04_warm.json.tmp artifacts/bench_r04_warm.json' || continue
+    # BENCH_r05 land inside its deadline.
+    run_quiet bench_verbatim 2400 'python bench.py > artifacts/.bench_r05_warm.json.tmp 2> artifacts/bench_r05_warm.log && mv artifacts/.bench_r05_warm.json.tmp artifacts/bench_r05_warm.json' || continue
     run_quiet bench_b8 2400 'BENCH_BATCH=8 python bench.py > artifacts/.bench_b8.json.tmp 2> artifacts/bench_b8.log && mv artifacts/.bench_b8.json.tmp artifacts/bench_b8.json' || continue
     run_quiet bench_remat 2400 'BENCH_REMAT=1 python bench.py > artifacts/.bench_remat.json.tmp 2> artifacts/bench_remat.log && mv artifacts/.bench_remat.json.tmp artifacts/bench_remat.json' || continue
     # Named _floor (not breakdown_bf16) so the already-done marker from
@@ -293,7 +344,11 @@ while :; do
     # committed headline artifact.
     run_quiet breakdown_bf16_floor 2400 'python tools/step_breakdown.py --batch 4 --dtype bfloat16 --profile_dir artifacts/xla_trace > artifacts/.step_breakdown_bf16_b4.json.tmp 2>> artifacts/step_breakdown.log && mv artifacts/.step_breakdown_bf16_b4.json.tmp artifacts/step_breakdown_bf16_b4.json' || continue
     run_quiet mfu_sweep 3600 'python tools/mfu_sweep.py > artifacts/.mfu_sweep.json.tmp 2> artifacts/mfu_sweep.log && mv artifacts/.mfu_sweep.json.tmp artifacts/mfu_sweep.json' || continue
-    run_quiet checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r04.log' || continue
+    run_quiet checks 5400 'python tools/tpu_checks.py 2> artifacts/tpu_checks_r05.log' || continue
+    # VERDICT r04 #6: the 1024x2048 geometry on the real chip (single
+    # chip, row-chunked search). Quiet: its step timings + HBM accounting
+    # are the evidence.
+    run_quiet cityscapes_chip 3600 'python tools/cityscapes_chip.py 2> artifacts/cityscapes_chip.log' || continue
     # The big one: reference geometry (320x960 train / 320x1224 eval,
     # 0.02 bpp), resumable across relay drops via the emergency/periodic
     # checkpoints synthetic_rd discovers on retry. Runs with the CPU

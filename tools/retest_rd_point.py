@@ -62,6 +62,8 @@ def main(argv=None) -> int:
                 **{f"file_path_{s}": f"synthetic_stereo_{s}.txt"
                    for s in ("train", "val", "test")})
 
+    from dsin_tpu.train import checkpoint as ckpt_lib
+
     for phase_key, test_key, ae_only, real_bpp in (
             ("phase1", "ae_only_test", True, False),
             ("phase2", "with_si_test", False, True)):
@@ -71,14 +73,29 @@ def main(argv=None) -> int:
                                 train_model=False, test_model=True)
         exp = Experiment(cfg, pc_config, out_root=args.out_root)
         exp.maybe_restore()
+        # model_name alone is not trustworthy: on a run whose phase was
+        # RESUMED and never improved, it points at a dir holding only the
+        # last-iterate phase*_final checkpoint — scoring that would keep
+        # the exact tail this tool exists to supersede. Mirror
+        # synthetic_rd._latest_resumable's discovery: every same-prefix
+        # dir under out_root/weights competes, and restore_best_for_test
+        # restores the one with the lowest RECORDED best_val (dirs
+        # without one — phase*_final, periodic, emergency — are skipped).
+        prefix = ckpt_lib.model_name_for(cfg, "")
+        weights = os.path.join(args.out_root, "weights")
+        cands = sorted(os.path.join(weights, d)
+                       for d in os.listdir(weights)
+                       if d.startswith(prefix))
+        best = exp.restore_best_for_test(extra_candidates=cands)
+        scored = (os.path.relpath(best, exp.weights_root) if best else name)
         t = exp.test(max_images=args.max_test_images, save_images=True,
                      real_bpp=real_bpp)
         old = results[test_key]
         if old != t:
             results[f"{test_key}_last_iterate"] = old
         results[test_key] = t
-        results[f"{test_key}_checkpoint"] = name
-        print(f"{test_key}: {t}", file=sys.stderr, flush=True)
+        results[f"{test_key}_checkpoint"] = scored
+        print(f"{test_key} ({scored}): {t}", file=sys.stderr, flush=True)
 
     results["retested_from_best_checkpoints"] = True
     tmp = rd_path + ".tmp"
