@@ -178,11 +178,25 @@ def run_3phase(ae_config, pc_config, out_root: str,
     if prior2:
         color_print(f"phase 2 resumes from {prior2} (step {prior2_step})",
                     "yellow")
+    # Phase-scoped divergence guard: phase 2's validation profile is
+    # tighter than phase 1's (measured: healthy +siNet runs oscillate to
+    # <=1.41x best with no two consecutive >1.3x — rd_pipe_bpp0.06/0.12
+    # logs — while the diverging 0.04 phase 2 put 39.0/24.2 = 1.61x TWO
+    # validations running at steps 875/1000 on its way to 2.06x). 1.3/2
+    # stops that case ~500 steps early; phase 1 keeps train()'s looser
+    # 1.5/3 default, which its larger rate-hinge noise needs (a 1.3/2
+    # guard would have false-stopped the healthy 0.04 phase 1 at step
+    # 11000, before its 12,522-step rate-target bind). Explicit config
+    # values still win.
     cfg2 = ae_config.replace(AE_only=False, load_model=True,
                              load_model_name=prior2 or phase1_name,
                              load_train_step=prior2 is not None,
                              train_model=True, test_model=False,
-                             checkpoint_every=ckpt_every)
+                             checkpoint_every=ckpt_every,
+                             divergence_factor=ae_config.get(
+                                 "divergence_factor", 1.3),
+                             divergence_patience=ae_config.get(
+                                 "divergence_patience", 2))
     exp2 = Experiment(cfg2, pc_config, out_root=out_root)
     exp2.maybe_restore()
     color_print(f"phase 2 (+siNet) -> {exp2.model_name}", "cyan", bold=True)

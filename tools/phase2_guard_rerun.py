@@ -69,11 +69,15 @@ def main(argv=None) -> int:
         os.makedirs(os.path.dirname(dst_ckpt), exist_ok=True)
         shutil.copytree(src_ckpt, dst_ckpt)
 
+    # 1.3/2 is the phase-2-scoped guard (see synthetic_rd.run_3phase):
+    # measured healthy phase 2s stay under it; the diverging 0.04
+    # trajectory trips it at step ~1000, max post-best excursion 1.61x
     ae_config = parse_config_file(args.ae_config).replace(
         H_target=src_results["H_target"], AE_only=False,
         load_model=True, load_model_name=phase1_name,
         load_train_step=False, train_model=True, test_model=False,
-        iterations=60000, checkpoint_every=500)
+        iterations=60000, checkpoint_every=500,
+        divergence_factor=1.3, divergence_patience=2)
     pc_config = parse_config_file(args.pc_config)
     if args.data_dir:
         ae_config = ae_config.replace(root_data=args.data_dir)
@@ -107,8 +111,8 @@ def main(argv=None) -> int:
         "src": args.src,
         "phase1_warm_start": phase1_name,
         "H_target": src_results["H_target"],
-        "divergence_factor": ae_config.get("divergence_factor", 1.5),
-        "divergence_patience": ae_config.get("divergence_patience", 3),
+        "divergence_factor": ae_config.get("divergence_factor", 1.3),
+        "divergence_patience": ae_config.get("divergence_patience", 2),
         "phase2": {"model_name": exp.model_name, **r2},
         "val_curve": val_curve,
         "with_si_test": t2,
