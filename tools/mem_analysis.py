@@ -1,0 +1,101 @@
+"""HBM memory accounting for the bench train step at batch 4 vs 8.
+
+Round-5 follow-up to the measured `bench_b8` regression (4.34 img/s at
+b8 vs 10.79 at b4, artifacts/bench_b8.json): if the 2.5x per-FLOP
+efficiency drop is a memory-residency cliff, XLA's own compile-time
+memory analysis will show the b8 program's temp (activation) allocation
+crossing the v5e's HBM budget — forcing serialization of what the b4
+program keeps resident. This tool prints that accounting from the
+compiler, per batch size, as one JSON line per program.
+
+Mirrors bench.py's exact step construction (ae_kitti_stereo at 320x960,
+bf16 compute, Pallas search, donated state) but lowers from
+jax.ShapeDtypeStructs — no init, no host->device transfer, no execution;
+the only expensive part is the compile, and both programs are already in
+the persistent cache from the bench_verbatim/bench_b8 stages.
+
+Usage (relay up):
+    python tools/mem_analysis.py > artifacts/mem_analysis.json
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCHES = tuple(
+    int(b) for b in os.environ.get("MEM_BATCHES", "4,8").split(","))
+CROP_H = int(os.environ.get("BENCH_CROP_H", "320"))
+CROP_W = int(os.environ.get("BENCH_CROP_W", "960"))
+PATCH_H, PATCH_W = 20, 24
+# v5e HBM per chip; the number the temp allocation is read against.
+HBM_BYTES = 16 * 1024**3
+
+
+def main() -> int:
+    import jax
+
+    from dsin_tpu.utils import enable_compilation_cache
+    enable_compilation_cache()
+
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.ops.sifinder import gaussian_position_mask
+    from dsin_tpu.train import optim as optim_lib
+    from dsin_tpu.train import step as step_lib
+
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dsin_tpu", "configs")
+    ae_cfg = parse_config_file(os.path.join(base, "ae_kitti_stereo"))
+    pc_cfg = parse_config_file(os.path.join(base, "pc_default"))
+    compute_dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+
+    mask = gaussian_position_mask(CROP_H, CROP_W, PATCH_H, PATCH_W)
+    out = []
+    for batch in BATCHES:
+        shape = (batch, CROP_H, CROP_W, 3)
+        cfg_b = ae_cfg.replace(
+            batch_size=batch, crop_size=(CROP_H, CROP_W), AE_only=False,
+            load_model=False, train_model=True, test_model=False,
+            compute_dtype=compute_dtype, sifinder_impl=impl)
+        model = DSIN(cfg_b, pc_cfg)
+        tx = optim_lib.build_optimizer(None, cfg_b, pc_cfg,
+                                       num_training_imgs=1576)
+        state_sds = jax.eval_shape(
+            lambda m=model, t=tx, s=shape: step_lib.create_train_state(
+                m, jax.random.PRNGKey(0), s, t))
+        x_sds = jax.ShapeDtypeStruct(shape, "float32")
+        train_step = step_lib.make_train_step(model, tx, si_mask=mask,
+                                              donate=True)
+        compiled = train_step.lower(state_sds, x_sds, x_sds).compile()
+        mem = compiled.memory_analysis()
+        row = {"batch": batch, "crop": [CROP_H, CROP_W],
+               "compute_dtype": compute_dtype, "impl": impl,
+               "backend": jax.default_backend()}
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                row[k] = int(v)
+        temp = row.get("temp_size_in_bytes")
+        args_b = row.get("argument_size_in_bytes", 0)
+        alias = row.get("alias_size_in_bytes", 0)
+        if temp is not None:
+            # live non-aliased arguments + temps is the resident set the
+            # scheduler must fit into HBM alongside the output
+            row["resident_est_bytes"] = int(temp + args_b - alias)
+            row["temp_frac_of_hbm"] = round(temp / HBM_BYTES, 4)
+        out.append(row)
+        print(f"[mem] b{batch}: " + ", ".join(
+            f"{k}={row[k]/1e9:.2f}GB" for k in (
+                "temp_size_in_bytes", "argument_size_in_bytes",
+                "output_size_in_bytes") if k in row), file=sys.stderr)
+    print(json.dumps({"hbm_bytes": HBM_BYTES, "programs": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
