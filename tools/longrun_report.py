@@ -132,9 +132,9 @@ def main(argv=None) -> int:
         "curve": curve,
     }
     # verdicts the judge can check without re-deriving
-    report["decayed"] = len(decays) >= 1 and lrs[-1] < lrs[0] * 0.2
-    report["stable"] = (len(tenths) == 10
-                       and tenths[-1] <= 1.5 * min(tenths))
+    report["decayed"] = bool(len(decays) >= 1 and lrs[-1] < lrs[0] * 0.2)
+    report["stable"] = bool(len(tenths) == 10
+                            and tenths[-1] <= 1.5 * min(tenths))
 
     out = args.out or args.out_root.rstrip("/") + ".json"
     with open(out + ".tmp", "w") as f:
