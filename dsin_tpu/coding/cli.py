@@ -9,9 +9,14 @@ siNet fusion) — the asymmetry that defines the method: the ENCODER never
 sees y, so the bitstream is identical with or without it.
 
 File format (little-endian):
-    b"DSIM" | u8 version | u16 img_h | u16 img_w | u32 payload_len | payload
+    b"DSIM" | u8 version | u16 img_h | u16 img_w | u32 init_seed
+            | u32 payload_len | payload
 where payload is a BottleneckCodec stream (its own header carries the
-symbol-volume dims).
+symbol-volume dims). `init_seed` is the parameter-init PRNG seed the
+encoder ran with: when no --ckpt restores real weights, the decoder MUST
+rebuild the identical random model or the rANS probabilities diverge and
+the decode silently produces garbage — so decompress defaults to the
+header's seed and only an explicit --seed overrides it.
 
 Usage:
     python -m dsin_tpu.coding.cli compress  x.png out.dsin --ckpt weights/m
@@ -31,13 +36,18 @@ import jax.numpy as jnp
 import numpy as np
 
 MAGIC = b"DSIM"
-VERSION = 1
+VERSION = 2            # v2: header records the parameter-init seed
+_HEADER_LEN = 17       # magic(4) + BHH(5) + seed(4) + payload_len(4)
 
 
 def _load_model_state(ae_config_path: str, pc_config_path: str,
                       ckpt_dir: Optional[str], img_shape,
-                      need_sinet: bool):
-    """Build DSIN (+ optional checkpoint restore) with a minimal state."""
+                      need_sinet: bool, seed: int = 0):
+    """Build DSIN (+ optional checkpoint restore) with a minimal state.
+
+    `seed` drives the parameter init and only matters when no checkpoint
+    is restored (smoke runs / tests); it rides the CLI's --seed flag so
+    un-checkpointed runs are reproducible without a hard-coded key."""
     from dsin_tpu.config import parse_config_file
     from dsin_tpu.models.dsin import DSIN
     from dsin_tpu.train import checkpoint as ckpt_lib
@@ -48,7 +58,7 @@ def _load_model_state(ae_config_path: str, pc_config_path: str,
         ae_cfg = ae_cfg.replace(AE_only=True)
     pc_cfg = parse_config_file(pc_config_path)
     model = DSIN(ae_cfg, pc_cfg)
-    variables = model.init_variables(jax.random.PRNGKey(0),
+    variables = model.init_variables(jax.random.PRNGKey(seed),
                                      (1, *img_shape, 3))
     state = TrainState(params=variables.params,
                        batch_stats=variables.batch_stats,
@@ -67,7 +77,7 @@ def _make_codec(model, state):
 
 
 def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
-             ckpt: Optional[str] = None) -> dict:
+             ckpt: Optional[str] = None, seed: int = 0) -> dict:
     from dsin_tpu.coding.codec import encode_batch
     from dsin_tpu.data.loader import decode_image
 
@@ -76,15 +86,20 @@ def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
     if h % 8 or w % 8:
         raise ValueError(
             f"image {h}x{w} must be divisible by the subsampling factor 8")
+    if not 0 <= seed < 2 ** 32:
+        # the header stores u32; a masked seed would init DIFFERENT weights
+        # on the decode side and silently corrupt the reconstruction
+        raise ValueError(f"seed must fit u32 (0 <= seed < 2**32), got {seed}")
     model, state = _load_model_state(ae_config, pc_config, ckpt, (h, w),
-                                     need_sinet=False)
+                                     need_sinet=False, seed=seed)
     enc_out, _ = model.encode(state.params, state.batch_stats,
                               jnp.asarray(x[None]), train=False)
     symbols = np.asarray(enc_out.symbols[0])          # (h/8, w/8, C)
     payload = encode_batch(_make_codec(model, state), symbols[None])[0]
 
     with open(out_path, "wb") as f:
-        f.write(MAGIC + struct.pack("<BHHI", VERSION, h, w, len(payload)))
+        f.write(MAGIC + struct.pack("<BHHII", VERSION, h, w, seed,
+                                    len(payload)))
         f.write(payload)
     bpp = len(payload) * 8.0 / (h * w)
     return {"bytes": len(payload), "bpp": bpp, "shape": (h, w)}
@@ -92,19 +107,27 @@ def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
 
 def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
                ckpt: Optional[str] = None,
-               side: Optional[str] = None) -> dict:
+               side: Optional[str] = None,
+               seed: Optional[int] = None) -> dict:
+    """`seed=None` (default) re-inits with the seed recorded in the
+    stream header — the only value that can reproduce the encoder's
+    weights when no checkpoint restores them. An explicit int overrides
+    (and will corrupt the reconstruction if it disagrees; the header
+    makes that an opt-in footgun instead of the default)."""
     from dsin_tpu.coding.codec import decode_batch
     from dsin_tpu.data.loader import decode_image
     from dsin_tpu.models.quantizer import centers_lookup
 
     with open(in_path, "rb") as f:
         blob = f.read()
-    if len(blob) < 13 or blob[:4] != MAGIC:
+    if len(blob) < _HEADER_LEN or blob[:4] != MAGIC:
         raise ValueError("not a DSIM stream")
-    version, h, w, n = struct.unpack("<BHHI", blob[4:13])
+    version, h, w, hdr_seed, n = struct.unpack("<BHHII", blob[4:_HEADER_LEN])
     if version != VERSION:
         raise ValueError(f"unsupported version {version}")
-    payload = blob[13:13 + n]
+    if seed is None:
+        seed = hdr_seed
+    payload = blob[_HEADER_LEN:_HEADER_LEN + n]
     if len(payload) != n:
         # the rANS decoder cannot detect truncation itself — it would
         # silently produce garbage symbols
@@ -112,7 +135,7 @@ def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
                          f"{n} bytes")
 
     model, state = _load_model_state(ae_config, pc_config, ckpt, (h, w),
-                                     need_sinet=side is not None)
+                                     need_sinet=side is not None, seed=seed)
     if side is not None:
         # validate the SI path up front — the entropy decode below is the
         # slow part and must not be wasted on a doomed reconstruction
@@ -167,6 +190,14 @@ def main(argv=None) -> None:
                         default=os.path.join(base, "pc_default"))
         sp.add_argument("--ckpt", default=None,
                         help="checkpoint dir (weights/<model_name>)")
+    sub.choices["compress"].add_argument(
+        "--seed", type=int, default=0,
+        help="parameter-init PRNG seed, recorded in the stream header "
+             "(matters when no --ckpt restores weights)")
+    sub.choices["decompress"].add_argument(
+        "--seed", type=int, default=None,
+        help="override the stream header's init seed (a mismatch "
+             "corrupts the reconstruction — default: trust the header)")
     sub.choices["decompress"].add_argument(
         "--side", default=None,
         help="decoder-side information image (enables the SI path)")
@@ -174,12 +205,13 @@ def main(argv=None) -> None:
 
     if args.cmd == "compress":
         info = compress(args.input, args.output, args.ae_config,
-                        args.pc_config, args.ckpt)
+                        args.pc_config, args.ckpt, seed=args.seed)
         print(f"{args.output}: {info['bytes']} bytes, "
               f"{info['bpp']:.4f} bpp @ {info['shape']}")
     else:
         info = decompress(args.input, args.output, args.ae_config,
-                          args.pc_config, args.ckpt, args.side)
+                          args.pc_config, args.ckpt, args.side,
+                          seed=args.seed)
         print(f"{args.output}: reconstructed {info['shape']}"
               f"{' with side information' if info['with_si'] else ''}")
 

@@ -53,6 +53,7 @@ The two jit engines remain as independently-derived cross-checks:
 
 from __future__ import annotations
 
+import functools
 import struct
 from typing import Optional, Tuple
 
@@ -112,17 +113,21 @@ class BottleneckCodec:
             pc_config, jnp.asarray(self.centers))
         self.pad_value = float(np.asarray(pad_value))
 
-        variables = {"params": pc_params}
-
-        def _block_logits(block):  # (cd, cs, cs) -> (L,)
+        # params enter as a traced pytree ARGUMENT, not a closure capture:
+        # a captured dict would rebind per BottleneckCodec instance and
+        # re-trace per identity (jaxlint: nonstatic-jit-capture)
+        def _block_logits(variables, block):  # (cd, cs, cs) -> (L,)
             out = self.model.apply(variables, block[None, ..., None])
             return out[0, 0, 0, 0, :]
 
-        self._block_logits = jax.jit(_block_logits)
+        variables = {"params": pc_params}
+        self._block_logits = functools.partial(
+            jax.jit(_block_logits), variables)
         # batched twin for wavefront fronts: (B, cd, cs, cs) -> (B, L).
         # vmap of the same per-block computation; all fronts are padded to
         # one bucket size so encode and decode hit the same executable.
-        self._block_logits_batch = jax.jit(jax.vmap(_block_logits))
+        self._block_logits_batch = functools.partial(
+            jax.jit(jax.vmap(_block_logits, in_axes=(None, 0))), variables)
         self._incremental = None  # lazy numpy engine (wavefront_np mode)
 
     def _incremental_engine(self):

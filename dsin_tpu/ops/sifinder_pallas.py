@@ -44,12 +44,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
 from dsin_tpu.ops import color as color_lib
 from dsin_tpu.ops import sifinder as sifinder_lib
 from dsin_tpu.ops.patches import assemble_patches, extract_patches
+from dsin_tpu.utils.jax_compat import pl, pltpu, require_pallas
 
 _NEG_INF = float("-inf")
 _GROUP = 8          # correlation rows per grid step (sublane alignment unit)
@@ -127,6 +125,7 @@ def fused_pearson_argmax(y_t: jnp.ndarray, patches_mat: jnp.ndarray,
     Returns (best_val (B, P) f32, best_idx (B, P) int32) with
     best_idx = row * Wc + col, matching jnp.argmax of the flattened map.
     """
+    require_pallas()
     b, chans, h, w = y_t.shape
     _, p_count, k = patches_mat.shape
     _, hc, wc = inv_denom.shape

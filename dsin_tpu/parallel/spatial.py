@@ -44,6 +44,7 @@ from dsin_tpu.ops import color as color_lib
 from dsin_tpu.ops import sifinder
 from dsin_tpu.ops.patches import assemble_patches, extract_patches
 from dsin_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+from dsin_tpu.utils.jax_compat import shard_map
 
 
 def _halo_from_right(z: jnp.ndarray, halo: int, axis_name: str):
@@ -188,7 +189,7 @@ def build_synthesize_shmap(mesh, patch_h: int, patch_w: int,
                      row_chunk=row_chunk)
         return jax.vmap(fn)(x_dec, y_img, y_dec)
 
-    shmap = jax.shard_map(
+    shmap = shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(DATA_AXIS, None, None, None),
                   P(DATA_AXIS, None, SPATIAL_AXIS, None),

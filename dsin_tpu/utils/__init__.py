@@ -5,8 +5,12 @@ from dsin_tpu.utils.cache import enable_compilation_cache
 from dsin_tpu.utils.logging import (JsonlLogger, StepTimer, color_print,
                                     device_memory_stats)
 from dsin_tpu.utils.profiling import StepProfiler
+from dsin_tpu.utils.recompile import (CompilationSentinel,
+                                      RecompilationBudgetExceeded,
+                                      compilation_count, watch)
 from dsin_tpu.utils.signals import install_interrupt_handlers
 
 __all__ = ["JsonlLogger", "StepTimer", "color_print", "device_memory_stats",
            "StepProfiler", "install_interrupt_handlers",
-           "enable_compilation_cache"]
+           "enable_compilation_cache", "CompilationSentinel",
+           "RecompilationBudgetExceeded", "compilation_count", "watch"]

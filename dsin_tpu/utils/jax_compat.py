@@ -1,0 +1,54 @@
+"""Version-compat shims for JAX APIs that move between releases.
+
+jaxlint's `bare-experimental-import` rule points every other module here:
+this is the ONE file allowed to touch `jax.experimental` directly, so the
+next upstream API move is absorbed in one place instead of N call sites.
+
+Current shims:
+  * `shard_map` — `jax.shard_map` graduated out of jax.experimental (and
+    renamed its replication-checker kwarg `check_rep` -> `check_vma` on
+    the way). Callers use the new spelling; older jax falls back to
+    `jax.experimental.shard_map.shard_map` with the kwarg mapped.
+  * `pl` / `pltpu` — Pallas has no stable import path yet; import it here
+    once, `None` when this jax build ships without it (CPU-only builds),
+    and let `require_pallas()` raise a actionable error at use time.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pl", "pltpu", "require_pallas"]
+
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` when this jax has it, else the jax.experimental
+    ancestor. `check_vma` maps onto the older `check_rep` — both toggle
+    the same replication/varying-axes validity checker."""
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:          # CPU-only / minimal jax builds
+    pl = None
+    pltpu = None
+
+
+def require_pallas() -> None:
+    """Raise with a config hint when Pallas is missing from this build."""
+    if pl is None:
+        raise ImportError(
+            f"jax.experimental.pallas is unavailable in this jax build "
+            f"({jax.__version__}) — set sifinder_impl to 'xla' or "
+            f"'xla_tiled' instead of 'pallas'")

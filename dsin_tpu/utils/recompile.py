@@ -1,0 +1,126 @@
+"""Runtime recompilation sentinel: count XLA compiles, fail on excess.
+
+Silent recompilation is the JAX failure mode pytest cannot see: a step
+function that retraces per call (shape drift, container captures, weak
+types) still returns correct numbers — it just burns minutes of TPU time
+per step. jaxlint (tools/jaxlint) catches the static patterns; this
+module catches the rest at runtime by counting backend compiles through
+`jax.monitoring`'s event stream and comparing against a budget.
+
+The counter is process-global and monotonic (jax.monitoring offers no
+listener removal, so ONE listener registers on first use and everything
+else diffs snapshots of its count). Per-function attribution works by
+snapshotting around calls — valid under the tests' single-threaded use.
+
+Use:
+    with CompilationSentinel(budget=1, label="train_step"):
+        step(state, x, y)          # raises if > 1 compile happens
+
+    step = watch(jax.jit(fn), budget=2)   # cumulative budget per wrapper
+
+    @pytest.mark.compile_budget(2)        # via tests/conftest.py
+    def test_step_compiles_once(...): ...
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Callable, Optional
+
+import jax.monitoring
+
+#: events that mean "XLA built a new executable". jaxpr_trace fires for
+#: cheap retraces that hit the executable cache; backend_compile is the
+#: expensive one the budget is about.
+_COMPILE_EVENTS = frozenset({
+    "/jax/core/compile/backend_compile_duration",
+})
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    global _count
+    if event in _COMPILE_EVENTS:
+        with _lock:
+            _count += 1
+
+
+def install() -> None:
+    """Register the global compile listener (idempotent)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+def compilation_count() -> int:
+    """Backend compiles observed process-wide since install()."""
+    install()
+    return _count
+
+
+class RecompilationBudgetExceeded(AssertionError):
+    """More XLA compiles than the declared budget — a hot function is
+    being rebuilt instead of reused."""
+
+
+class CompilationSentinel:
+    """Context manager: fail when the region compiles more than `budget`
+    times. `raise_on_exceed=False` turns it into a pure counter
+    (`.compilations` after exit)."""
+
+    def __init__(self, budget: int, label: str = "",
+                 raise_on_exceed: bool = True):
+        if budget < 0:
+            raise ValueError(f"budget must be >= 0, got {budget}")
+        self.budget = budget
+        self.label = label
+        self.raise_on_exceed = raise_on_exceed
+        self.compilations: Optional[int] = None
+
+    def __enter__(self) -> "CompilationSentinel":
+        install()
+        self._start = compilation_count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.compilations = compilation_count() - self._start
+        # never mask an in-flight exception with the budget report
+        if exc_type is None and self.raise_on_exceed \
+                and self.compilations > self.budget:
+            what = f" [{self.label}]" if self.label else ""
+            raise RecompilationBudgetExceeded(
+                f"compilation budget exceeded{what}: {self.compilations} "
+                f"XLA compiles > budget {self.budget} — a jitted function "
+                f"is recompiling (shape/dtype drift, non-static capture, "
+                f"or a fresh wrapper per call)")
+
+
+def watch(fn: Callable, budget: int, label: Optional[str] = None
+          ) -> Callable:
+    """Wrap a (jitted) callable with a CUMULATIVE compile budget across
+    all its calls: call #1 may compile, steady-state calls must not.
+    The wrapper exposes `.compilations` for inspection."""
+    name = label or getattr(fn, "__name__", repr(fn))
+
+    @functools.wraps(fn, updated=())
+    def wrapper(*args, **kwargs):
+        install()
+        before = compilation_count()
+        result = fn(*args, **kwargs)   # an fn error propagates unmasked
+        wrapper.compilations += compilation_count() - before
+        if wrapper.compilations > budget:
+            raise RecompilationBudgetExceeded(
+                f"[{name}] compiled {wrapper.compilations} times, "
+                f"budget {budget} — the step function is recompiling "
+                f"instead of reusing its executable")
+        return result
+
+    wrapper.compilations = 0
+    return wrapper

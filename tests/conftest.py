@@ -18,6 +18,26 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert all(d.platform == "cpu" for d in jax.devices())
+
+
+@pytest.fixture(autouse=True)
+def _compile_budget(request):
+    """Recompilation sentinel behind `@pytest.mark.compile_budget(n)`:
+    the marked test FAILS if more than n XLA backend compiles happen
+    while it runs (dsin_tpu/utils/recompile.py). Unmarked tests pay
+    nothing beyond one global-counter read."""
+    marker = request.node.get_closest_marker("compile_budget")
+    if marker is None:
+        yield
+        return
+    if not marker.args or not isinstance(marker.args[0], int):
+        pytest.fail("@pytest.mark.compile_budget requires an int budget, "
+                    "e.g. @pytest.mark.compile_budget(2)")
+    from dsin_tpu.utils.recompile import CompilationSentinel
+    with CompilationSentinel(budget=marker.args[0],
+                             label=request.node.nodeid):
+        yield

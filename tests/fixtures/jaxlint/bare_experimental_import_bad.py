@@ -1,0 +1,8 @@
+"""Golden BAD fixture: jax.experimental imported outside the shim."""
+import jax.experimental.multihost_utils as mhu
+from jax.experimental import pallas as pl
+from jax.experimental.shard_map import shard_map
+
+
+def run(fn, mesh):
+    return shard_map(fn, mesh, in_specs=None, out_specs=None)

@@ -1,0 +1,6 @@
+"""Golden CLEAN fixture: experimental APIs come from the compat shim."""
+from dsin_tpu.utils.jax_compat import pl, pltpu, shard_map  # noqa: F401
+
+
+def run(fn, mesh, specs):
+    return shard_map(fn, mesh, in_specs=specs, out_specs=specs)

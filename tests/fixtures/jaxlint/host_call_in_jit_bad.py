@@ -1,0 +1,29 @@
+"""Golden BAD fixture: numpy/host calls inside jitted bodies."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    m = np.mean(x)            # np call traced -> host constant, wrong
+    print("step", m)          # trace-time only
+    t = time.time()           # trace-time only
+    return x * m + t
+
+
+def outer(x):
+    @jax.jit
+    def inner(y):
+        return np.asarray(y) + 1   # nested jitted def: still flagged
+
+    return inner(x)
+
+
+def wrapped(y):
+    return jnp.float32(y.item())   # .item() forces a sync under trace
+
+
+wrapped_jit = jax.jit(wrapped)

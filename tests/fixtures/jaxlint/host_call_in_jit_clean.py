@@ -1,0 +1,16 @@
+"""Golden CLEAN fixture: jnp inside jit; np only outside/static."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    m = jnp.mean(x)
+    pad = np.float32(0.5)          # dtype constructors are static
+    n = x.shape[0] * np.prod((2, 3))   # shape arithmetic is trace-time
+    return x * m + pad + n
+
+
+def host_side(x):
+    return np.mean(np.asarray(x))  # not jitted: host numpy is fine

@@ -1,0 +1,21 @@
+"""Golden BAD fixture: per-leaf device syncs inside the step loop."""
+import jax
+import numpy as np
+
+
+def train(train_step, state, batches, logger):
+    for x, y in batches:
+        state, metrics = train_step(state, x, y)
+        loss = np.asarray(metrics["loss"])        # blocking pull per step
+        bpp = np.asarray(metrics["bpp"])          # ... and another
+        jax.block_until_ready(state.params)       # serializes dispatch
+        logger.log(loss, bpp)
+    return state
+
+
+def evaluate(eval_step, state, batches):
+    out = []
+    for x, y in batches:
+        m = eval_step(state, x, y)
+        out.append(jax.device_get(m))             # one per step, unbatched
+    return out

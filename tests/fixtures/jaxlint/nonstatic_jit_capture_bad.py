@@ -1,0 +1,15 @@
+"""Golden BAD fixture: jitted closure captures enclosing containers."""
+import jax
+import jax.numpy as jnp
+
+
+def build_step(model):
+    scales = [1.0, 0.5, 0.25]          # fresh list per build_step call
+    table = {"alpha": 0.9}             # fresh dict per build_step call
+
+    @jax.jit
+    def step(x):
+        y = x * scales[0] + table["alpha"]
+        return model.apply(y)
+
+    return step

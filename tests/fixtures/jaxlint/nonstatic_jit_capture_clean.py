@@ -1,0 +1,15 @@
+"""Golden CLEAN fixture: captures are arrays/tuples or passed as args."""
+import jax
+import jax.numpy as jnp
+
+SCALES = (1.0, 0.5, 0.25)              # module-level tuple: hashable
+
+
+def build_step(model, alpha):
+    scale = jnp.asarray(SCALES)        # array capture: a normal constant
+
+    @jax.jit
+    def step(x, table):                # containers enter as pytree args
+        return model.apply(x * scale * alpha + table["alpha"])
+
+    return step

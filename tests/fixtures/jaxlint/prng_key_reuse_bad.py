@@ -1,0 +1,14 @@
+"""Golden BAD fixture: hard-coded seeds and key reuse without split."""
+import jax
+import jax.numpy as jnp
+
+
+def init_model(model):
+    # hard-coded literal seed
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+
+
+def sample_pair(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))   # same key: identical randomness
+    return a, b

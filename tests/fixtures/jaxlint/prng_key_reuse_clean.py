@@ -1,0 +1,22 @@
+"""Golden CLEAN fixture: threaded seeds, split before every draw."""
+import jax
+import jax.numpy as jnp
+
+
+def init_model(model, seed: int):
+    return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8, 8, 3)))
+
+
+def sample_pair(key):
+    k_a, k_b = jax.random.split(key)
+    a = jax.random.normal(k_a, (4,))
+    b = jax.random.uniform(k_b, (4,))
+    return a, b
+
+
+def sample_loop(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)    # reassigned: fresh key each draw
+    b = jax.random.normal(sub, (4,))
+    return a, b

@@ -1,0 +1,16 @@
+"""Golden BAD fixture: jitted functions mutating argument pytrees."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def update_params(params, grads):
+    params["w"] = params["w"] - 0.1 * grads["w"]   # in-place dict write
+    return params
+
+
+@jax.jit
+def extend_state(state, x):
+    state.history.append(x)        # mutating method on an argument
+    state.count += 1               # attribute augmented-assign
+    return state

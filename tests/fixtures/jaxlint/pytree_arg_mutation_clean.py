@@ -1,0 +1,16 @@
+"""Golden CLEAN fixture: functional updates build new pytrees."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def update_params(params, grads):
+    return {k: params[k] - 0.1 * grads[k] for k in params}
+
+
+@jax.jit
+def set_row(x, row):
+    y = x.at[0].set(row)           # functional array update
+    out = {}
+    out["y"] = y                   # mutating a LOCAL is fine
+    return out

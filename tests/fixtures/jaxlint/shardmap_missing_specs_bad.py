@@ -1,0 +1,10 @@
+"""Golden BAD fixture: layout-implicit shard_map / pmap."""
+import jax
+
+from dsin_tpu.utils.jax_compat import shard_map
+
+
+def build(mesh, fn):
+    mapped = shard_map(fn, mesh=mesh)         # no in_specs / out_specs
+    replicated = jax.pmap(fn)                 # no axis_name
+    return mapped, replicated

@@ -1,0 +1,13 @@
+"""Golden CLEAN fixture: explicit specs and axis names."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from dsin_tpu.utils.jax_compat import shard_map
+
+
+def build(mesh, fn):
+    mapped = shard_map(fn, mesh=mesh,
+                       in_specs=(P("data"), P(None)),
+                       out_specs=P("data"))
+    replicated = jax.pmap(fn, axis_name="data")
+    return mapped, replicated

@@ -1,0 +1,26 @@
+"""Golden BAD fixture: Python control flow on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_or_zero(x, threshold):
+    if threshold > 0:              # traced comparison -> TracerBoolError
+        return jnp.maximum(x, 0)
+    return jnp.zeros_like(x)
+
+
+@jax.jit
+def accumulate(xs):
+    total = jnp.float32(0)
+    for row in xs:                 # iterating a traced array unrolls/fails
+        total = total + row.sum()
+    return total
+
+
+@jax.jit
+def drain(x):
+    y = x * 2                      # derived from a traced arg
+    while y.sum() > 1:             # traced while condition
+        y = y * 0.5
+    return y

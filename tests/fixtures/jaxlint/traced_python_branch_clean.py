@@ -1,0 +1,16 @@
+"""Golden CLEAN fixture: static control flow + lax combinators."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, mask=None, n_layers=3):
+    if mask is not None:           # None-checks are static
+        x = x * mask
+    if isinstance(n_layers, int):  # isinstance is static
+        pass
+    for i in range(x.shape[0]):    # shape-derived range is static
+        x = x + i
+    for _ in range(len(x.shape)):  # len() of a tuple is static
+        x = x * 1.0
+    return jax.lax.cond(x.sum() > 0, lambda v: v, lambda v: -v, x)

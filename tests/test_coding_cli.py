@@ -40,7 +40,7 @@ def test_compress_decompress_roundtrip(tmp_path, tiny_cfg_files):
 
     info = codec_cli.compress(x_png, stream, ae_p, pc_p)
     assert info["shape"] == (16, 24) and info["bytes"] > 0
-    assert os.path.getsize(stream) == 13 + info["bytes"]
+    assert os.path.getsize(stream) == codec_cli._HEADER_LEN + info["bytes"]
 
     out = codec_cli.decompress(stream, rec, ae_p, pc_p)
     assert out["shape"] == (16, 24) and not out["with_si"]
@@ -79,6 +79,24 @@ def test_decompress_with_side_information(tmp_path, tiny_cfg_files):
     out = codec_cli.decompress(stream, rec, ae_p, pc_p, side=y_png)
     assert out["with_si"]
     assert os.path.exists(rec)
+
+
+def test_seed_flag_threads_through(tmp_path, tiny_cfg_files):
+    """--seed drives the un-checkpointed init: different seeds give
+    different model weights (hence different streams), and the decoder
+    picks the encoder's seed up from the stream header on its own."""
+    ae_p, pc_p = tiny_cfg_files
+    x_png = str(tmp_path / "x.png")
+    s0, s1 = str(tmp_path / "s0.dsin"), str(tmp_path / "s1.dsin")
+    _write_png(x_png, 4)
+    codec_cli.compress(x_png, s0, ae_p, pc_p, seed=0)
+    codec_cli.compress(x_png, s1, ae_p, pc_p, seed=1)
+    with open(s0, "rb") as f0, open(s1, "rb") as f1:
+        assert f0.read() != f1.read()
+    rec = str(tmp_path / "rec.png")
+    # no seed passed: the header's recorded seed rebuilds the right model
+    out = codec_cli.decompress(s1, rec, ae_p, pc_p)
+    assert out["shape"] == (16, 24) and os.path.exists(rec)
 
 
 def test_cli_main_reports(tmp_path, tiny_cfg_files, capsys):

@@ -1,0 +1,179 @@
+"""Golden-fixture tests for every jaxlint rule + framework contracts.
+
+Each rule has one known-bad and one known-clean snippet under
+tests/fixtures/jaxlint/; the bad one must fire the rule (at least once),
+the clean one must not. These fixtures ARE the rule semantics — any rule
+change that moves a boundary must move a fixture with it.
+"""
+
+import os
+
+import pytest
+
+from tools.jaxlint import LintConfig, lint_paths, lint_source
+from tools.jaxlint.cli import (EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL,
+                               run)
+from tools.jaxlint.rules import ALL_RULES, RULES_BY_NAME
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "jaxlint")
+RULE_NAMES = sorted(RULES_BY_NAME)
+
+
+def _lint_fixture(name):
+    path = os.path.join(FIXDIR, name)
+    with open(path) as f:
+        source = f.read()
+    active, suppressed = lint_source(source, path)
+    return active, suppressed
+
+
+@pytest.mark.parametrize("rule", RULE_NAMES)
+def test_bad_fixture_fires(rule):
+    active, _ = _lint_fixture(f"{rule.replace('-', '_')}_bad.py")
+    hits = [f for f in active if f.rule == rule]
+    assert hits, (f"{rule} did not fire on its bad fixture; active "
+                  f"findings: {active}")
+
+
+@pytest.mark.parametrize("rule", RULE_NAMES)
+def test_clean_fixture_silent(rule):
+    active, _ = _lint_fixture(f"{rule.replace('-', '_')}_clean.py")
+    hits = [f for f in active if f.rule == rule]
+    assert not hits, f"{rule} false-positived on its clean fixture: {hits}"
+
+
+def test_every_rule_has_fixture_pair():
+    for rule in RULE_NAMES:
+        stem = rule.replace("-", "_")
+        for suffix in ("bad", "clean"):
+            assert os.path.exists(os.path.join(
+                FIXDIR, f"{stem}_{suffix}.py")), (rule, suffix)
+
+
+def test_expected_counts_on_bad_fixtures():
+    """Pin exact firing counts for a few load-bearing fixtures so a rule
+    that silently widens or narrows shows up as a diff here."""
+    active, _ = _lint_fixture("host_call_in_jit_bad.py")
+    assert len([f for f in active if f.rule == "host-call-in-jit"]) == 5
+    active, _ = _lint_fixture("traced_python_branch_bad.py")
+    assert len([f for f in active if f.rule == "traced-python-branch"]) == 3
+    active, _ = _lint_fixture("nonstatic_jit_capture_bad.py")
+    assert len([f for f in active if f.rule == "nonstatic-jit-capture"]) == 2
+
+
+# -- suppression machinery ---------------------------------------------------
+
+BAD_SNIPPET = """import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.mean(x){}
+"""
+
+
+def test_suppression_same_line():
+    src = BAD_SNIPPET.format(
+        "  # jaxlint: disable=host-call-in-jit -- exercised by tests")
+    active, suppressed = lint_source(src, "x.py")
+    assert not active
+    assert len(suppressed) == 1
+
+
+def test_suppression_line_above_spanning_comment_block():
+    src = BAD_SNIPPET.replace(
+        "    return np.mean(x){}",
+        "    # jaxlint: disable=host-call-in-jit -- trace-time constant\n"
+        "    # is intentional here\n"
+        "    return np.mean(x)")
+    active, suppressed = lint_source(src, "x.py")
+    assert not active and len(suppressed) == 1
+
+
+def test_suppression_without_reason_is_a_finding():
+    src = BAD_SNIPPET.format("  # jaxlint: disable=host-call-in-jit")
+    active, suppressed = lint_source(src, "x.py")
+    assert [f.rule for f in active] == ["suppression-missing-reason"]
+    assert len(suppressed) == 1
+
+
+def test_suppression_unknown_rule_is_a_finding():
+    src = BAD_SNIPPET.format(
+        "  # jaxlint: disable=no-such-rule -- whatever")
+    active, _ = lint_source(src, "x.py")
+    assert {f.rule for f in active} == {"host-call-in-jit", "unknown-rule"}
+
+
+def test_suppression_wrong_rule_does_not_cover():
+    src = BAD_SNIPPET.format(
+        "  # jaxlint: disable=prng-key-reuse -- misdirected")
+    active, _ = lint_source(src, "x.py")
+    assert "host-call-in-jit" in {f.rule for f in active}
+
+
+def test_suppression_covers_multiline_statement():
+    src = ("import jax\n\n"
+           "def g(model):\n"
+           "    # jaxlint: disable=prng-key-reuse -- fixed bench seed\n"
+           "    return model.init(\n"
+           "        jax.random.PRNGKey(0))\n")
+    active, suppressed = lint_source(src, "x.py")
+    assert not active and len(suppressed) == 1
+
+
+def test_parse_error_is_a_finding():
+    active, _ = lint_source("def broken(:\n", "x.py")
+    assert [f.rule for f in active] == ["parse-error"]
+
+
+# -- config / CLI contracts --------------------------------------------------
+
+def test_select_and_ignore():
+    src = BAD_SNIPPET.format("")
+    cfg = LintConfig(select=("prng-key-reuse",))
+    active, _ = lint_source(src, "x.py", cfg)
+    assert not active
+    cfg = LintConfig(ignore=("host-call-in-jit",))
+    active, _ = lint_source(src, "x.py", cfg)
+    assert not active
+    with pytest.raises(ValueError):
+        LintConfig(select=("nope",)).enabled_rules()
+
+
+def test_lint_paths_walks_fixture_dir(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SNIPPET.format(""))
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text("import nope(")
+    findings, suppressed, files = lint_paths([str(tmp_path)])
+    assert files == 1 and suppressed == 0
+    assert [f.rule for f in findings] == ["host-call-in-jit"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SNIPPET.format(""))
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert run([str(clean)]) == EXIT_CLEAN
+    assert run([str(bad)]) == EXIT_FINDINGS
+    assert run([str(tmp_path / "missing.py")]) == EXIT_INTERNAL
+    out = capsys.readouterr().out
+    assert "host-call-in-jit" in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SNIPPET.format(""))
+    assert run([str(bad), "--format", "json"]) == EXIT_FINDINGS
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"][0]["rule"] == "host-call-in-jit"
+    assert payload["files"] == 1
+
+
+def test_list_rules_names_all_rules(capsys):
+    assert run(["--list-rules"]) == EXIT_CLEAN
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule.name in out

@@ -1,0 +1,125 @@
+"""Recompilation sentinel: the runtime half of the jaxlint story.
+
+The deliberately shape-polymorphic jit here is the canonical failure the
+sentinel exists for: every new input shape silently rebuilds the XLA
+executable, numbers stay correct, throughput dies.
+
+Counting caveat baked into these tests: EVERY first-seen eager op
+(jnp.ones, dtype casts) also compiles a tiny executable, so inputs are
+materialized OUTSIDE the watched region when a budget is tight, and
+marker budgets carry headroom for the eager-op noise floor.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.utils.recompile import (CompilationSentinel,
+                                      RecompilationBudgetExceeded,
+                                      compilation_count, watch)
+
+_SELFTEST_ENV = "JAXLINT_SENTINEL_SELFTEST"
+
+
+def _fresh_jit():
+    # a fresh wrapper per use so executable caches never leak between
+    # tests — a fresh lambda always recompiles
+    return jax.jit(lambda x: x * 2.0 + 1.0)
+
+
+def test_sentinel_trips_on_shape_polymorphic_jit():
+    f = _fresh_jit()
+    xs = [jnp.ones((n,)) for n in (3, 4, 5, 6)]
+    with pytest.raises(RecompilationBudgetExceeded, match="budget 2"):
+        with CompilationSentinel(budget=2, label="poly"):
+            for x in xs:                # 4 shapes -> 4 compiles
+                f(x)
+
+
+def test_sentinel_passes_within_budget():
+    f = _fresh_jit()
+    x = jnp.ones((7,))
+    with CompilationSentinel(budget=1, label="stable") as s:
+        for _ in range(5):              # one shape -> one compile
+            f(x)
+    assert s.compilations == 1
+
+
+def test_sentinel_counts_without_raising():
+    f = _fresh_jit()
+    xs = [jnp.ones((11,)), jnp.ones((12,))]
+    with CompilationSentinel(budget=0, raise_on_exceed=False) as s:
+        f(xs[0])
+        f(xs[1])
+    assert s.compilations >= 2
+
+
+def test_sentinel_never_masks_test_exceptions():
+    x = jnp.ones((13,))
+    with pytest.raises(ValueError, match="real error"):
+        with CompilationSentinel(budget=0):
+            _fresh_jit()(x)             # over budget AND raising
+            raise ValueError("real error")
+
+
+def test_sentinel_rejects_negative_budget():
+    with pytest.raises(ValueError):
+        CompilationSentinel(budget=-1)
+
+
+def test_watch_wrapper_cumulative_budget():
+    step = watch(_fresh_jit(), budget=1, label="train_step")
+    a, b = jnp.ones((4, 4)), jnp.ones((5, 5))
+    step(a)                             # compile #1: within budget
+    step(a)                             # cached
+    assert step.compilations == 1
+    with pytest.raises(RecompilationBudgetExceeded, match="train_step"):
+        step(b)                         # compile #2: over budget
+
+
+def test_compilation_count_monotonic():
+    before = compilation_count()
+    _fresh_jit()(jnp.ones((17,)))
+    assert compilation_count() > before
+
+
+@pytest.mark.compile_budget(6)
+def test_marker_keeps_honest_step_within_budget():
+    """conftest marker wiring end-to-end: a stable-shape jitted step stays
+    within budget. 6 = one step compile + eager-op noise floor (ones,
+    casts) — the polymorphic twin below blows past the same headroom."""
+    f = jax.jit(lambda s, x: (s + x.sum(), x * s))
+    s = jnp.float32(0)
+    x = jnp.ones((8, 8))
+    for _ in range(4):
+        s, _out = f(s, x)
+    np.testing.assert_allclose(float(s), 256.0)
+
+
+def test_marker_trips_on_polymorphic_step():
+    """The marker demonstrably FAILS a shape-polymorphic step: run the
+    marked twin below via pytest-in-pytest so its failure is observed
+    without failing this suite."""
+    os.environ[_SELFTEST_ENV] = "1"
+    try:
+        inner = pytest.main(
+            ["-q", "--no-header", "-p", "no:cacheprovider",
+             "-k", "test_inner_poly", __file__])
+    finally:
+        os.environ.pop(_SELFTEST_ENV, None)
+    assert inner == 1, ("the compile_budget marker should have failed "
+                        "the polymorphic inner test")
+
+
+@pytest.mark.compile_budget(2)
+def test_inner_poly():
+    """Deliberately shape-polymorphic step under a tight budget — run
+    only as the inner half of test_marker_trips_on_polymorphic_step."""
+    if not os.environ.get(_SELFTEST_ENV):
+        pytest.skip("inner half of test_marker_trips_on_polymorphic_step")
+    f = _fresh_jit()
+    for n in (3, 4, 5, 6):              # >= 4 step compiles + ones noise
+        f(jnp.ones((n,)))

@@ -107,6 +107,29 @@ def test_ae_only_eval_step_runs():
     assert float(m["bpp"]) > 0.0
 
 
+def test_train_step_steady_state_never_recompiles():
+    """The recompilation sentinel on DSIN's ACTUAL hot path: after the
+    first call compiles the executable, every further same-shape step must
+    be a pure cache hit. Budget 0 is strict on purpose — one silent
+    retrace per step is exactly the failure mode that kills TPU
+    throughput while every numeric test keeps passing."""
+    from dsin_tpu.utils.recompile import CompilationSentinel
+    ae_cfg, pc_cfg = tiny_ae_cfg(), tiny_pc_cfg()
+    model = DSIN(ae_cfg, pc_cfg)
+    params = model.init_variables(jax.random.PRNGKey(0), (2, 16, 24, 3)).params
+    tx = optim_lib.build_optimizer(params, ae_cfg, pc_cfg, 10)
+    state = step_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                        (2, 16, 24, 3), tx)
+    train_step = step_lib.make_train_step(model, tx, donate=False)
+    rng = np.random.default_rng(7)
+    x, y = synthetic_batch(rng, 2, 16, 24)
+    state, _ = train_step(state, x, y)        # warm-up: trace + compile
+    with CompilationSentinel(budget=0, label="train_step steady state"):
+        for _ in range(3):
+            state, metrics = train_step(state, x, y)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_frozen_bn_stats_mode():
     ae_cfg, pc_cfg = tiny_ae_cfg(bn_stats="frozen"), tiny_pc_cfg()
     model = DSIN(ae_cfg, pc_cfg)

@@ -1,0 +1,24 @@
+"""jaxlint: JAX-aware static analysis for the dsin_tpu stack.
+
+An AST-based linter (stdlib only) for the JAX failure modes pytest cannot
+see: host calls and Python control flow inside jitted bodies, PRNG key
+reuse, host syncs inside the step hot loop, recompilation hazards from
+captured Python containers, under-specified shard_map/pmap, bare
+jax.experimental imports, and argument-pytree mutation.
+
+Entry points:
+    python -m tools.jaxlint dsin_tpu/          # CLI (exit 0/1/2)
+    from tools.jaxlint import lint_paths        # in-process (tests, CI)
+
+Suppressions: `# jaxlint: disable=<rule>[,<rule>...] -- <justification>`
+on the offending line, or on a comment-only line directly above it.
+The justification is mandatory — a bare disable is itself a finding.
+"""
+
+from tools.jaxlint.config import LintConfig
+from tools.jaxlint.framework import Finding, Rule, lint_source
+from tools.jaxlint.rules import ALL_RULES, RULES_BY_NAME
+from tools.jaxlint.cli import lint_paths, run
+
+__all__ = ["ALL_RULES", "RULES_BY_NAME", "Finding", "LintConfig", "Rule",
+           "lint_paths", "lint_source", "run"]

@@ -1,0 +1,85 @@
+"""CLI driver with the exit-code contract CI gates on:
+
+    0  clean (no active findings)
+    1  findings reported
+    2  internal error (bad arguments, unreadable path, linter crash)
+
+`run(argv)` is the in-process entry point tests use — no subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+from typing import List, Optional, Sequence, Tuple
+
+from tools.jaxlint.config import LintConfig
+from tools.jaxlint.framework import Finding, lint_source
+from tools.jaxlint import reporting
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL = 2
+
+
+def lint_paths(paths: Sequence[str], config: Optional[LintConfig] = None
+               ) -> Tuple[List[Finding], int, int]:
+    """Lint files/directories. Returns (findings, suppressed_count,
+    files_count). Raises on unreadable paths (CLI maps that to exit 2)."""
+    config = config or LintConfig()
+    findings: List[Finding] = []
+    suppressed = 0
+    files = config.iter_files(paths)
+    for path in files:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        active, sup = lint_source(source, path, config)
+        findings.extend(active)
+        suppressed += len(sup)
+    return findings, suppressed, len(files)
+
+
+def _parse_args(argv: Optional[Sequence[str]]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="JAX-aware static analysis for the dsin_tpu stack")
+    p.add_argument("paths", nargs="*", default=["dsin_tpu"],
+                   help="files or directories to lint (default: dsin_tpu)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule names to run exclusively")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule names to skip")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit 0")
+    return p.parse_args(argv)
+
+
+def run(argv: Optional[Sequence[str]] = None,
+        out=None) -> int:
+    """argparse + lint + report; returns the exit code (never raises)."""
+    out = out or sys.stdout
+    try:
+        args = _parse_args(argv)
+    except SystemExit as e:       # argparse errors exit 2 already
+        return EXIT_INTERNAL if e.code not in (0, None) else EXIT_CLEAN
+    try:
+        if args.list_rules:
+            print(reporting.format_rules(), file=out)
+            return EXIT_CLEAN
+        config = LintConfig(
+            select=tuple(s for s in args.select.split(",") if s),
+            ignore=tuple(s for s in args.ignore.split(",") if s))
+        findings, suppressed, files = lint_paths(args.paths, config)
+        fmt = (reporting.format_json if args.format == "json"
+               else reporting.format_text)
+        print(fmt(findings, suppressed, files), file=out)
+        return EXIT_FINDINGS if findings else EXIT_CLEAN
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    sys.exit(run(argv))

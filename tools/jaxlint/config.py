@@ -1,0 +1,61 @@
+"""Lint configuration: rule selection and path filtering.
+
+Defaults fit this repo: lint every .py under the given paths, skip
+caches/artifacts/test fixtures, and allow jax.experimental imports only
+inside the designated compat-shim modules.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+
+DEFAULT_EXCLUDE_DIRS = ("__pycache__", ".git", "fixtures", "artifacts",
+                        "weights", ".ipynb_checkpoints")
+
+#: module stems allowed to import jax.experimental directly — the shims
+#: whose entire purpose is absorbing experimental-API moves
+DEFAULT_COMPAT_MODULES = ("jax_compat",)
+
+
+@dataclass
+class LintConfig:
+    select: Tuple[str, ...] = ()       # empty = all rules
+    ignore: Tuple[str, ...] = ()
+    exclude_dirs: Tuple[str, ...] = DEFAULT_EXCLUDE_DIRS
+    compat_modules: Tuple[str, ...] = DEFAULT_COMPAT_MODULES
+
+    def enabled_rules(self) -> List[str]:
+        from tools.jaxlint.rules import RULES_BY_NAME
+        names = list(RULES_BY_NAME)
+        if self.select:
+            unknown = set(self.select) - set(names)
+            if unknown:
+                raise ValueError(f"unknown rule(s) in --select: "
+                                 f"{sorted(unknown)}")
+            names = [n for n in names if n in self.select]
+        if self.ignore:
+            unknown = set(self.ignore) - set(RULES_BY_NAME)
+            if unknown:
+                raise ValueError(f"unknown rule(s) in --ignore: "
+                                 f"{sorted(unknown)}")
+            names = [n for n in names if n not in self.ignore]
+        return names
+
+    def iter_files(self, paths: Sequence[str]) -> List[str]:
+        """Expand files/directories into a sorted list of .py files."""
+        out: List[str] = []
+        for path in paths:
+            if os.path.isfile(path):
+                out.append(path)
+            elif os.path.isdir(path):
+                for root, dirs, files in os.walk(path):
+                    dirs[:] = sorted(d for d in dirs
+                                     if d not in self.exclude_dirs)
+                    out.extend(os.path.join(root, f) for f in sorted(files)
+                               if f.endswith(".py"))
+            else:
+                raise FileNotFoundError(path)
+        return out

@@ -1,0 +1,302 @@
+"""Rule framework: findings, suppressions, and the jit-context index.
+
+The jit index is the piece every JAX-specific rule leans on: a syntactic
+over/under-approximation of "which function bodies get traced". It marks a
+FunctionDef as jitted when it is
+
+  * decorated with jit/pmap (bare, dotted, or via functools.partial),
+  * passed by name to a jit/pmap/shard_map wrapper call anywhere in the
+    module (``step = jax.jit(step_fn)``),
+  * defined inside an already-jitted function (nested defs trace with
+    their parent).
+
+Builder patterns that thread a function through intermediate variables
+before jitting (``fn = build(...); return jax.jit(fn)``) are invisible to
+a single-module AST pass; rules therefore catch the direct patterns and
+the repo keeps hot-path bodies in directly-wrapped functions.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\-\* ]+?)\s*(?:--\s*(.*))?$")
+
+#: wrappers whose first functional argument gets traced/compiled
+JIT_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "pmap"}
+TRACE_WRAPPERS = JIT_WRAPPERS | {
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+
+class Rule:
+    """One lint rule. Subclasses set `name`/`description` and implement
+    `check(ctx) -> iterable of Finding`."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str
+                ) -> Finding:
+        return Finding(path=ctx.path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       rule=self.name, message=message)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.random.PRNGKey' for the matching Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_skipping_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a statement/expression tree without descending into nested
+    function/class definitions (their bodies are separate scopes)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def body_walk(func: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's own body, excluding nested defs/lambdas."""
+    for stmt in func.body:
+        yield from walk_skipping_defs(stmt)
+
+
+class JitIndex:
+    """Which FunctionDefs in a module are (syntactically) traced."""
+
+    def __init__(self, tree: ast.Module):
+        self._jitted: Set[ast.AST] = set()
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        all_defs: List[ast.AST] = []
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                all_defs.append(node)
+                defs_by_name.setdefault(node.name, []).append(node)
+        self.parents = parents
+        self.all_defs = all_defs
+
+        for fn in all_defs:
+            if any(self._decorator_jits(d) for d in fn.decorator_list):
+                self._jitted.add(fn)
+
+        # fn passed by name to a wrapper call: jax.jit(step), shard_map(f,..)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn in TRACE_WRAPPERS and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    for fn in defs_by_name.get(target.id, ()):
+                        self._jitted.add(fn)
+
+        # nested defs inside a jitted function trace with it
+        changed = True
+        while changed:
+            changed = False
+            for fn in all_defs:
+                if fn in self._jitted:
+                    continue
+                p = parents.get(fn)
+                while p is not None:
+                    if p in self._jitted:
+                        self._jitted.add(fn)
+                        changed = True
+                        break
+                    p = self.parents.get(p)
+
+    @staticmethod
+    def _decorator_jits(dec: ast.AST) -> bool:
+        dn = dotted_name(dec)
+        if dn in JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            fn = dotted_name(dec.func)
+            if fn in JIT_WRAPPERS:       # @jax.jit(static_argnums=...)
+                return True
+            if fn in PARTIAL_NAMES and dec.args:
+                return dotted_name(dec.args[0]) in JIT_WRAPPERS
+        return False
+
+    def is_jitted(self, fn: ast.AST) -> bool:
+        return fn in self._jitted
+
+    def jitted_functions(self) -> List[ast.AST]:
+        return [f for f in self.all_defs if f in self._jitted]
+
+
+@dataclass
+class Suppression:
+    line: int            # line the comment sits on
+    applies_to: int      # line the suppression covers
+    rules: Set[str]      # rule names, or {"*"}
+    reason: str
+
+
+class Suppressions:
+    """`# jaxlint: disable=rule[,rule] -- reason` parsing + matching.
+
+    A trailing comment covers its own line; a comment-only line covers the
+    next line. `disable=all` (or `*`) covers every rule.
+    """
+
+    def __init__(self, source: str):
+        self.entries: List[Suppression] = []
+        self._by_line: Dict[int, Set[str]] = {}
+        lines = source.splitlines()
+        for i, text in enumerate(lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if "all" in rules:
+                rules = {"*"}
+            reason = (m.group(2) or "").strip()
+            comment_only = text[:m.start()].strip() == ""
+            applies = i
+            if comment_only:
+                # cover the first code line below, skipping the rest of a
+                # multi-line justification comment and blank lines
+                applies = i + 1
+                while applies <= len(lines):
+                    stripped = lines[applies - 1].strip()
+                    if stripped and not stripped.startswith("#"):
+                        break
+                    applies += 1
+            self.entries.append(Suppression(line=i, applies_to=applies,
+                                            rules=rules, reason=reason))
+            self._by_line.setdefault(applies, set()).update(rules)
+
+    def covers(self, finding: Finding,
+               stmt_start: Optional[Dict[int, int]] = None) -> bool:
+        lines = [finding.line]
+        if stmt_start and finding.line in stmt_start:
+            # a suppression on a multi-line statement's first line covers
+            # findings on its continuation lines too
+            lines.append(stmt_start[finding.line])
+        for line in lines:
+            rules = self._by_line.get(line, ())
+            if "*" in rules or finding.rule in rules:
+                return True
+        return False
+
+
+@dataclass
+class FileContext:
+    path: str
+    source: str
+    tree: ast.Module
+    jit_index: JitIndex
+    config: "LintConfig"
+    module_stem: str
+
+    @classmethod
+    def parse(cls, source: str, path: str, config) -> "FileContext":
+        tree = ast.parse(source, filename=path)
+        import os
+        stem = os.path.splitext(os.path.basename(path))[0]
+        return cls(path=path, source=source, tree=tree,
+                   jit_index=JitIndex(tree), config=config,
+                   module_stem=stem)
+
+
+def _statement_start_lines(tree: ast.Module) -> Dict[int, int]:
+    """continuation line -> first line, for SIMPLE (non-compound)
+    statements only — a suppression above `x = f(\\n  ...)` covers the
+    whole call, but one above an `if` header never covers its block."""
+    out: Dict[int, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt) and not isinstance(
+                node, (ast.If, ast.For, ast.While, ast.With, ast.Try,
+                       ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for line in range(node.lineno + 1, end + 1):
+                out.setdefault(line, node.lineno)
+    return out
+
+
+def lint_source(source: str, path: str, config=None
+                ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one file's source. Returns (active findings, suppressed).
+
+    Active findings include the meta-findings: unparseable source
+    (`parse-error`), suppressions with no justification
+    (`suppression-missing-reason`), and suppressions naming rules that
+    do not exist (`unknown-rule`).
+    """
+    from tools.jaxlint.config import LintConfig
+    from tools.jaxlint.rules import RULES_BY_NAME
+
+    config = config or LintConfig()
+    try:
+        ctx = FileContext.parse(source, path, config)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 0),
+                        rule="parse-error",
+                        message=f"source does not parse: {e.msg}")], []
+
+    raw: Set[Finding] = set()
+    for name in config.enabled_rules():
+        rule = RULES_BY_NAME[name]
+        # set-dedup: one site can be reached twice (e.g. a sync call seen
+        # from two nested step loops) but is one finding
+        raw.update(rule.check(ctx))
+
+    sup = Suppressions(source)
+    stmt_start = _statement_start_lines(ctx.tree)
+    active = [f for f in raw if not sup.covers(f, stmt_start)]
+    suppressed = [f for f in raw if sup.covers(f, stmt_start)]
+    for entry in sup.entries:
+        if not entry.reason:
+            active.append(Finding(
+                path=path, line=entry.line, col=1,
+                rule="suppression-missing-reason",
+                message="suppression without a justification — append "
+                        "`-- <why this is intentional>`"))
+        for r in entry.rules - {"*"}:
+            if r not in RULES_BY_NAME:
+                active.append(Finding(
+                    path=path, line=entry.line, col=1, rule="unknown-rule",
+                    message=f"suppression names unknown rule {r!r}"))
+    return sorted(active), sorted(suppressed)

@@ -1,0 +1,35 @@
+"""Finding output: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from tools.jaxlint.framework import Finding
+
+
+def format_text(findings: List[Finding], suppressed_count: int,
+                files_count: int) -> str:
+    lines = [f.format() for f in sorted(findings)]
+    lines.append(f"jaxlint: {len(findings)} finding(s) "
+                 f"({suppressed_count} suppressed) in {files_count} "
+                 f"file(s)")
+    return "\n".join(lines)
+
+
+def format_json(findings: List[Finding], suppressed_count: int,
+                files_count: int) -> str:
+    return json.dumps({
+        "findings": [{"path": f.path, "line": f.line, "col": f.col,
+                      "rule": f.rule, "message": f.message}
+                     for f in sorted(findings)],
+        "suppressed": suppressed_count,
+        "files": files_count,
+    }, indent=2)
+
+
+def format_rules() -> str:
+    from tools.jaxlint.rules import ALL_RULES
+    width = max(len(r.name) for r in ALL_RULES)
+    return "\n".join(f"{r.name:<{width}}  {r.description}"
+                     for r in ALL_RULES)

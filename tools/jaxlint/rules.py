@@ -1,0 +1,390 @@
+"""The eight JAX-specific rules.
+
+Each rule is syntactic and deliberately conservative: it catches the
+direct form of a failure mode (the form this repo's hot paths use) and
+relies on golden-fixture tests (tests/fixtures/jaxlint/) to pin exactly
+what fires and what doesn't. Intentional violations are suppressed inline
+with a justification (see framework.Suppressions).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.jaxlint.framework import (FileContext, Finding, Rule, body_walk,
+                                     dotted_name, walk_skipping_defs)
+
+#: np.* attributes that are static/trace-time safe inside a jitted body
+#: (dtype objects, dtype queries, shape arithmetic on Python ints)
+NP_STATIC_OK = frozenset({
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "complex64", "dtype",
+    "iinfo", "finfo", "ndim", "prod", "newaxis", "pi", "inf", "nan",
+})
+
+#: method calls that force a device->host sync
+SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+#: jax.random draws (anything that consumes a key except key plumbing)
+KEY_PLUMBING = frozenset({"PRNGKey", "key", "split", "fold_in", "key_data",
+                          "wrap_key_data", "clone"})
+
+MUTATOR_METHODS = frozenset({"append", "extend", "insert", "update",
+                             "setdefault", "pop", "popitem", "clear",
+                             "remove", "sort", "reverse"})
+
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+CONTAINER_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                   ast.SetComp)
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """The root Name of an Attribute/Subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class HostCallInJit(Rule):
+    name = "host-call-in-jit"
+    description = ("numpy/host calls inside a jitted body run at trace "
+                   "time or force a transfer — use jnp/lax, or hoist to "
+                   "the caller")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ctx.jit_index.jitted_functions():
+            for node in body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func)
+                if dn:
+                    parts = dn.split(".")
+                    if parts[0] in ("np", "numpy"):
+                        if parts[-1] in NP_STATIC_OK or \
+                                (len(parts) > 1 and parts[1] in NP_STATIC_OK):
+                            continue
+                        yield self.finding(
+                            ctx, node, f"`{dn}` inside jitted "
+                            f"`{fn.name}` — numpy executes on host at "
+                            f"trace time; use jnp")
+                        continue
+                    if dn == "print" or dn.startswith("time."):
+                        yield self.finding(
+                            ctx, node, f"host call `{dn}` inside jitted "
+                            f"`{fn.name}` — runs at trace time only; use "
+                            f"jax.debug.print / hoist out")
+                        continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in SYNC_METHODS:
+                    yield self.finding(
+                        ctx, node, f"`.{node.func.attr}()` inside jitted "
+                        f"`{fn.name}` forces a device sync at trace time")
+
+
+class TracedPythonBranch(Rule):
+    name = "traced-python-branch"
+    description = ("Python if/for/while on traced values inside a jitted "
+                   "body fails at trace time or silently specializes — "
+                   "use lax.cond/scan/while_loop")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ctx.jit_index.jitted_functions():
+            traced = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                      + fn.args.posonlyargs)}
+            if fn.args.vararg:
+                traced.add(fn.args.vararg.arg)
+            # one forward pass: names assigned from traced expressions
+            for node in body_walk(fn):
+                if isinstance(node, ast.Assign):
+                    used = {n.id for n in ast.walk(node.value)
+                            if isinstance(n, ast.Name)}
+                    if used & traced:
+                        for tgt in node.targets:
+                            for n in ast.walk(tgt):
+                                if isinstance(n, ast.Name):
+                                    traced.add(n.id)
+            for node in body_walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    expr, kind = node.test, \
+                        "if" if isinstance(node, ast.If) else "while"
+                elif isinstance(node, ast.For):
+                    expr, kind = node.iter, "for"
+                else:
+                    continue
+                name = self._traced_use(expr, traced)
+                if name:
+                    yield self.finding(
+                        ctx, node, f"Python `{kind}` on traced value "
+                        f"`{name}` in jitted `{fn.name}` — use "
+                        f"jax.lax.cond/while_loop/scan (or mark the "
+                        f"argument static)")
+
+    @staticmethod
+    def _traced_use(expr: ast.AST, traced: Set[str]) -> Optional[str]:
+        """First traced Name used non-statically in `expr`, else None."""
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(expr):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Name) and node.id in traced):
+                continue
+            p = parents.get(node)
+            # static idioms: x.shape/.ndim/.dtype, len(x), isinstance(x,..),
+            # `x is None` / `x is not None`
+            if isinstance(p, ast.Attribute) and p.attr in STATIC_ATTRS:
+                continue
+            if isinstance(p, ast.Call) and \
+                    dotted_name(p.func) in ("len", "isinstance"):
+                continue
+            comp = p
+            while comp is not None and not isinstance(comp, ast.Compare):
+                comp = parents.get(comp)
+            if isinstance(comp, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in comp.ops):
+                continue
+            return node.id
+        return None
+
+
+class PrngKeyReuse(Rule):
+    name = "prng-key-reuse"
+    description = ("hard-coded PRNGKey literals and key reuse without "
+                   "split produce correlated randomness")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn in ("jax.random.PRNGKey", "jax.random.key",
+                          "random.PRNGKey") and node.args and \
+                        isinstance(node.args[0], ast.Constant):
+                    yield self.finding(
+                        ctx, node, f"hard-coded PRNG seed "
+                        f"`{dn}({node.args[0].value!r})` — thread a seed "
+                        f"argument/flag through instead")
+        scopes: List[ast.AST] = [ctx.tree] + ctx.jit_index.all_defs
+        for scope in scopes:
+            body = scope.body if isinstance(scope, ast.Module) else None
+            yield from self._check_scope(ctx, scope, body)
+
+    def _check_scope(self, ctx, scope, module_body) -> Iterable[Finding]:
+        events = []   # (lineno, col, kind, keyname, node)
+        walker = (body_walk(scope) if module_body is None else
+                  (n for stmt in module_body
+                   for n in walk_skipping_defs(stmt)))
+        for node in walker:
+            if isinstance(node, ast.Assign):
+                names = [n.id for t in node.targets for n in ast.walk(t)
+                         if isinstance(n, ast.Name)]
+                for nm in names:
+                    events.append((node.lineno, node.col_offset,
+                                   "assign", nm, node))
+            elif isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if not dn or not dn.startswith("jax.random."):
+                    continue
+                parts = dn.split(".")
+                if parts[-1] not in KEY_PLUMBING and node.args and \
+                        isinstance(node.args[0], ast.Name):
+                    events.append((node.lineno, node.col_offset, "draw",
+                                   node.args[0].id, node))
+        events.sort(key=lambda e: (e[0], e[1]))
+        drawn: Set[str] = set()
+        for _, _, kind, nm, node in events:
+            if kind == "assign":
+                drawn.discard(nm)
+            elif nm in drawn:
+                yield self.finding(
+                    ctx, node, f"key `{nm}` consumed by a second draw "
+                    f"without `jax.random.split` — draws share identical "
+                    f"randomness")
+            else:
+                drawn.add(nm)
+
+
+class HostSyncInLoop(Rule):
+    name = "host-sync-in-loop"
+    description = ("device->host syncs inside a step loop serialize host "
+                   "and device work — batch with one jax.device_get, or "
+                   "overlap (lag-1) the pulls")
+
+    SYNC_DOTTED = frozenset({"jax.device_get", "device_get", "np.asarray",
+                             "np.array", "numpy.asarray", "numpy.array"})
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        jitted_names = {f.name for f in ctx.jit_index.jitted_functions()}
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            calls = [n for stmt in loop.body
+                     for n in walk_skipping_defs(stmt)
+                     if isinstance(n, ast.Call)]
+            step_call = any(
+                (lambda dn: dn and ("step" in dn.split(".")[-1].lower()
+                                    or dn in jitted_names))(
+                    dotted_name(c.func)) for c in calls)
+            if not step_call:
+                continue
+            for c in calls:
+                dn = dotted_name(c.func)
+                if dn in self.SYNC_DOTTED:
+                    yield self.finding(
+                        ctx, c, f"`{dn}` inside a step loop — each call is "
+                        f"a blocking device->host transfer; batch into one "
+                        f"device_get per iteration / overlap with dispatch")
+                elif isinstance(c.func, ast.Attribute) and \
+                        c.func.attr == "block_until_ready":
+                    yield self.finding(
+                        ctx, c, "`.block_until_ready()` inside a step loop "
+                        "serializes dispatch; only benchmarks should sync "
+                        "every step")
+
+
+class NonStaticJitCapture(Rule):
+    name = "nonstatic-jit-capture"
+    description = ("a jitted closure capturing an enclosing-scope Python "
+                   "container retraces when the object changes identity — "
+                   "recompilation hazard")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ctx.jit_index.jitted_functions():
+            parent = ctx.jit_index.parents.get(fn)
+            while parent is not None and not isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parent = ctx.jit_index.parents.get(parent)
+            if parent is None:
+                continue
+            bound = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                     + fn.args.posonlyargs)}
+            for node in body_walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = (node.targets if isinstance(node, ast.Assign)
+                            else [node.target])
+                    for t in tgts:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                bound.add(n.id)
+            free = {n.id for n in body_walk(fn)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)} - bound
+            # body_walk never descends into nested defs, so the jitted
+            # closure's own subtree is excluded from the enclosing scan
+            container_assigns: Dict[str, ast.AST] = {}
+            for node in body_walk(parent):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, CONTAINER_NODES):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            container_assigns[t.id] = node
+            for name in sorted(free & set(container_assigns)):
+                yield self.finding(
+                    ctx, fn, f"jitted `{fn.name}` captures Python "
+                    f"container `{name}` from the enclosing scope — each "
+                    f"new object retriggers tracing; pass it as a static "
+                    f"arg or hoist to a module constant/tuple")
+
+
+class ShardMapMissingSpecs(Rule):
+    name = "shardmap-missing-specs"
+    description = ("shard_map/pmap without explicit specs/axis names "
+                   "relies on implicit layout — spell out the contract")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if not dn:
+                continue
+            last = dn.split(".")[-1]
+            kw = {k.arg for k in node.keywords}
+            if last == "shard_map":
+                # positional signature: (f, mesh, in_specs, out_specs)
+                if len(node.args) < 4 and not {"in_specs",
+                                               "out_specs"} <= kw:
+                    yield self.finding(
+                        ctx, node, "shard_map without explicit "
+                        "in_specs/out_specs — the device layout contract "
+                        "must be spelled out")
+            elif last == "pmap" and dn in ("pmap", "jax.pmap"):
+                if "axis_name" not in kw:
+                    yield self.finding(
+                        ctx, node, "pmap without an explicit axis_name — "
+                        "collectives and donation need a named axis "
+                        "(prefer jit + shardings on new code)")
+
+
+class BareExperimentalImport(Rule):
+    name = "bare-experimental-import"
+    description = ("jax.experimental APIs move between releases — import "
+                   "them through a version-compat shim "
+                   "(dsin_tpu/utils/jax_compat.py)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.module_stem in ctx.config.compat_modules:
+            return
+        for node in ast.walk(ctx.tree):
+            mods: List[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            for mod in mods:
+                if mod == "jax.experimental" or \
+                        mod.startswith("jax.experimental."):
+                    yield self.finding(
+                        ctx, node, f"bare `{mod}` import — route through "
+                        f"the version-compat shim (utils/jax_compat) so "
+                        f"one place absorbs the next API move")
+
+
+class PytreeArgMutation(Rule):
+    name = "pytree-arg-mutation"
+    description = ("mutating an argument pytree inside a traced function "
+                   "does not propagate through jit and hides aliasing "
+                   "bugs — build a new pytree")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ctx.jit_index.jitted_functions():
+            params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                      + fn.args.posonlyargs)}
+            for node in body_walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = (node.targets if isinstance(node, ast.Assign)
+                            else [node.target])
+                    for t in tgts:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)) \
+                                and _base_name(t) in params:
+                            yield self.finding(
+                                ctx, node, f"jitted `{fn.name}` mutates "
+                                f"argument `{_base_name(t)}` in place — "
+                                f"use .at[].set() / dict copies; in-place "
+                                f"writes vanish under tracing")
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)) \
+                                and _base_name(t) in params:
+                            yield self.finding(
+                                ctx, node, f"jitted `{fn.name}` deletes "
+                                f"from argument `{_base_name(t)}`")
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in MUTATOR_METHODS and \
+                        _base_name(node.func.value) in params:
+                    yield self.finding(
+                        ctx, node, f"jitted `{fn.name}` calls "
+                        f"`.{node.func.attr}()` on argument "
+                        f"`{_base_name(node.func.value)}` — argument "
+                        f"pytrees must stay immutable under tracing")
+
+
+ALL_RULES = [HostCallInJit(), TracedPythonBranch(), PrngKeyReuse(),
+             HostSyncInLoop(), NonStaticJitCapture(),
+             ShardMapMissingSpecs(), BareExperimentalImport(),
+             PytreeArgMutation()]
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -65,6 +65,8 @@ def main() -> int:
                                        num_training_imgs=1576)
         state_sds = jax.eval_shape(
             lambda m=model, t=tx, s=shape: step_lib.create_train_state(
+                # jaxlint: disable=prng-key-reuse -- eval_shape only: the
+                # key never produces real randomness
                 m, jax.random.PRNGKey(0), s, t))
         x_sds = jax.ShapeDtypeStruct(shape, "float32")
         train_step = step_lib.make_train_step(model, tx, si_mask=mask,

@@ -5,19 +5,32 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: bench checks breakdown mfu rd_sweep
+# Stages: lint bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
 rc=0
 case $s in
+lint)
+  # fail fast BEFORE burning chip time: jaxlint's exit-code contract
+  # (0 clean / 1 findings / 2 internal) gates the queue on the static
+  # JAX hazards — recompilation captures, host syncs in step loops, ...
+  python -m tools.jaxlint dsin_tpu/ tools/ bench.py __graft_entry__.py \
+    > artifacts/jaxlint.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    # a dirty tree aborts the whole queue — that is the point of the gate
+    cat artifacts/jaxlint.log
+    echo "TPU_SESSION_FAILED: lint (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -89,7 +102,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
