@@ -29,51 +29,22 @@ from __future__ import annotations
 import argparse
 import os
 import struct
+import sys
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
+
+from dsin_tpu.coding.loader import load_model_state, make_codec
 
 MAGIC = b"DSIM"
 VERSION = 2            # v2: header records the parameter-init seed
 _HEADER_LEN = 17       # magic(4) + BHH(5) + seed(4) + payload_len(4)
 
-
-def _load_model_state(ae_config_path: str, pc_config_path: str,
-                      ckpt_dir: Optional[str], img_shape,
-                      need_sinet: bool, seed: int = 0):
-    """Build DSIN (+ optional checkpoint restore) with a minimal state.
-
-    `seed` drives the parameter init and only matters when no checkpoint
-    is restored (smoke runs / tests); it rides the CLI's --seed flag so
-    un-checkpointed runs are reproducible without a hard-coded key."""
-    from dsin_tpu.config import parse_config_file
-    from dsin_tpu.models.dsin import DSIN
-    from dsin_tpu.train import checkpoint as ckpt_lib
-    from dsin_tpu.train.step import TrainState
-
-    ae_cfg = parse_config_file(ae_config_path)
-    if not need_sinet:
-        ae_cfg = ae_cfg.replace(AE_only=True)
-    pc_cfg = parse_config_file(pc_config_path)
-    model = DSIN(ae_cfg, pc_cfg)
-    variables = model.init_variables(jax.random.PRNGKey(seed),
-                                     (1, *img_shape, 3))
-    state = TrainState(params=variables.params,
-                       batch_stats=variables.batch_stats,
-                       opt_state=(), step=jnp.int32(0))
-    if ckpt_dir:
-        parts = list(ckpt_lib.AE_PARTITIONS)
-        if need_sinet:
-            parts.append("sinet")
-        state = ckpt_lib.restore_partitions(ckpt_dir, state, parts)
-    return model, state
-
-
-def _make_codec(model, state):
-    from dsin_tpu.coding.codec import BottleneckCodec
-    return BottleneckCodec.for_model(model, state.params)
+# construction lives in coding/loader.py now (shared with dsin_tpu/serve);
+# the old private names stay importable for existing call sites
+_load_model_state = load_model_state
+_make_codec = make_codec
 
 
 def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
@@ -111,9 +82,10 @@ def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
                seed: Optional[int] = None) -> dict:
     """`seed=None` (default) re-inits with the seed recorded in the
     stream header — the only value that can reproduce the encoder's
-    weights when no checkpoint restores them. An explicit int overrides
-    (and will corrupt the reconstruction if it disagrees; the header
-    makes that an opt-in footgun instead of the default)."""
+    weights when no checkpoint restores them. An explicit seed that
+    DISAGREES with the header is a hard error: the mismatched init would
+    silently decode garbage (the rANS probabilities diverge from the
+    encoder's), so there is no legitimate override to offer."""
     from dsin_tpu.coding.codec import decode_batch
     from dsin_tpu.data.loader import decode_image
     from dsin_tpu.models.quantizer import centers_lookup
@@ -127,6 +99,11 @@ def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
         raise ValueError(f"unsupported version {version}")
     if seed is None:
         seed = hdr_seed
+    elif seed != hdr_seed:
+        raise ValueError(
+            f"--seed {seed} disagrees with the stream header's init seed "
+            f"{hdr_seed}: the encoder ran with seed {hdr_seed}, so any "
+            f"other init decodes garbage. Drop --seed to trust the header.")
     payload = blob[_HEADER_LEN:_HEADER_LEN + n]
     if len(payload) != n:
         # the rANS decoder cannot detect truncation itself — it would
@@ -196,24 +173,30 @@ def main(argv=None) -> None:
              "(matters when no --ckpt restores weights)")
     sub.choices["decompress"].add_argument(
         "--seed", type=int, default=None,
-        help="override the stream header's init seed (a mismatch "
-             "corrupts the reconstruction — default: trust the header)")
+        help="assert the stream's init seed (a value disagreeing with "
+             "the header is an error — default: trust the header)")
     sub.choices["decompress"].add_argument(
         "--side", default=None,
         help="decoder-side information image (enables the SI path)")
     args = p.parse_args(argv)
 
-    if args.cmd == "compress":
-        info = compress(args.input, args.output, args.ae_config,
-                        args.pc_config, args.ckpt, seed=args.seed)
-        print(f"{args.output}: {info['bytes']} bytes, "
-              f"{info['bpp']:.4f} bpp @ {info['shape']}")
-    else:
-        info = decompress(args.input, args.output, args.ae_config,
-                          args.pc_config, args.ckpt, args.side,
-                          seed=args.seed)
-        print(f"{args.output}: reconstructed {info['shape']}"
-              f"{' with side information' if info['with_si'] else ''}")
+    try:
+        if args.cmd == "compress":
+            info = compress(args.input, args.output, args.ae_config,
+                            args.pc_config, args.ckpt, seed=args.seed)
+            print(f"{args.output}: {info['bytes']} bytes, "
+                  f"{info['bpp']:.4f} bpp @ {info['shape']}")
+        else:
+            info = decompress(args.input, args.output, args.ae_config,
+                              args.pc_config, args.ckpt, args.side,
+                              seed=args.seed)
+            print(f"{args.output}: reconstructed {info['shape']}"
+                  f"{' with side information' if info['with_si'] else ''}")
+    except ValueError as e:
+        # bad streams / flag-header disagreements are user errors, not
+        # crashes: report one clear line, not a traceback
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,58 @@
+"""Shared model/codec construction for every codec entry point.
+
+Factored out of coding/cli.py so a long-lived process (dsin_tpu/serve/)
+builds model + jit state ONCE and amortizes it across requests, while the
+one-shot CLI keeps the identical construction path — the two must not
+drift, or a stream compressed by the service would decode against a
+differently-wired model in the CLI (and vice versa).
+
+DSIN's modules are fully convolutional: `img_shape` only sizes the dummy
+batch that `init_variables` traces shapes with, the resulting parameter
+tree is shape-independent. A service can therefore init at one bucket
+geometry and run every other bucket through the same parameters.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def load_model_state(ae_config_path: str, pc_config_path: str,
+                     ckpt_dir: Optional[str], img_shape: Tuple[int, int],
+                     need_sinet: bool, seed: int = 0):
+    """Build DSIN (+ optional checkpoint restore) with a minimal state.
+
+    `seed` drives the parameter init and only matters when no checkpoint
+    is restored (smoke runs / tests); callers thread their --seed flag
+    through so un-checkpointed runs are reproducible without a
+    hard-coded key."""
+    from dsin_tpu.config import parse_config_file
+    from dsin_tpu.models.dsin import DSIN
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.train.step import TrainState
+
+    ae_cfg = parse_config_file(ae_config_path)
+    if not need_sinet:
+        ae_cfg = ae_cfg.replace(AE_only=True)
+    pc_cfg = parse_config_file(pc_config_path)
+    model = DSIN(ae_cfg, pc_cfg)
+    variables = model.init_variables(jax.random.PRNGKey(seed),
+                                     (1, *img_shape, 3))
+    state = TrainState(params=variables.params,
+                       batch_stats=variables.batch_stats,
+                       opt_state=(), step=jnp.int32(0))
+    if ckpt_dir:
+        parts = list(ckpt_lib.AE_PARTITIONS)
+        if need_sinet:
+            parts.append("sinet")
+        state = ckpt_lib.restore_partitions(ckpt_dir, state, parts)
+    return model, state
+
+
+def make_codec(model, state):
+    """The one BottleneckCodec construction every call site shares."""
+    from dsin_tpu.coding.codec import BottleneckCodec
+    return BottleneckCodec.for_model(model, state.params)

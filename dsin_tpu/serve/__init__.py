@@ -1,0 +1,30 @@
+"""dsin_tpu.serve — long-lived micro-batching compression service.
+
+Layering (each module stands alone below the next):
+    buckets.py  — static shape buckets (fixed executable census)
+    batcher.py  — bounded queue, same-bucket coalescing, backpressure,
+                  deadlines, drain (pure stdlib threading)
+    metrics.py  — lock-guarded counters/gauges/histograms + http.server
+                  /healthz + /metrics endpoint
+    service.py  — worker threads over the batched jitted codec; model
+                  state loaded once via coding/loader.py
+
+Driven by tools/serve_bench.py (open-loop load, SERVE_BENCH.json).
+"""
+
+from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, MicroBatcher,
+                                    Request, ServeError, ServiceDraining,
+                                    ServiceOverloaded)
+from dsin_tpu.serve.buckets import (BucketPolicy, NoBucketFits,
+                                    crop_from_bucket, pad_to_bucket)
+from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
+from dsin_tpu.serve.service import (CompressionService, EncodeResult,
+                                    ServiceConfig)
+
+__all__ = [
+    "BucketPolicy", "CompressionService", "DeadlineExceeded",
+    "EncodeResult", "Future", "MetricsRegistry", "MetricsServer",
+    "MicroBatcher", "NoBucketFits", "Request", "ServeError",
+    "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
+    "crop_from_bucket", "pad_to_bucket",
+]

@@ -1,0 +1,243 @@
+"""Dynamic micro-batcher: bounded queue + same-bucket coalescing.
+
+The throughput/latency trade every batched service makes, with explicit
+failure semantics instead of the two silent ones:
+
+* **Backpressure, not buffering**: `submit` on a full queue raises
+  `ServiceOverloaded` IMMEDIATELY. An unbounded queue converts overload
+  into unbounded memory growth plus latencies every client has already
+  given up on — rejecting at the door is the only behavior a load
+  balancer upstream can act on.
+* **Deadlines, not zombie work**: a request whose deadline passes while
+  queued is completed with `DeadlineExceeded` and never batched —
+  serving an answer nobody is waiting for still costs a batch slot.
+
+Coalescing: requests carry an opaque hashable `key` ((kind, bucket) in
+the service); a batch only ever contains one key, because one key maps
+to one XLA executable. A worker picks the key with the OLDEST head
+request (FIFO fairness across buckets), then waits up to `max_wait_ms`
+for that key's queue to fill to `max_batch` — the head request's age
+bounds added latency, late same-bucket arrivals ride along free.
+
+Pure stdlib threading (one Condition), so tier-1 exercises all of it on
+CPU with no jax in sight.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Hashable, List, Optional
+
+
+class ServeError(RuntimeError):
+    """Base for every request-rejection mode the service can answer with."""
+
+
+class ServiceOverloaded(ServeError):
+    """Queue full — shed load now; retry against another replica/later."""
+
+
+class ServiceDraining(ServeError):
+    """Service is shutting down — it finishes in-flight work only."""
+
+
+class DeadlineExceeded(ServeError):
+    """Deadline passed while the request was still queued."""
+
+
+class Future:
+    """Minimal one-shot result slot (stdlib Event; no asyncio loop to own)."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value: Any) -> None:
+        self._result = value
+        self._done.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still pending")
+        return self._exc
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        return self._result
+
+
+@dataclass
+class Request:
+    """One unit of work. `payload` is opaque to the batcher; `key` decides
+    what it may be batched with; `deadline` is absolute time.monotonic()."""
+    key: Hashable
+    payload: Any
+    deadline: Optional[float] = None
+    future: Future = field(default_factory=Future)
+    arrival: float = field(default_factory=time.monotonic)
+
+
+class MicroBatcher:
+    """Bounded multi-queue with same-key coalescing, deadlines, and drain.
+
+    Contract:
+      submit(req)        -> enqueue | raise ServiceOverloaded/ServiceDraining
+      next_batch(t)      -> [Request, ...] (one key, 1..max_batch of them)
+                            | [] on timeout | None once closed AND empty
+      close()            -> reject everything queued with ServiceDraining;
+                            workers mid-batch are unaffected (in-flight
+                            work completes — that is the drain guarantee)
+    """
+
+    def __init__(self, max_batch: int, max_wait_ms: float, max_queue: int,
+                 on_expired=None):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        if max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        #: called with the count of deadline-expired requests (under the
+        #: batcher lock — keep it leaf-locked and cheap, e.g. a counter)
+        self.on_expired = on_expired
+        self._cond = threading.Condition()
+        self._queues: Dict[Hashable, deque] = {}
+        self._depth = 0
+        self._closed = False
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise ServiceDraining("service is draining; not accepting "
+                                      "new requests")
+            if self._depth >= self.max_queue:
+                raise ServiceOverloaded(
+                    f"request queue full ({self._depth}/{self.max_queue})")
+            self._queues.setdefault(request.key, deque()).append(request)
+            self._depth += 1
+            self._cond.notify_all()
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return self._depth
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    # -- consumer side ------------------------------------------------------
+
+    def _expire_locked(self) -> None:
+        """Complete every already-dead queued request with DeadlineExceeded
+        (holding the lock; O(depth), fine at service queue scales)."""
+        now = time.monotonic()
+        expired = 0
+        for key in list(self._queues):
+            q = self._queues[key]
+            if not any(r.deadline is not None and r.deadline <= now
+                       for r in q):
+                continue
+            alive = deque(r for r in q
+                          if r.deadline is None or r.deadline > now)
+            for r in q:
+                if r.deadline is not None and r.deadline <= now:
+                    self._depth -= 1
+                    expired += 1
+                    r.future.set_exception(DeadlineExceeded(
+                        f"deadline passed after "
+                        f"{(now - r.arrival) * 1e3:.1f}ms in queue"))
+            if alive:
+                self._queues[key] = alive
+            else:
+                del self._queues[key]
+        if expired and self.on_expired is not None:
+            self.on_expired(expired)
+
+    def _oldest_key_locked(self) -> Optional[Hashable]:
+        best, best_t = None, None
+        for key, q in self._queues.items():
+            if q and (best_t is None or q[0].arrival < best_t):
+                best, best_t = key, q[0].arrival
+        return best
+
+    def next_batch(self, timeout: Optional[float] = None
+                   ) -> Optional[List[Request]]:
+        """Block until a batch is ready. Returns [] when `timeout` elapses
+        with nothing to do (so worker loops can poll a stop flag), None
+        once the batcher is closed and empty (worker should exit)."""
+        give_up = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                self._expire_locked()
+                key = self._oldest_key_locked()
+                if key is None:
+                    if self._closed:
+                        return None
+                    if give_up is not None:
+                        remaining = give_up - time.monotonic()
+                        if remaining <= 0:
+                            return []
+                        self._cond.wait(remaining)
+                    else:
+                        self._cond.wait()
+                    continue
+                # coalesce: wait for the head's key to fill, bounded by the
+                # HEAD's age so the first-in request caps the added latency
+                full_at = self._queues[key][0].arrival + self.max_wait
+                while (not self._closed
+                       and key in self._queues
+                       and len(self._queues[key]) < self.max_batch):
+                    remaining = full_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    self._expire_locked()
+                q = self._queues.get(key)
+                if not q:
+                    continue   # everything expired or was rejected meanwhile
+                batch = []
+                while q and len(batch) < self.max_batch:
+                    batch.append(q.popleft())
+                    self._depth -= 1
+                if not q:
+                    del self._queues[key]
+                return batch
+
+    # -- drain --------------------------------------------------------------
+
+    def close(self) -> int:
+        """Stop accepting, reject everything still queued (they were never
+        started, so 'rejected cleanly' is accurate), wake all waiters.
+        Returns the number of rejected requests. Idempotent."""
+        with self._cond:
+            if self._closed:
+                return 0
+            self._closed = True
+            rejected = 0
+            for q in self._queues.values():
+                for r in q:
+                    rejected += 1
+                    r.future.set_exception(ServiceDraining(
+                        "service drained before this request was started"))
+            self._queues.clear()
+            self._depth = 0
+            self._cond.notify_all()
+            return rejected

@@ -1,0 +1,199 @@
+"""Lock-guarded service metrics + stdlib health/metrics HTTP endpoint.
+
+Everything an operator needs to answer "is the service keeping up":
+queue depth, batch occupancy (how full the micro-batches actually run —
+low occupancy at high load means max_wait_ms is mis-tuned), request
+latency quantiles, rejection counters split by cause, and the XLA
+compile count (any steady-state motion there is a bucket-policy bug;
+dsin_tpu/utils/recompile.py is the source of truth).
+
+No prometheus client dependency: counters/gauges/histograms are tiny
+lock-guarded classes and the endpoint is `http.server` — the text format
+is prometheus-compatible enough (`name value` lines) to scrape, and
+`/healthz` + `/metrics?format=json` serve humans and tests.
+
+Latency quantiles come from a bounded reservoir (last `maxlen` samples)
+— exact percentiles over an unbounded run would grow memory, and a
+sliding window is the operationally useful view anyway.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import urlparse
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Bounded-reservoir summary: count/mean over everything ever
+    observed, quantiles over the most recent `maxlen` samples."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._window: deque = deque(maxlen=maxlen)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._window.append(float(v))
+            self._count += 1
+            self._sum += float(v)
+
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile over the window; 0.0 when empty."""
+        with self._lock:
+            if not self._window:
+                return 0.0
+            xs = sorted(self._window)
+        rank = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        return xs[rank]
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            count, total = self._count, self._sum
+        return {
+            "count": count,
+            "mean": (total / count) if count else 0.0,
+            "p50": self.quantile(0.50),
+            "p99": self.quantile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Named metric namespace; creation is idempotent so call sites just
+    `registry.counter('x').inc()` without wiring declarations around."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
+            "histograms": {k: h.summary()
+                           for k, h in sorted(histograms.items())},
+        }
+
+    def render_text(self) -> str:
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap["counters"].items():
+            lines.append(f"{k}_total {v}")
+        for k, v in snap["gauges"].items():
+            lines.append(f"{k} {v:g}")
+        for k, s in snap["histograms"].items():
+            lines.append(f"{k}_count {s['count']}")
+            for stat in ("mean", "p50", "p99"):
+                lines.append(f"{k}_{stat} {s[stat]:g}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """`/healthz` + `/metrics` on a daemon thread; port 0 = ephemeral
+    (tests read `.port` after start)."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 health: Callable[[], dict],
+                 port: int = 0, host: str = "127.0.0.1"):
+        registry_ref, health_ref = registry, health
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass  # request logging would interleave with service logs
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+                url = urlparse(self.path)
+                if url.path == "/healthz":
+                    state = health_ref()
+                    code = 200 if state.get("status") == "ok" else 503
+                    self._send(code, json.dumps(state), "application/json")
+                elif url.path == "/metrics":
+                    if "format=json" in (url.query or ""):
+                        self._send(200, json.dumps(registry_ref.snapshot()),
+                                   "application/json")
+                    else:
+                        self._send(200, registry_ref.render_text(),
+                                   "text/plain; version=0.0.4")
+                else:
+                    self._send(404, "not found\n", "text/plain")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="serve-metrics", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,406 @@
+"""Long-lived compression service: model loaded once, batched hot path.
+
+Every earlier entry point (coding/cli.py, bench.py, tools/*) is one-shot
+— it pays Python startup, model init, and jit compiles per image. This
+module is the amortized form the ROADMAP's serving goal needs:
+
+* model/jit state is built ONCE per process (coding/loader.py, shared
+  with the CLI so the construction cannot drift);
+* requests of arbitrary (h, w) are padded onto the static bucket set
+  (serve/buckets.py), so the steady-state executable count is exactly
+  2 * len(buckets) — warm-up compiles them all, and after that
+  `CompilationSentinel(budget=0)` holds over any mixed-shape stream;
+* same-bucket requests coalesce into micro-batches (serve/batcher.py)
+  with backpressure and deadlines;
+* SIGINT/SIGTERM drain gracefully (utils/signals.py): in-flight batches
+  complete, queued requests are rejected with ServiceDraining, new
+  submits are refused.
+
+The jitted work is the batched AE encode/decode; the per-image rANS
+entropy stage runs on the worker thread with the pure-numpy incremental
+engine (coding/incremental.py), which holds no jax state and therefore
+never contributes to the compile budget.
+
+Stream framing (little-endian), around the BottleneckCodec payload:
+    b"DSRV" | u8 version | u16 h | u16 w | u16 bh | u16 bw
+            | u32 payload_len | payload
+The original (h, w) drives the post-decode crop; the bucket (bh, bw) is
+recorded explicitly so a decode request routes to its executable without
+re-deriving policy (and fails loudly if the service lacks that bucket).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsin_tpu.serve import buckets as buckets_lib
+from dsin_tpu.serve import metrics as metrics_lib
+from dsin_tpu.serve.batcher import (Future, MicroBatcher, Request,
+                                    ServiceDraining)
+from dsin_tpu.utils import recompile
+
+SERVE_MAGIC = b"DSRV"
+SERVE_VERSION = 1
+_FRAME_LEN = 17   # magic(4) + B(1) + 4*H(8) + I(4)
+
+ENCODE = "encode"
+DECODE = "decode"
+
+
+@dataclass
+class ServiceConfig:
+    ae_config: str
+    pc_config: str
+    ckpt: Optional[str] = None
+    seed: int = 0
+    buckets: Sequence[Tuple[int, int]] = buckets_lib.DEFAULT_BUCKETS
+    max_batch: int = 4
+    max_wait_ms: float = 5.0
+    max_queue: int = 64
+    workers: int = 1
+    #: None = no HTTP endpoint; 0 = ephemeral port (tests)
+    metrics_port: Optional[int] = None
+
+
+@dataclass
+class EncodeResult:
+    stream: bytes          # framed: ready for decode() / a wire
+    payload_bytes: int     # entropy-coded payload only
+    bpp: float             # payload bits over ORIGINAL h*w pixels
+    shape: Tuple[int, int]
+    bucket: Tuple[int, int]
+
+
+def frame_stream(payload: bytes, shape: Tuple[int, int],
+                 bucket: Tuple[int, int]) -> bytes:
+    h, w = shape
+    bh, bw = bucket
+    return (SERVE_MAGIC
+            + struct.pack("<BHHHHI", SERVE_VERSION, h, w, bh, bw,
+                          len(payload))
+            + payload)
+
+
+def parse_stream(blob: bytes):
+    """-> (payload, (h, w), (bh, bw)); raises ValueError on a bad frame."""
+    if len(blob) < _FRAME_LEN or blob[:4] != SERVE_MAGIC:
+        raise ValueError("not a DSRV stream")
+    version, h, w, bh, bw, n = struct.unpack("<BHHHHI", blob[4:_FRAME_LEN])
+    if version != SERVE_VERSION:
+        raise ValueError(f"unsupported DSRV version {version}")
+    payload = blob[_FRAME_LEN:_FRAME_LEN + n]
+    if len(payload) != n:
+        raise ValueError(f"truncated stream: payload {len(payload)} of "
+                         f"{n} bytes")
+    if h > bh or w > bw:
+        raise ValueError(f"corrupt frame: image ({h}, {w}) exceeds its "
+                         f"own bucket ({bh}, {bw})")
+    return payload, (h, w), (bh, bw)
+
+
+def _make_batched_fns(model):
+    """The service's only two jitted functions. Params/batch_stats enter
+    as traced ARGUMENTS (not closure captures — jaxlint:
+    nonstatic-jit-capture); `model` is a static module bundle. One jit
+    wrapper each: distinct bucket shapes become distinct executables in
+    the same cache, so the executable census is #buckets per function."""
+
+    def encode_fn(params, batch_stats, x):
+        enc_out, _ = model.encode(params, batch_stats, x, train=False)
+        return enc_out.symbols
+
+    def decode_fn(params, batch_stats, symbols):
+        from dsin_tpu.models.quantizer import centers_lookup
+        q = centers_lookup(params["centers"], symbols)
+        x_dec, _ = model.decode(params, batch_stats, q, train=False)
+        return jnp.clip(x_dec, 0.0, 255.0)
+
+    return jax.jit(encode_fn), jax.jit(decode_fn)
+
+
+class CompressionService:
+    """Thread-per-worker micro-batching codec service.
+
+    Lifecycle:  start() -> [warmup()] -> submit_*/encode/decode ...
+                -> drain()   (or initiate_drain() from a signal handler)
+    """
+
+    def __init__(self, config: ServiceConfig):
+        self.config = config
+        self.policy = buckets_lib.BucketPolicy(config.buckets)
+        self.metrics = metrics_lib.MetricsRegistry()
+        self._batcher = MicroBatcher(
+            config.max_batch, config.max_wait_ms, config.max_queue,
+            on_expired=lambda n: self.metrics.counter(
+                "serve_rejected_deadline").inc(n))
+        self._workers = []
+        self._closer: Optional[threading.Thread] = None
+        self._started = False
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._metrics_server: Optional[metrics_lib.MetricsServer] = None
+        self._batch_hook = None   # test/diagnostic: called with each batch
+        self.model = None
+        self.state = None
+        self.codec = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "CompressionService":
+        if self._started:
+            return self
+        from dsin_tpu.coding.loader import load_model_state, make_codec
+        # init at the largest bucket; params are shape-independent (the
+        # modules are fully convolutional) so every bucket shares them
+        init_shape = self.policy.buckets[-1]
+        self.model, self.state = load_model_state(
+            self.config.ae_config, self.config.pc_config, self.config.ckpt,
+            init_shape, need_sinet=False, seed=self.config.seed)
+        self.codec = make_codec(self.model, self.state)
+        self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
+        self._bn_channels = int(self.model.ae_config.num_chan_bn)
+        recompile.install()
+        for i in range(self.config.workers):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"serve-worker-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        if self.config.metrics_port is not None:
+            self._metrics_server = metrics_lib.MetricsServer(
+                self.metrics, self.health,
+                port=self.config.metrics_port).start()
+        self._started = True
+        return self
+
+    def warmup(self) -> dict:
+        """Compile every (bucket, direction) executable and prime the
+        numpy entropy engine, so the first real request pays nothing.
+        Returns {"compiles": n, "seconds": s}."""
+        assert self._started, "start() before warmup()"
+        t0 = time.monotonic()
+        before = recompile.compilation_count()
+        params, bs = self.state.params, self.state.batch_stats
+        for bh, bw in self.policy.buckets:
+            x = jnp.zeros((self.config.max_batch, bh, bw, 3), jnp.float32)
+            symbols = np.asarray(self._encode_fn(params, bs, x))
+            # one per-image entropy roundtrip primes the incremental
+            # engine's schedule path for this bucket's volume geometry
+            stream = self.codec.encode(np.transpose(symbols[0], (2, 0, 1)))
+            self.codec.decode(stream)
+            sym_batch = jnp.zeros(
+                (self.config.max_batch, bh // buckets_lib.SUBSAMPLING,
+                 bw // buckets_lib.SUBSAMPLING, self._bn_channels),
+                jnp.int32)
+            np.asarray(self._decode_fn(params, bs, sym_batch))
+        compiles = recompile.compilation_count() - before
+        self.metrics.gauge("serve_warmup_compiles").set(compiles)
+        self.metrics.gauge("serve_buckets").set(len(self.policy.buckets))
+        return {"compiles": compiles,
+                "seconds": time.monotonic() - t0}
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def initiate_drain(self) -> None:
+        """Non-blocking drain trigger — safe from a signal handler: flip
+        the flag, then close the queue from a FRESH thread. The handler
+        runs on the main thread mid-bytecode, which may already hold the
+        batcher's (non-reentrant) lock inside submit(); closing inline
+        there would self-deadlock. `drain()`/`wait_drained()` does the
+        blocking part."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+
+        def _close():
+            rejected = self._batcher.close()
+            self.metrics.counter("serve_rejected_drain").inc(rejected)
+
+        self._closer = threading.Thread(target=_close, name="serve-drain",
+                                        daemon=True)
+        self._closer.start()
+
+    def wait_drained(self, timeout: Optional[float] = 30.0) -> bool:
+        if self._closer is not None:
+            self._closer.join(timeout)
+        for t in self._workers:
+            t.join(timeout)
+        alive = any(t.is_alive() for t in self._workers)
+        if not alive:
+            self._drained.set()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+                self._metrics_server = None
+        return not alive
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Graceful shutdown: returns True when every worker exited."""
+        self.initiate_drain()
+        return self.wait_drained(timeout)
+
+    def install_signal_handlers(self) -> bool:
+        """SIGINT/SIGTERM -> initiate_drain (main thread only)."""
+        from dsin_tpu.utils.signals import install_drain_handlers
+        return install_drain_handlers(self.initiate_drain)
+
+    def __enter__(self) -> "CompressionService":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.drain()
+
+    # -- request intake -----------------------------------------------------
+
+    def health(self) -> dict:
+        return {"status": "draining" if self.draining else "ok",
+                "queue_depth": self._batcher.depth,
+                "buckets": [list(b) for b in self.policy.buckets]}
+
+    def _deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
+        return (None if deadline_ms is None
+                else time.monotonic() + deadline_ms / 1000.0)
+
+    def _submit(self, request: Request) -> Future:
+        # the drain flag flips before the queue actually closes (the
+        # close runs on the serve-drain thread) — refuse here too so no
+        # request slips into that window
+        if self._draining.is_set():
+            self.metrics.counter("serve_rejected_drain").inc()
+            raise ServiceDraining("service is draining; not accepting "
+                                  "new requests")
+        try:
+            self._batcher.submit(request)
+        except ServiceDraining:
+            self.metrics.counter("serve_rejected_drain").inc()
+            raise
+        except Exception:
+            self.metrics.counter("serve_rejected_overload").inc()
+            raise
+        # counted only once ACCEPTED: submitted - completed must bound
+        # the queued+in-flight backlog, so rejections stay out of it
+        self.metrics.counter("serve_submitted").inc()
+        self.metrics.gauge("serve_queue_depth").set(self._batcher.depth)
+        return request.future
+
+    def submit_encode(self, img: np.ndarray,
+                      deadline_ms: Optional[float] = None) -> Future:
+        """(h, w, 3) uint8/float image -> Future[EncodeResult]. Raises
+        ServiceOverloaded/ServiceDraining/NoBucketFits at the door."""
+        img = np.asarray(img)
+        if img.ndim != 3 or img.shape[-1] != 3:
+            raise ValueError(f"expected (h, w, 3) image, got {img.shape}")
+        h, w = img.shape[:2]
+        bucket = self.policy.bucket_for(h, w)
+        padded = buckets_lib.pad_to_bucket(
+            img.astype(np.float32, copy=False), bucket)
+        return self._submit(Request(
+            key=(ENCODE, bucket), payload=(padded, (h, w)),
+            deadline=self._deadline(deadline_ms)))
+
+    def submit_decode(self, blob: bytes,
+                      deadline_ms: Optional[float] = None) -> Future:
+        """Framed DSRV stream -> Future[(h, w, 3) uint8 image]."""
+        payload, shape, bucket = parse_stream(blob)
+        if bucket not in self.policy.buckets:
+            raise buckets_lib.NoBucketFits(
+                f"stream was encoded for bucket {bucket}, which this "
+                f"service does not serve (buckets: "
+                f"{list(self.policy.buckets)})")
+        return self._submit(Request(
+            key=(DECODE, bucket), payload=(payload, shape),
+            deadline=self._deadline(deadline_ms)))
+
+    def encode(self, img: np.ndarray, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = 60.0) -> EncodeResult:
+        return self.submit_encode(img, deadline_ms).result(timeout)
+
+    def decode(self, blob: bytes, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = 60.0) -> np.ndarray:
+        return self.submit_decode(blob, deadline_ms).result(timeout)
+
+    # -- worker side --------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._batcher.next_batch(timeout=0.25)
+            if batch is None:
+                return            # closed and empty: drain complete
+            if not batch:
+                continue
+            try:
+                self._process_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — must answer callers
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _process_batch(self, batch) -> None:
+        if self._batch_hook is not None:
+            self._batch_hook(batch)
+        kind, bucket = batch[0].key
+        t0 = time.monotonic()
+        self.metrics.gauge("serve_queue_depth").set(self._batcher.depth)
+        self.metrics.histogram("serve_batch_occupancy").observe(
+            len(batch) / self.config.max_batch)
+        if kind == ENCODE:
+            self._run_encode(batch, bucket)
+        else:
+            self._run_decode(batch, bucket)
+        now = time.monotonic()
+        for r in batch:
+            self.metrics.histogram("serve_latency_ms").observe(
+                (now - r.arrival) * 1e3)
+        self.metrics.counter("serve_batches").inc()
+        self.metrics.counter("serve_completed").inc(len(batch))
+        self.metrics.histogram("serve_batch_ms").observe((now - t0) * 1e3)
+        self.metrics.gauge("serve_xla_compiles").set(
+            recompile.compilation_count())
+
+    def _run_encode(self, batch, bucket) -> None:
+        bh, bw = bucket
+        n = len(batch)
+        x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
+        for i, r in enumerate(batch):
+            x[i] = r.payload[0]
+        symbols = np.asarray(self._encode_fn(
+            self.state.params, self.state.batch_stats, jnp.asarray(x)))
+        for i, r in enumerate(batch):
+            h, w = r.payload[1]
+            payload = self.codec.encode(np.transpose(symbols[i], (2, 0, 1)))
+            r.future.set_result(EncodeResult(
+                stream=frame_stream(payload, (h, w), bucket),
+                payload_bytes=len(payload),
+                bpp=len(payload) * 8.0 / (h * w),
+                shape=(h, w), bucket=bucket))
+
+    def _run_decode(self, batch, bucket) -> None:
+        bh, bw = bucket
+        sub = buckets_lib.SUBSAMPLING
+        sym = np.zeros((self.config.max_batch, bh // sub, bw // sub,
+                        self._bn_channels), np.int32)
+        per_item_exc = {}
+        for i, r in enumerate(batch):
+            try:
+                vol = self.codec.decode(r.payload[0])   # (C, bh/8, bw/8)
+                sym[i] = np.transpose(vol, (1, 2, 0))
+            except Exception as e:  # noqa: BLE001 — isolate bad streams
+                per_item_exc[i] = e
+        imgs = np.asarray(self._decode_fn(
+            self.state.params, self.state.batch_stats, jnp.asarray(sym)))
+        for i, r in enumerate(batch):
+            if i in per_item_exc:
+                r.future.set_exception(per_item_exc[i])
+                continue
+            h, w = r.payload[1]
+            r.future.set_result(
+                buckets_lib.crop_from_bucket(imgs[i], (h, w))
+                .astype(np.uint8))

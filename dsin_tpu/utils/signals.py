@@ -45,3 +45,30 @@ def install_interrupt_handlers() -> bool:
     signal.signal(signal.SIGINT, signal.default_int_handler)
     signal.signal(signal.SIGTERM, _raise_keyboard_interrupt)
     return True
+
+
+def install_drain_handlers(drain) -> bool:
+    """Route SIGINT/SIGTERM to `drain()` instead of unwinding.
+
+    The serving story (dsin_tpu/serve): a long-lived process must NOT die
+    mid-batch on a deploy's SIGTERM — it stops ACCEPTING work and finishes
+    what is in flight. `drain` must therefore be fast and non-blocking
+    (flip a flag, close a queue); the actual wait for in-flight work
+    happens in the serve loop, never inside a signal handler. A second
+    signal falls back to the training handlers above, so a stuck drain can
+    still be interrupted the ordinary way.
+
+    Returns True when installed (main thread only — signal.signal is
+    illegal elsewhere), False when skipped; the caller then drains via
+    its own stop API instead.
+    """
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _drain_once(signum, frame):  # noqa: ARG001
+        install_interrupt_handlers()  # second signal: hard interrupt
+        drain()
+
+    signal.signal(signal.SIGINT, _drain_once)
+    signal.signal(signal.SIGTERM, _drain_once)
+    return True

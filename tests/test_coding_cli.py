@@ -107,3 +107,54 @@ def test_cli_main_reports(tmp_path, tiny_cfg_files, capsys):
     codec_cli.main(["compress", x_png, stream,
                     "--ae_config", ae_p, "--pc_config", pc_p])
     assert "bpp" in capsys.readouterr().out
+
+
+def test_seed_disagreeing_with_header_is_a_clear_error(tmp_path,
+                                                      tiny_cfg_files):
+    """An explicit --seed that contradicts the stream header would decode
+    garbage (mismatched init weights -> diverged rANS probabilities), so
+    it must fail up front, naming both seeds — and the matching seed must
+    still be accepted (it is an assertion, not an override)."""
+    ae_p, pc_p = tiny_cfg_files
+    x_png = str(tmp_path / "x.png")
+    stream = str(tmp_path / "x.dsin")
+    _write_png(x_png, 5)
+    codec_cli.compress(x_png, stream, ae_p, pc_p, seed=3)
+    rec = str(tmp_path / "rec.png")
+    with pytest.raises(ValueError, match="disagrees.*3"):
+        codec_cli.decompress(stream, rec, ae_p, pc_p, seed=7)
+    assert not os.path.exists(rec)     # failed BEFORE the slow decode
+    out = codec_cli.decompress(stream, rec, ae_p, pc_p, seed=3)
+    assert out["shape"] == (16, 24) and os.path.exists(rec)
+
+
+def test_cli_main_reports_user_errors_without_traceback(tmp_path,
+                                                        tiny_cfg_files,
+                                                        capsys):
+    """Through main(): a header/flag disagreement (and any other bad
+    stream) exits 2 with one clear stderr line, never a traceback."""
+    ae_p, pc_p = tiny_cfg_files
+    x_png = str(tmp_path / "x.png")
+    stream = str(tmp_path / "x.dsin")
+    _write_png(x_png, 6)
+    codec_cli.main(["compress", x_png, stream, "--seed", "1",
+                    "--ae_config", ae_p, "--pc_config", pc_p])
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as exc:
+        codec_cli.main(["decompress", stream, str(tmp_path / "r.png"),
+                        "--seed", "2",
+                        "--ae_config", ae_p, "--pc_config", pc_p])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert err.startswith("error:") and "disagrees" in err
+    assert "Traceback" not in err
+
+    # a truncated/garbage stream goes down the same clean path
+    bad = str(tmp_path / "bad.dsin")
+    with open(bad, "wb") as f:
+        f.write(b"JUNK")
+    with pytest.raises(SystemExit) as exc:
+        codec_cli.main(["decompress", bad, str(tmp_path / "r2.png"),
+                        "--ae_config", ae_p, "--pc_config", pc_p])
+    assert exc.value.code == 2
+    assert "not a DSIM stream" in capsys.readouterr().err

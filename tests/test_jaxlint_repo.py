@@ -30,6 +30,17 @@ def test_repo_gate_via_cli_contract(capsys):
     assert "0 finding(s)" in capsys.readouterr().out
 
 
+def test_serve_subsystem_is_in_the_gate():
+    """dsin_tpu/serve/ rides the dsin_tpu/ walk above; pin that the walk
+    really reaches it (a path-filter regression would silently exempt the
+    serving hot path from the lint gate) and that it lints clean on its
+    own."""
+    findings, _, files = lint_paths(
+        [os.path.join(REPO, "dsin_tpu", "serve")])
+    assert files >= 5, f"serve/ walk found only {files} files"
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 def test_suppressions_stay_justified():
     """Every inline suppression in the repo carries a reason (the
     missing-reason meta-finding is part of the clean gate above, but

@@ -1,0 +1,119 @@
+"""Micro-batcher semantics (dsin_tpu/serve/batcher.py): coalescing,
+backpressure, deadlines, drain. Pure stdlib threading — no jax, so these
+run in milliseconds and pin the concurrency contract exactly."""
+
+import threading
+import time
+
+import pytest
+
+from dsin_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher, Request,
+                                    ServiceDraining, ServiceOverloaded)
+
+
+def _req(key="k", payload=None, deadline=None):
+    return Request(key=key, payload=payload, deadline=deadline)
+
+
+def test_coalesces_same_key_up_to_max_batch():
+    b = MicroBatcher(max_batch=3, max_wait_ms=50, max_queue=16)
+    reqs = [_req() for _ in range(5)]
+    for r in reqs:
+        b.submit(r)
+    first = b.next_batch(timeout=1)
+    second = b.next_batch(timeout=1)
+    assert [r.payload for r in first] == [None] * 3 and len(first) == 3
+    assert len(second) == 2
+    assert first == reqs[:3] and second == reqs[3:]    # FIFO order
+    assert b.depth == 0
+
+
+def test_batches_never_mix_keys_and_oldest_head_goes_first():
+    b = MicroBatcher(max_batch=4, max_wait_ms=0, max_queue=16)
+    ra, rb = _req(key="a"), _req(key="b")
+    rb.arrival -= 1.0          # b's head is older
+    b.submit(ra)
+    b.submit(rb)
+    first = b.next_batch(timeout=1)
+    second = b.next_batch(timeout=1)
+    assert first == [rb] and second == [ra]
+
+
+def test_partial_batch_released_after_max_wait():
+    b = MicroBatcher(max_batch=8, max_wait_ms=30, max_queue=16)
+    b.submit(_req())
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=2)
+    waited = time.monotonic() - t0
+    assert len(batch) == 1
+    # released by the head's age bound, not the 2s poll timeout
+    assert waited < 1.0
+
+
+def test_late_same_key_arrival_rides_along():
+    b = MicroBatcher(max_batch=2, max_wait_ms=500, max_queue=16)
+    b.submit(_req())
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.setdefault("batch", b.next_batch(timeout=5)))
+    t.start()
+    time.sleep(0.05)
+    b.submit(_req())           # arrives while the worker is coalescing
+    t.join(timeout=5)
+    assert len(got["batch"]) == 2
+
+
+def test_backpressure_rejects_at_the_door():
+    b = MicroBatcher(max_batch=2, max_wait_ms=10, max_queue=2)
+    b.submit(_req())
+    b.submit(_req())
+    with pytest.raises(ServiceOverloaded):
+        b.submit(_req())
+    # popping a batch frees capacity again
+    assert len(b.next_batch(timeout=1)) == 2
+    b.submit(_req())
+
+
+def test_expired_request_completes_with_deadline_exceeded():
+    b = MicroBatcher(max_batch=4, max_wait_ms=0, max_queue=16)
+    dead = _req(deadline=time.monotonic() - 0.01)
+    alive = _req()
+    b.submit(dead)
+    b.submit(alive)
+    batch = b.next_batch(timeout=1)
+    assert batch == [alive]
+    assert isinstance(dead.future.exception(timeout=0), DeadlineExceeded)
+    assert b.depth == 0
+
+
+def test_close_rejects_queued_and_signals_workers():
+    b = MicroBatcher(max_batch=2, max_wait_ms=10, max_queue=16)
+    queued = [_req() for _ in range(3)]
+    for r in queued:
+        b.submit(r)
+    assert b.close() == 3
+    for r in queued:
+        assert isinstance(r.future.exception(timeout=0), ServiceDraining)
+    assert b.next_batch(timeout=1) is None     # worker exit signal
+    with pytest.raises(ServiceDraining):
+        b.submit(_req())
+    assert b.close() == 0                      # idempotent
+
+
+def test_close_wakes_a_blocked_worker():
+    b = MicroBatcher(max_batch=2, max_wait_ms=10, max_queue=16)
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.setdefault("r", b.next_batch()))  # no timeout
+    t.start()
+    time.sleep(0.05)
+    b.close()
+    t.join(timeout=5)
+    assert not t.is_alive() and got["r"] is None
+
+
+def test_next_batch_timeout_returns_empty_list():
+    b = MicroBatcher(max_batch=2, max_wait_ms=10, max_queue=16)
+    t0 = time.monotonic()
+    assert b.next_batch(timeout=0.05) == []
+    assert time.monotonic() - t0 < 1.0

@@ -1,0 +1,70 @@
+"""Shape-bucket policy (dsin_tpu/serve/buckets.py): the routing layer the
+fixed-executable-census guarantee rests on. Pure numpy — no jax."""
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve.buckets import (SUBSAMPLING, BucketPolicy, NoBucketFits,
+                                    crop_from_bucket, pad_to_bucket)
+
+
+def test_smallest_fitting_bucket_wins():
+    policy = BucketPolicy([(128, 256), (64, 64), (256, 512)])
+    assert policy.bucket_for(10, 10) == (64, 64)
+    assert policy.bucket_for(64, 64) == (64, 64)       # exact fit
+    assert policy.bucket_for(65, 10) == (128, 256)     # one edge overflows
+    assert policy.bucket_for(10, 65) == (128, 256)
+    assert policy.bucket_for(200, 300) == (256, 512)
+
+
+def test_area_order_not_config_order():
+    # smaller AREA must win regardless of the order buckets were declared
+    policy = BucketPolicy([(64, 512), (128, 128)])
+    assert policy.bucket_for(100, 100) == (128, 128)
+    assert policy.bucket_for(32, 300) == (64, 512)
+
+
+def test_too_large_raises_no_bucket_fits():
+    policy = BucketPolicy([(64, 64)])
+    with pytest.raises(NoBucketFits):
+        policy.bucket_for(65, 65)
+    with pytest.raises(ValueError):
+        policy.bucket_for(0, 10)
+
+
+def test_bucket_validation():
+    with pytest.raises(ValueError):
+        BucketPolicy([])
+    with pytest.raises(ValueError):
+        BucketPolicy([(60, 64)])           # not /SUBSAMPLING
+    with pytest.raises(ValueError):
+        BucketPolicy([(64, 64), (64, 64)])  # duplicate
+    assert SUBSAMPLING == 8  # AE downsampling — cli.py enforces the same
+
+
+def test_pad_crop_roundtrip_preserves_pixels():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (10, 17, 3), dtype=np.uint8)
+    padded = pad_to_bucket(img, (16, 24))
+    assert padded.shape == (16, 24, 3)
+    np.testing.assert_array_equal(crop_from_bucket(padded, (10, 17)), img)
+    # replicated border, not zeros: the conv receptive fields near the
+    # real edge must not see a synthetic black frame
+    np.testing.assert_array_equal(padded[10:, :17],
+                                  np.broadcast_to(img[9:10, :17],
+                                                  (6, 17, 3)))
+    np.testing.assert_array_equal(padded[:10, 17:],
+                                  np.broadcast_to(img[:10, 16:17],
+                                                  (10, 7, 3)))
+
+
+def test_pad_exact_fit_returns_fresh_storage_and_rejects_oversize():
+    img = np.zeros((16, 24, 3), np.float32)
+    out = pad_to_bucket(img, (16, 24))
+    np.testing.assert_array_equal(out, img)
+    # even the exact fit must NOT alias the input: the result gets
+    # enqueued, and a caller reusing its frame buffer would otherwise
+    # overwrite work that is still waiting in the batcher
+    assert not np.shares_memory(out, img)
+    with pytest.raises(ValueError):
+        pad_to_bucket(img, (8, 24))

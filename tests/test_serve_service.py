@@ -1,0 +1,237 @@
+"""Service-level tests for dsin_tpu/serve: a real (tiny) model behind the
+micro-batcher, exercised through the public submit/encode/decode API.
+
+Pins the three acceptance properties of the serving PR:
+  * mixed-shape streams after warm-up trigger ZERO XLA compiles
+    (CompilationSentinel(budget=0) — the bucket census holds);
+  * a full queue answers ServiceOverloaded instead of buffering;
+  * SIGTERM drains gracefully — in-flight requests complete, queued ones
+    are rejected cleanly (utils/signals.py drain path).
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import (CompressionService, EncodeResult, NoBucketFits,
+                            ServiceConfig, ServiceDraining,
+                            ServiceOverloaded)
+from dsin_tpu.serve.service import parse_stream
+
+BUCKETS = ((16, 24), (32, 48))
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("serve_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+@pytest.fixture(scope="module")
+def service(tiny_cfg_files):
+    """One shared WARMED service for the read-only tests; draining tests
+    build their own instances."""
+    ae_p, pc_p = tiny_cfg_files
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS, max_batch=2,
+        max_wait_ms=2.0, max_queue=16, workers=1, metrics_port=0)).start()
+    warm = svc.warmup()
+    assert warm["compiles"] > 0, "warmup compiled nothing — vacuous census"
+    yield svc
+    svc.drain()
+
+
+def _fresh_service(tiny_cfg_files, **over):
+    ae_p, pc_p = tiny_cfg_files
+    kw = dict(ae_config=ae_p, pc_config=pc_p, buckets=((16, 24),),
+              max_batch=1, max_wait_ms=0.0, max_queue=8, workers=1)
+    kw.update(over)
+    return CompressionService(ServiceConfig(**kw)).start()
+
+
+def _img(rng, h, w):
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+# -- roundtrip plumbing -------------------------------------------------------
+
+def test_roundtrip_matches_model_on_streamed_symbols(service):
+    """The stream must carry the exact symbols the batched encoder
+    produced, and decode must be the model's reconstruction of exactly
+    those symbols, cropped to the original shape. All comparisons run
+    through the service's OWN executables, so equality is exact."""
+    import jax.numpy as jnp
+
+    from dsin_tpu.serve.buckets import pad_to_bucket
+    rng = np.random.default_rng(0)
+    img = _img(rng, 10, 17)               # deliberately un-aligned shape
+    res = service.encode(img)
+    assert isinstance(res, EncodeResult)
+    assert res.shape == (10, 17) and res.bucket == (16, 24)
+    assert res.payload_bytes > 0
+    assert res.bpp == pytest.approx(res.payload_bytes * 8.0 / (10 * 17))
+
+    payload, shape, bucket = parse_stream(res.stream)
+    assert shape == (10, 17) and bucket == (16, 24)
+    assert len(payload) == res.payload_bytes
+
+    # stream symbols == batched-executable symbols for the padded image
+    x = np.zeros((service.config.max_batch, 16, 24, 3), np.float32)
+    x[0] = pad_to_bucket(img.astype(np.float32), bucket)
+    want_sym = np.asarray(service._encode_fn(
+        service.state.params, service.state.batch_stats, jnp.asarray(x)))[0]
+    got_vol = service.codec.decode(payload)            # (C, 2, 3)
+    np.testing.assert_array_equal(np.transpose(got_vol, (1, 2, 0)),
+                                  want_sym)
+
+    # service decode == model decode of those symbols, cropped
+    out = service.decode(res.stream)
+    assert out.shape == (10, 17, 3) and out.dtype == np.uint8
+    sym = np.zeros((service.config.max_batch, 2, 3,
+                    want_sym.shape[-1]), np.int32)
+    sym[0] = want_sym
+    imgs = np.asarray(service._decode_fn(
+        service.state.params, service.state.batch_stats, jnp.asarray(sym)))
+    np.testing.assert_array_equal(out, imgs[0][:10, :17].astype(np.uint8))
+
+
+def test_mixed_shape_steady_state_compiles_zero(service):
+    """Acceptance criterion: >=3 distinct image sizes across >=2 buckets,
+    encode AND decode, after warm-up — zero XLA compiles. A nonzero count
+    means a request shape leaked past the bucket padding into a jit
+    signature, the exact failure mode serve/buckets.py exists to kill."""
+    from dsin_tpu.utils.recompile import CompilationSentinel
+    rng = np.random.default_rng(1)
+    sizes = [(16, 24), (10, 17), (32, 48), (24, 40), (9, 33)]
+    with CompilationSentinel(budget=0, label="serve steady state"):
+        streams = [service.encode(_img(rng, h, w)).stream
+                   for h, w in sizes]
+        for (h, w), s in zip(sizes, streams):
+            assert service.decode(s).shape == (h, w, 3)
+
+
+def test_bucket_routing_rejections(service):
+    rng = np.random.default_rng(2)
+    with pytest.raises(NoBucketFits):
+        service.submit_encode(_img(rng, 33, 48))   # taller than max bucket
+    with pytest.raises(ValueError):
+        service.submit_decode(b"not a stream")
+    # a stream for a bucket this service does not serve
+    from dsin_tpu.serve.service import frame_stream
+    alien = frame_stream(b"\x00" * 4, (10, 10), (64, 64))
+    with pytest.raises(NoBucketFits):
+        service.submit_decode(alien)
+
+
+def test_metrics_endpoint_serves_health_and_metrics(service):
+    import json
+    import urllib.request
+    port = service._metrics_server.port
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+    assert health["status"] == "ok"
+    assert health["buckets"] == [list(b) for b in BUCKETS]
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    for needle in ("serve_completed_total", "serve_latency_ms_p99",
+                   "serve_batch_occupancy_mean", "serve_xla_compiles"):
+        assert needle in text, text
+    snap = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics?format=json", timeout=5).read())
+    assert snap["counters"]["serve_completed"] > 0
+
+
+# -- backpressure and deadlines ----------------------------------------------
+
+def test_full_queue_rejects_with_service_overloaded(tiny_cfg_files):
+    """max_queue bounds memory: with the worker wedged, the queue fills
+    and further submits fail fast at the door; releasing the worker
+    completes everything that was admitted."""
+    svc = _fresh_service(tiny_cfg_files, max_queue=3)
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(batch):  # noqa: ARG001
+        entered.set()
+        assert release.wait(20)
+    svc._batch_hook = hook
+    rng = np.random.default_rng(3)
+    img = _img(rng, 16, 24)
+    try:
+        f0 = svc.submit_encode(img)           # popped into flight
+        assert entered.wait(10)
+        admitted = [svc.submit_encode(img) for _ in range(2)]
+        # a queued request whose deadline lapses is answered, not served
+        doomed = svc.submit_encode(_img(rng, 16, 24), deadline_ms=1.0)
+        with pytest.raises(ServiceOverloaded):   # 3/3 queued: door shut
+            svc.submit_encode(img)
+        time.sleep(0.05)
+        release.set()
+        assert isinstance(f0.result(timeout=30), EncodeResult)
+        for f in admitted:
+            assert isinstance(f.result(timeout=30), EncodeResult)
+        from dsin_tpu.serve import DeadlineExceeded
+        assert isinstance(doomed.exception(timeout=30), DeadlineExceeded)
+        assert svc.metrics.counter("serve_rejected_overload").value >= 1
+        assert svc.metrics.counter("serve_rejected_deadline").value >= 1
+        # submitted counts ACCEPTED requests only (f0 + 2 admitted +
+        # doomed), so submitted - completed bounds the live backlog
+        assert svc.metrics.counter("serve_submitted").value == 4
+    finally:
+        release.set()
+        svc.drain()
+
+
+# -- graceful drain (utils/signals.py path) ----------------------------------
+
+def test_sigterm_drains_in_flight_completes_queued_rejected(tiny_cfg_files):
+    """The serving drain contract end-to-end: SIGTERM (sent from a
+    thread, delivered to the pytest main thread) flips the service into
+    drain via utils/signals.install_drain_handlers — the wedged in-flight
+    batch still COMPLETES, every queued request is rejected with
+    ServiceDraining, and new submits are refused."""
+    svc = _fresh_service(tiny_cfg_files, max_queue=8)
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    assert svc.install_signal_handlers()      # pytest runs us on main
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(batch):  # noqa: ARG001
+        entered.set()
+        assert release.wait(20)
+    svc._batch_hook = hook
+    rng = np.random.default_rng(4)
+    img = _img(rng, 16, 24)
+    try:
+        futs = [svc.submit_encode(img) for _ in range(4)]
+        assert entered.wait(10)               # futs[0] is now in flight
+        threading.Thread(
+            target=lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+        deadline = time.monotonic() + 10
+        while not svc.draining and time.monotonic() < deadline:
+            time.sleep(0.005)                 # handler runs on main thread
+        assert svc.draining, "SIGTERM did not reach the drain handler"
+        # queued requests are already rejected — before in-flight finishes
+        for f in futs[1:]:
+            assert isinstance(f.exception(timeout=5), ServiceDraining)
+        with pytest.raises(ServiceDraining):
+            svc.submit_encode(img)
+        release.set()                         # let the in-flight batch run
+        assert svc.wait_drained(timeout=30), "workers did not exit"
+        assert isinstance(futs[0].result(timeout=5), EncodeResult)
+        assert svc.health()["status"] == "draining"
+    finally:
+        release.set()
+        svc.drain()
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)

@@ -90,3 +90,39 @@ def test_install_skipped_off_main_thread():
     t.start()
     t.join()
     assert out["r"] is False
+
+
+def test_drain_handlers_route_signal_to_callback():
+    """The serving drain path: SIGTERM from a worker thread lands in the
+    main thread and calls drain() instead of unwinding the process. (The
+    full service-level contract — in-flight completes, queued rejected —
+    lives in test_serve_service.py; this pins the signal plumbing.)"""
+    from dsin_tpu.utils.signals import install_drain_handlers
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    drained = threading.Event()
+    try:
+        assert install_drain_handlers(drained.set)
+        threading.Thread(
+            target=lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+        deadline = time.monotonic() + 10
+        while not drained.is_set() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert drained.is_set(), "drain callback never ran"
+        # after the first signal the hard-interrupt handlers are back, so
+        # a wedged drain can still be killed the ordinary way
+        assert signal.getsignal(signal.SIGINT) is signal.default_int_handler
+    finally:
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_drain_handlers_skipped_off_main_thread():
+    from dsin_tpu.utils.signals import install_drain_handlers
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault(
+            "r", install_drain_handlers(lambda: None)))
+    t.start()
+    t.join()
+    assert out["r"] is False

@@ -135,6 +135,29 @@ def test_cityscapes_chip_smoke_cpu(tmp_path):
     assert att["step_wall_s"] and att["loss_final"] is not None
 
 
+def test_serve_bench_smoke_json_contract(tmp_path):
+    """Tier-1 (NOT slow): the serving acceptance surface in one run —
+    tools/serve_bench.py --smoke must emit a SERVE_BENCH.json carrying
+    throughput, batch occupancy, p50/p99 latency, a non-empty trajectory,
+    and a ZERO steady-state compile count over its mixed-shape stream."""
+    out = tmp_path / "serve.json"
+    r = _run("serve_bench.py", "--smoke", "--out", str(out))
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(out.read_text())
+    load = report["load"]
+    assert load["completed"] > 0 and load["failed"] == 0
+    assert load["throughput_rps"] > 0
+    lat = report["latency_ms"]
+    assert lat["p50"] > 0 and lat["p99"] >= lat["p50"]
+    occ = report["batch_occupancy"]
+    assert 0 < occ["mean"] <= 1 and occ["batches"] > 0
+    assert report["warmup"]["compiles"] > 0
+    assert report["steady_compiles"] == 0, (
+        "mixed-shape serving stream recompiled after warm-up")
+    assert report["decode_roundtrips"] > 0
+    assert report["trajectory"], "empty trajectory time series"
+
+
 def test_cache_dir_keyed_by_host_fingerprint():
     """XLA:CPU AOT cache entries embed the COMPILE host's CPU features;
     a dir shared across hosts loads mismatched code with documented

@@ -1,0 +1,267 @@
+"""Open-loop load bench for the micro-batching service (dsin_tpu/serve).
+
+Drives CompressionService with a synthetic OPEN-LOOP arrival process:
+request submission times are fixed up front at `--rate` req/s and
+submitted asynchronously regardless of completions — the honest serving
+measurement (a closed loop self-throttles and hides queueing collapse).
+Shapes rotate through `--shapes`, so the stream is mixed-shape across
+buckets; after warm-up the steady-state XLA compile count must be 0
+(measured and reported — nonzero means the bucket policy leaked a shape).
+
+Emits a SERVE_BENCH.json trajectory artifact: totals (throughput,
+rejections by cause), latency quantiles, batch occupancy, compile
+counts, and a sampled time series of queue depth / completion progress.
+
+Usage:
+    python tools/serve_bench.py                      # committed artifact
+    python tools/serve_bench.py --smoke --out /tmp/s.json   # tier-1 CI
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# tiny standalone configs for --smoke: CI has no dataset and no minutes to
+# spare, but the service mechanics (bucketing, batching, drain, compile
+# census) are shape-independent, so the smallest model that exercises the
+# full quantize->rANS->decode path is the right smoke vehicle
+SMOKE_AE_CFG = """
+arch = CVPR
+arch_param_B = 1
+num_chan_bn = 4
+heatmap = True
+num_centers = 6
+centers_initial_range = (-2, 2)
+normalization = 'FIXED'
+AE_only = True
+si_weight = 0.7
+y_patch_size = (8, 12)
+use_gauss_mask = True
+use_L2andLAB = False
+batch_size = 1
+num_crops_per_img = 1
+H_target = 0.08
+beta = 500
+distortion_to_minimize = 'mae'
+K_psnr = 100
+K_ms_ssim = 5000
+regularization_factor = 0.0005
+regularization_factor_centers = 0.01
+optimizer = 'ADAM'
+lr_initial = 3e-4
+lr_schedule = 'FIXED'
+train_autoencoder = True
+train_probclass = True
+lr_centers_factor = None
+bn_stats = 'update'
+"""
+
+SMOKE_PC_CFG = """
+arch = res_shallow
+kernel_size = 3
+arch_param__k = 6
+use_centers_for_padding = True
+regularization_factor = None
+optimizer = 'ADAM'
+lr_initial = 3e-4
+lr_schedule = 'FIXED'
+"""
+
+
+def _parse_shapes(spec):
+    shapes = []
+    for part in spec.split():
+        h, w = (int(v) for v in part.split(","))
+        shapes.append((h, w))
+    return shapes
+
+
+def _write_smoke_cfgs(tmpdir):
+    ae_p = os.path.join(tmpdir, "ae_smoke")
+    pc_p = os.path.join(tmpdir, "pc_smoke")
+    with open(ae_p, "w") as f:
+        f.write(SMOKE_AE_CFG)
+    with open(pc_p, "w") as f:
+        f.write(SMOKE_PC_CFG)
+    return ae_p, pc_p
+
+
+def run_bench(args) -> dict:
+    from dsin_tpu.serve import (CompressionService, ServeError,
+                                ServiceConfig)
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    shapes = _parse_shapes(args.shapes)
+    buckets = _parse_shapes(args.buckets)
+    cfg = ServiceConfig(
+        ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
+        seed=args.seed, buckets=buckets, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        workers=args.workers)
+    service = CompressionService(cfg).start()
+    warm = service.warmup()
+
+    rng = np.random.default_rng(args.seed)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+
+    futures, rejected = [], 0
+    trajectory = []
+    stop_sampler = threading.Event()
+
+    def sampler():
+        t0 = time.monotonic()
+        while not stop_sampler.wait(args.sample_every_ms / 1000.0):
+            snap = service.metrics.snapshot()
+            trajectory.append({
+                "t_s": round(time.monotonic() - t0, 4),
+                "queue_depth": service.health()["queue_depth"],
+                "submitted": snap["counters"].get("serve_submitted", 0),
+                "completed": snap["counters"].get("serve_completed", 0),
+            })
+
+    sampler_thread = threading.Thread(target=sampler, daemon=True)
+    sampler_thread.start()
+
+    period = 1.0 / args.rate
+    t_start = time.monotonic()
+    with CompilationSentinel(budget=0, label="serve steady state",
+                             raise_on_exceed=False) as sentinel:
+        for i in range(args.requests):
+            target = t_start + i * period
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures.append(service.submit_encode(
+                    images[i % len(images)],
+                    deadline_ms=args.deadline_ms))
+            except ServeError:
+                rejected += 1
+        errors = 0
+        t_submit_done = time.monotonic()
+        for f in futures:
+            try:
+                f.result(timeout=60.0)
+            except Exception:  # noqa: BLE001 — rejection modes counted below
+                errors += 1
+        t_done = time.monotonic()
+        # snapshot the encode-load metrics BEFORE the decode leg so
+        # "completed"/latency describe exactly the open-loop stream
+        snap = service.metrics.snapshot()
+        # decode leg: roundtrip a handful of the encoded streams so the
+        # artifact covers both directions (still under the sentinel)
+        decode_ok = 0
+        for f in futures[:args.decode_samples]:
+            exc = f.exception(timeout=0)
+            if exc is None:
+                img = service.decode(f.result().stream)
+                decode_ok += 1
+                assert img.ndim == 3
+    stop_sampler.set()
+    sampler_thread.join(timeout=2)
+    service.drain()
+
+    lat = snap["histograms"].get("serve_latency_ms",
+                                 {"count": 0, "mean": 0, "p50": 0, "p99": 0})
+    occ = snap["histograms"].get("serve_batch_occupancy", {"mean": 0.0})
+    completed = snap["counters"].get("serve_completed", 0)
+    duration = t_done - t_start
+    report = {
+        "config": {
+            "shapes": [list(s) for s in shapes],
+            "buckets": [list(b) for b in buckets],
+            "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+            "max_queue": args.max_queue, "workers": args.workers,
+            "rate_rps": args.rate, "requests": args.requests,
+            "deadline_ms": args.deadline_ms, "smoke": args.smoke,
+        },
+        "warmup": warm,
+        "load": {
+            "submitted": len(futures),
+            "rejected_at_submit": rejected,
+            "completed": completed,
+            "failed": errors,
+            "rejected_overload": snap["counters"].get(
+                "serve_rejected_overload", 0),
+            "rejected_deadline": snap["counters"].get(
+                "serve_rejected_deadline", 0),
+            "rejected_drain": snap["counters"].get(
+                "serve_rejected_drain", 0),
+            "duration_s": round(duration, 4),
+            "submit_window_s": round(t_submit_done - t_start, 4),
+            "throughput_rps": round(completed / duration, 3)
+            if duration > 0 else 0.0,
+        },
+        "latency_ms": {k: round(float(v), 3) for k, v in lat.items()},
+        "batch_occupancy": {
+            "mean": round(float(occ.get("mean", 0.0)), 4),
+            "batches": snap["counters"].get("serve_batches", 0),
+        },
+        "decode_roundtrips": decode_ok,
+        "steady_compiles": sentinel.compilations,
+        "trajectory": trajectory,
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="open-loop load bench for dsin_tpu/serve")
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "dsin_tpu", "configs")
+    p.add_argument("--ae_config",
+                   default=os.path.join(base, "ae_synthetic_micro"))
+    p.add_argument("--pc_config", default=os.path.join(base, "pc_default"))
+    p.add_argument("--ckpt", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--shapes", default="48,144 40,96 32,144",
+                   help="space-separated h,w request shapes (mixed stream)")
+    p.add_argument("--buckets", default="40,96 48,144",
+                   help="space-separated h,w bucket shapes")
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="open-loop arrival rate, requests/second")
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--max_wait_ms", type=float, default=10.0)
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--deadline_ms", type=float, default=None)
+    p.add_argument("--decode_samples", type=int, default=4)
+    p.add_argument("--sample_every_ms", type=float, default=100.0)
+    p.add_argument("--out", default="SERVE_BENCH.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny model + short run for tier-1 CI")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        import tempfile
+        args.ae_config, args.pc_config = _write_smoke_cfgs(tempfile.mkdtemp())
+        args.shapes = "16,24 24,32 32,48"
+        args.buckets = "24,32 32,48"
+        args.rate = 100.0
+        args.requests = 40
+        args.max_batch = 2
+        args.sample_every_ms = 20.0
+
+    report = run_bench(args)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
+    print(json.dumps({k: report[k] for k in
+                      ("load", "latency_ms", "batch_occupancy",
+                       "steady_compiles")}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

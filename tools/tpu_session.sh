@@ -22,6 +22,8 @@ lint)
   # fail fast BEFORE burning chip time: jaxlint's exit-code contract
   # (0 clean / 1 findings / 2 internal) gates the queue on the static
   # JAX hazards — recompilation captures, host syncs in step loops, ...
+  # The dsin_tpu/ walk includes dsin_tpu/serve/ (the serving subsystem);
+  # tests/test_jaxlint_repo.py pins that coverage.
   python -m tools.jaxlint dsin_tpu/ tools/ bench.py __graft_entry__.py \
     > artifacts/jaxlint.log 2>&1 || rc=$?
   if [ "$rc" -ne 0 ]; then
