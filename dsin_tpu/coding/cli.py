@@ -8,15 +8,20 @@ the decoder-side information image to run the full DSIN path (patch search +
 siNet fusion) — the asymmetry that defines the method: the ENCODER never
 sees y, so the bitstream is identical with or without it.
 
-File format (little-endian):
+File format (little-endian, v3):
     b"DSIM" | u8 version | u16 img_h | u16 img_w | u32 init_seed
-            | u32 payload_len | payload
+            | u32 crc32 | u32 payload_len | payload
 where payload is a BottleneckCodec stream (its own header carries the
-symbol-volume dims). `init_seed` is the parameter-init PRNG seed the
-encoder ran with: when no --ckpt restores real weights, the decoder MUST
-rebuild the identical random model or the rANS probabilities diverge and
-the decode silently produces garbage — so decompress defaults to the
-header's seed and only an explicit --seed overrides it.
+symbol-volume dims). `crc32` covers every header field after the magic
+(except itself) plus the payload: a single flipped bit anywhere in the
+frame raises a typed IntegrityError instead of decoding to a plausible
+garbage image — the context-model coupling makes payload corruption
+otherwise silent. v2 streams (no CRC) remain readable. `init_seed` is
+the parameter-init PRNG seed the encoder ran with: when no --ckpt
+restores real weights, the decoder MUST rebuild the identical random
+model or the rANS probabilities diverge and the decode silently produces
+garbage — so decompress defaults to the header's seed and only an
+explicit --seed overrides it.
 
 Usage:
     python -m dsin_tpu.coding.cli compress  x.png out.dsin --ckpt weights/m
@@ -36,15 +41,60 @@ import jax.numpy as jnp
 import numpy as np
 
 from dsin_tpu.coding.loader import load_model_state, make_codec
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.integrity import IntegrityError, frame_crc, verify_crc
 
 MAGIC = b"DSIM"
-VERSION = 2            # v2: header records the parameter-init seed
-_HEADER_LEN = 17       # magic(4) + BHH(5) + seed(4) + payload_len(4)
+VERSION = 3            # v3: + CRC32 over header fields + payload
+_HEADER_LEN = 21       # magic(4) + BHH(5) + seed(4) + crc(4) + len(4)
+_HEADER_LEN_V2 = 17    # v2: no CRC field
 
 # construction lives in coding/loader.py now (shared with dsin_tpu/serve);
 # the old private names stay importable for existing call sites
 _load_model_state = load_model_state
 _make_codec = make_codec
+
+
+def frame_dsim(payload: bytes, h: int, w: int, seed: int) -> bytes:
+    """Frame a BottleneckCodec payload as a v3 DSIM stream."""
+    head = struct.pack("<BHHI", VERSION, h, w, seed)
+    tail = struct.pack("<I", len(payload))
+    crc = frame_crc(head, tail, payload)
+    return MAGIC + head + struct.pack("<I", crc) + tail + payload
+
+
+def parse_dsim(blob: bytes):
+    """-> (version, h, w, seed, payload); every corruption mode is a
+    typed error. v3 verifies the frame CRC (IntegrityError on mismatch);
+    v2 streams predate the CRC and parse without one. Pure bytes-in
+    validation — callable without a model, which is what lets the fuzz
+    tests sweep every header field cheaply."""
+    if len(blob) < _HEADER_LEN_V2 or blob[:4] != MAGIC:
+        raise ValueError("not a DSIM stream")
+    version = blob[4]
+    if version == 2:
+        version, h, w, seed, n = struct.unpack("<BHHII",
+                                               blob[4:_HEADER_LEN_V2])
+        payload = blob[_HEADER_LEN_V2:_HEADER_LEN_V2 + n]
+    elif version == VERSION:
+        if len(blob) < _HEADER_LEN:
+            raise ValueError(f"truncated DSIM v3 header: {len(blob)} of "
+                             f"{_HEADER_LEN} bytes")
+        version, h, w, seed, crc, n = struct.unpack("<BHHIII",
+                                                    blob[4:_HEADER_LEN])
+        payload = blob[_HEADER_LEN:_HEADER_LEN + n]
+    else:
+        raise ValueError(f"unsupported version {version}")
+    if len(payload) != n:
+        # the rANS decoder cannot detect truncation itself — it would
+        # silently produce garbage symbols
+        raise ValueError(f"truncated stream: payload {len(payload)} of "
+                         f"{n} bytes")
+    if version == VERSION:
+        verify_crc(crc, "DSIM stream", struct.pack("<BHHI", version, h, w,
+                                                   seed),
+                   struct.pack("<I", n), payload)
+    return version, h, w, seed, payload
 
 
 def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
@@ -69,9 +119,7 @@ def compress(x_path: str, out_path: str, ae_config: str, pc_config: str,
     payload = encode_batch(_make_codec(model, state), symbols[None])[0]
 
     with open(out_path, "wb") as f:
-        f.write(MAGIC + struct.pack("<BHHII", VERSION, h, w, seed,
-                                    len(payload)))
-        f.write(payload)
+        f.write(frame_dsim(payload, h, w, seed))
     bpp = len(payload) * 8.0 / (h * w)
     return {"bytes": len(payload), "bpp": bpp, "shape": (h, w)}
 
@@ -92,11 +140,8 @@ def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
 
     with open(in_path, "rb") as f:
         blob = f.read()
-    if len(blob) < _HEADER_LEN or blob[:4] != MAGIC:
-        raise ValueError("not a DSIM stream")
-    version, h, w, hdr_seed, n = struct.unpack("<BHHII", blob[4:_HEADER_LEN])
-    if version != VERSION:
-        raise ValueError(f"unsupported version {version}")
+    blob = faults.corrupt("io.read", blob)   # no-op without a fault plan
+    _, h, w, hdr_seed, payload = parse_dsim(blob)
     if seed is None:
         seed = hdr_seed
     elif seed != hdr_seed:
@@ -104,12 +149,6 @@ def decompress(in_path: str, out_path: str, ae_config: str, pc_config: str,
             f"--seed {seed} disagrees with the stream header's init seed "
             f"{hdr_seed}: the encoder ran with seed {hdr_seed}, so any "
             f"other init decodes garbage. Drop --seed to trust the header.")
-    payload = blob[_HEADER_LEN:_HEADER_LEN + n]
-    if len(payload) != n:
-        # the rANS decoder cannot detect truncation itself — it would
-        # silently produce garbage symbols
-        raise ValueError(f"truncated stream: payload {len(payload)} of "
-                         f"{n} bytes")
 
     model, state = _load_model_state(ae_config, pc_config, ckpt, (h, w),
                                      need_sinet=side is not None, seed=seed)
@@ -192,6 +231,11 @@ def main(argv=None) -> None:
                               seed=args.seed)
             print(f"{args.output}: reconstructed {info['shape']}"
                   f"{' with side information' if info['with_si'] else ''}")
+    except IntegrityError as e:
+        # a corrupted stream is an environment failure, not a bug: one
+        # clear line naming the CRC mismatch, clean exit 2, no traceback
+        print(f"integrity error: {e}", file=sys.stderr)
+        raise SystemExit(2)
     except ValueError as e:
         # bad streams / flag-header disagreements are user errors, not
         # crashes: report one clear line, not a traceback

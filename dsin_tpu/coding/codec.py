@@ -324,6 +324,11 @@ class BottleneckCodec:
         (sequential/wavefront/wavefront_np) is read from the stream header —
         it defines the symbol order and the exact PMF floats, so it is a
         property of the stream, not a knob."""
+        if len(bitstream) < 13:
+            # struct.error here would be a raw traceback on any truncated
+            # blob — corrupted streams must fail typed (ISSUE 3 fuzz gate)
+            raise ValueError(f"truncated DTPC stream: {len(bitstream)} "
+                             f"bytes < 13-byte header")
         if bitstream[:4] != MAGIC:
             raise ValueError("bad magic")
         version, mode_id, scale_bits, d, h, w = struct.unpack(
@@ -336,6 +341,11 @@ class BottleneckCodec:
         if scale_bits != self.scale_bits:
             raise ValueError(f"stream scale_bits {scale_bits} != codec "
                              f"{self.scale_bits}")
+        if d * h * w == 0 or d * h * w > (1 << 28):
+            # a corrupt header's dims would otherwise drive a giant
+            # allocation + hours of decode before anything notices
+            raise ValueError(f"implausible symbol volume ({d}, {h}, {w}) "
+                             f"in stream header")
         symbols = np.empty((d, h, w), dtype=np.int32)
         with rans.Decoder(bitstream[13:], scale_bits) as dec:
             if mode_id in (MODE_WAVEFRONT, MODE_WAVEFRONT_NP):

@@ -36,6 +36,10 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
 
+class _NativeLoadError(RuntimeError):
+    """Internal: one compile-or-bind attempt failed (retriable)."""
+
+
 def _compile_native() -> Optional[str]:
     try:
         os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -60,17 +64,32 @@ def _load_native() -> Optional[ctypes.CDLL]:
         if _lib_tried:
             return _lib
         _lib_tried = True
-        lib = _load_and_bind()
-        if lib is None and os.path.exists(_LIB_PATH):
-            # a stale prebuilt .so (restored cache / copied tree with newer
-            # mtimes) can pass the mtime check yet miss newer symbols —
-            # rebuild once from source before giving up on the native path
-            try:
-                os.remove(_LIB_PATH)
-            except OSError:
-                return None
+
+        def _attempt() -> ctypes.CDLL:
             lib = _load_and_bind()
-        _lib = lib
+            if lib is None:
+                raise _NativeLoadError("compile/dlopen/bind failed")
+            return lib
+
+        def _force_rebuild(attempt: int, exc: BaseException) -> None:
+            # a stale prebuilt .so (restored cache / copied tree with
+            # newer mtimes) can pass the mtime check yet miss newer
+            # symbols — drop it so _compile_native rebuilds from source
+            try:
+                if os.path.exists(_LIB_PATH):
+                    os.remove(_LIB_PATH)
+            except OSError:
+                pass
+
+        from dsin_tpu.utils.retry import RetryPolicy, call_with_retry
+        try:
+            # one forced rebuild + retry (shared policy), then give up:
+            # the pure-Python implementation takes over transparently
+            _lib = call_with_retry(
+                _attempt, RetryPolicy(max_attempts=2, base_delay_s=0.0),
+                retry_on=(_NativeLoadError,), on_retry=_force_rebuild)
+        except _NativeLoadError:
+            _lib = None
         return _lib
 
 
@@ -105,7 +124,8 @@ def _load_and_bind() -> Optional[ctypes.CDLL]:
         return lib
     except (OSError, AttributeError):
         # OSError: dlopen failure; AttributeError: the .so predates a
-        # symbol — caller may retry after a forced rebuild
+        # symbol — _load_native forces one rebuild and retries via
+        # utils/retry before falling back to the pure-Python path
         return None
 
 

@@ -57,12 +57,21 @@ def _latest_resumable(out_root: str, ae_config, ae_only: bool):
         for sub in ("", "periodic", "emergency"):
             cand = os.path.join(weights, d, sub) if sub else \
                 os.path.join(weights, d)
+            # a save SIGKILLed between its swap renames leaves only a
+            # rotated `.prev-*` sibling — still a resumable checkpoint
+            # (train/checkpoint.py latest_checkpoint)
+            name = os.path.join(d, sub) if sub else d
+            if not os.path.exists(os.path.join(cand, "meta.json")):
+                resolved = ckpt_lib.latest_checkpoint(cand)
+                if resolved is None:
+                    continue
+                cand, name = resolved, os.path.relpath(resolved, weights)
             try:
                 step = int(ckpt_lib.load_meta(cand)["step"])
             except (OSError, KeyError, ValueError, json.JSONDecodeError):
                 continue
             if step > best_step:
-                best_name = os.path.join(d, sub) if sub else d
+                best_name = name
                 best_step = step
     return best_name, best_step
 

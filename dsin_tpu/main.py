@@ -184,6 +184,12 @@ class Experiment:
         if not cfg.load_model:
             return
         load_dir = os.path.join(self.weights_root, cfg.load_model_name)
+        # a save killed between its swap renames leaves the live dir
+        # absent but a complete rotated `.prev-*` behind — resolve
+        # whichever complete checkpoint survives (train/checkpoint.py);
+        # keep the caller's path when the live dir itself is complete
+        if not os.path.exists(os.path.join(load_dir, "meta.json")):
+            load_dir = ckpt_lib.latest_checkpoint(load_dir) or load_dir
         self.state = ckpt_lib.restore_for_mode(load_dir, self.state, cfg)
         if cfg.load_train_step:
             # true resume of the same phase: seed best-val tracking from the
@@ -501,6 +507,12 @@ class Experiment:
         """
         best_dir, best_val, best_meta = None, float("inf"), None
         for cand in (self.ckpt_dir, *extra_candidates):
+            # resolve through the rotation history: a kill between swap
+            # renames leaves only `<cand>.prev-*` (train/checkpoint.py);
+            # keep the caller's path (identity matters for the
+            # already-live check below) when cand itself is complete
+            if not os.path.exists(os.path.join(cand, "meta.json")):
+                cand = ckpt_lib.latest_checkpoint(cand) or cand
             try:
                 meta = ckpt_lib.load_meta(cand)
                 val = float(meta["best_val"])

@@ -14,17 +14,18 @@ Driven by tools/serve_bench.py (open-loop load, SERVE_BENCH.json).
 
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, MicroBatcher,
                                     Request, ServeError, ServiceDraining,
-                                    ServiceOverloaded)
+                                    ServiceOverloaded, ServiceUnavailable)
 from dsin_tpu.serve.buckets import (BucketPolicy, NoBucketFits,
                                     crop_from_bucket, pad_to_bucket)
 from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
+from dsin_tpu.utils.integrity import IntegrityError
 
 __all__ = [
     "BucketPolicy", "CompressionService", "DeadlineExceeded",
-    "EncodeResult", "Future", "MetricsRegistry", "MetricsServer",
-    "MicroBatcher", "NoBucketFits", "Request", "ServeError",
-    "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
-    "crop_from_bucket", "pad_to_bucket",
+    "EncodeResult", "Future", "IntegrityError", "MetricsRegistry",
+    "MetricsServer", "MicroBatcher", "NoBucketFits", "Request",
+    "ServeError", "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
+    "ServiceUnavailable", "crop_from_bucket", "pad_to_bucket",
 ]

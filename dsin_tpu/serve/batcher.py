@@ -44,6 +44,12 @@ class ServiceDraining(ServeError):
     """Service is shutting down — it finishes in-flight work only."""
 
 
+class ServiceUnavailable(ServeError):
+    """No live workers — nothing would drain the queue, so accepting the
+    request could only park it until its deadline. Fail fast instead;
+    the supervisor is restarting the pool (serve/service.py)."""
+
+
 class DeadlineExceeded(ServeError):
     """Deadline passed while the request was still queued."""
 

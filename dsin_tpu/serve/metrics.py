@@ -166,7 +166,11 @@ class MetricsServer:
                 url = urlparse(self.path)
                 if url.path == "/healthz":
                     state = health_ref()
-                    code = 200 if state.get("status") == "ok" else 503
+                    # degraded (pool below configured but alive) still
+                    # serves — a load balancer should keep routing here;
+                    # unhealthy (zero workers) and draining must 503
+                    code = (200 if state.get("status") in ("ok", "degraded")
+                            else 503)
                     self._send(code, json.dumps(state), "application/json")
                 elif url.path == "/metrics":
                     if "format=json" in (url.query or ""):

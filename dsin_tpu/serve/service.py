@@ -21,12 +21,26 @@ entropy stage runs on the worker thread with the pure-numpy incremental
 engine (coding/incremental.py), which holds no jax state and therefore
 never contributes to the compile budget.
 
-Stream framing (little-endian), around the BottleneckCodec payload:
+Stream framing (little-endian, v2), around the BottleneckCodec payload:
     b"DSRV" | u8 version | u16 h | u16 w | u16 bh | u16 bw
-            | u32 payload_len | payload
+            | u32 payload_len | u32 crc32 | payload
 The original (h, w) drives the post-decode crop; the bucket (bh, bw) is
 recorded explicitly so a decode request routes to its executable without
 re-deriving policy (and fails loudly if the service lacks that bucket).
+The CRC covers every header field after the magic plus the payload
+(utils/integrity.py): a flipped bit anywhere in the frame raises a typed
+IntegrityError instead of rANS-decoding to a plausible garbage image.
+v1 frames (no CRC) remain readable.
+
+Fault tolerance (ISSUE 3): workers that die — a non-`Exception` escaping
+a batch, e.g. the fault harness's InjectedCrash or a KeyboardInterrupt —
+are restarted by a supervisor thread with capped exponential backoff
+(utils/retry.py). `/healthz` degrades honestly (`degraded` below the
+configured pool size, `unhealthy` + 503 at zero), and submits against an
+empty pool fail fast with ServiceUnavailable instead of queueing work
+nobody will drain. Injection sites: `serve.worker.batch` (batch
+processing) and `serve.rans` (decode payload bytes) — no-ops unless a
+fault plan is installed (utils/faults.py).
 """
 
 from __future__ import annotations
@@ -44,12 +58,15 @@ import numpy as np
 from dsin_tpu.serve import buckets as buckets_lib
 from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve.batcher import (Future, MicroBatcher, Request,
-                                    ServiceDraining)
-from dsin_tpu.utils import recompile
+                                    ServiceDraining, ServiceUnavailable)
+from dsin_tpu.utils import faults, recompile
+from dsin_tpu.utils.integrity import IntegrityError, frame_crc, verify_crc
+from dsin_tpu.utils.retry import RetryPolicy
 
 SERVE_MAGIC = b"DSRV"
-SERVE_VERSION = 1
-_FRAME_LEN = 17   # magic(4) + B(1) + 4*H(8) + I(4)
+SERVE_VERSION = 2   # v2: + CRC32 over header fields + payload
+_FRAME_LEN_V1 = 17  # magic(4) + B(1) + 4*H(8) + I(4)
+_FRAME_LEN = 21     # v2: + I(4) CRC
 
 ENCODE = "encode"
 DECODE = "decode"
@@ -68,6 +85,12 @@ class ServiceConfig:
     workers: int = 1
     #: None = no HTTP endpoint; 0 = ephemeral port (tests)
     metrics_port: Optional[int] = None
+    #: supervisor restart backoff: base and cap of the exponential curve
+    #: (utils/retry.py RetryPolicy; delay doubles per consecutive restart)
+    restart_backoff_s: float = 0.05
+    restart_backoff_max_s: float = 2.0
+    #: how often the supervisor checks the pool for dead workers
+    supervise_every_s: float = 0.05
 
 
 @dataclass
@@ -83,23 +106,40 @@ def frame_stream(payload: bytes, shape: Tuple[int, int],
                  bucket: Tuple[int, int]) -> bytes:
     h, w = shape
     bh, bw = bucket
-    return (SERVE_MAGIC
-            + struct.pack("<BHHHHI", SERVE_VERSION, h, w, bh, bw,
-                          len(payload))
-            + payload)
+    head = struct.pack("<BHHHHI", SERVE_VERSION, h, w, bh, bw, len(payload))
+    crc = frame_crc(head, payload)
+    return SERVE_MAGIC + head + struct.pack("<I", crc) + payload
 
 
 def parse_stream(blob: bytes):
-    """-> (payload, (h, w), (bh, bw)); raises ValueError on a bad frame."""
-    if len(blob) < _FRAME_LEN or blob[:4] != SERVE_MAGIC:
+    """-> (payload, (h, w), (bh, bw)); every corruption mode is a typed
+    error — ValueError for structural damage, IntegrityError (a
+    ValueError subclass) for a v2 CRC mismatch. v1 frames predate the
+    CRC and parse without one."""
+    if len(blob) < _FRAME_LEN_V1 or blob[:4] != SERVE_MAGIC:
         raise ValueError("not a DSRV stream")
-    version, h, w, bh, bw, n = struct.unpack("<BHHHHI", blob[4:_FRAME_LEN])
-    if version != SERVE_VERSION:
+    version = blob[4]
+    if version == 1:
+        version, h, w, bh, bw, n = struct.unpack(
+            "<BHHHHI", blob[4:_FRAME_LEN_V1])
+        payload = blob[_FRAME_LEN_V1:_FRAME_LEN_V1 + n]
+        crc = None
+    elif version == SERVE_VERSION:
+        if len(blob) < _FRAME_LEN:
+            raise ValueError(f"truncated DSRV v2 header: {len(blob)} of "
+                             f"{_FRAME_LEN} bytes")
+        version, h, w, bh, bw, n, crc = struct.unpack(
+            "<BHHHHII", blob[4:_FRAME_LEN])
+        payload = blob[_FRAME_LEN:_FRAME_LEN + n]
+    else:
         raise ValueError(f"unsupported DSRV version {version}")
-    payload = blob[_FRAME_LEN:_FRAME_LEN + n]
     if len(payload) != n:
         raise ValueError(f"truncated stream: payload {len(payload)} of "
                          f"{n} bytes")
+    if crc is not None:
+        verify_crc(crc, "DSRV stream",
+                   struct.pack("<BHHHHI", version, h, w, bh, bw, n),
+                   payload)
     if h > bh or w > bw:
         raise ValueError(f"corrupt frame: image ({h}, {w}) exceeds its "
                          f"own bucket ({bh}, {bw})")
@@ -142,6 +182,16 @@ class CompressionService:
             on_expired=lambda n: self.metrics.counter(
                 "serve_rejected_deadline").inc(n))
         self._workers = []
+        self._workers_lock = threading.Lock()
+        self._worker_exits = {}            # slot -> last fatal BaseException
+        self._restarts = []                # slot -> consecutive restarts
+        self._restart_at = []              # slot -> monotonic restart time
+        self._restart_policy = RetryPolicy(
+            max_attempts=1 << 30,          # supervise forever; cap is on
+            base_delay_s=config.restart_backoff_s,   # the DELAY, not the
+            max_delay_s=config.restart_backoff_max_s,  # attempt count
+            backoff=2.0)
+        self._supervisor: Optional[threading.Thread] = None
         self._closer: Optional[threading.Thread] = None
         self._started = False
         self._draining = threading.Event()
@@ -168,11 +218,16 @@ class CompressionService:
         self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
         self._bn_channels = int(self.model.ae_config.num_chan_bn)
         recompile.install()
-        for i in range(self.config.workers):
-            t = threading.Thread(target=self._worker_loop,
-                                 name=f"serve-worker-{i}", daemon=True)
-            t.start()
-            self._workers.append(t)
+        with self._workers_lock:
+            for i in range(self.config.workers):
+                self._workers.append(self._spawn_worker(i))
+                self._restarts.append(0)
+                self._restart_at.append(None)
+        self.metrics.gauge("serve_workers_live").set(self.config.workers)
+        self._supervisor = threading.Thread(target=self._supervise_loop,
+                                            name="serve-supervisor",
+                                            daemon=True)
+        self._supervisor.start()
         if self.config.metrics_port is not None:
             self._metrics_server = metrics_lib.MetricsServer(
                 self.metrics, self.health,
@@ -232,9 +287,15 @@ class CompressionService:
     def wait_drained(self, timeout: Optional[float] = 30.0) -> bool:
         if self._closer is not None:
             self._closer.join(timeout)
-        for t in self._workers:
+        if self._supervisor is not None:
+            # the supervisor exits once draining is set; join it first so
+            # no restart races the worker joins below
+            self._supervisor.join(timeout)
+        with self._workers_lock:
+            workers = list(self._workers)
+        for t in workers:
             t.join(timeout)
-        alive = any(t.is_alive() for t in self._workers)
+        alive = any(t.is_alive() for t in workers)
         if not alive:
             self._drained.set()
             if self._metrics_server is not None:
@@ -260,10 +321,29 @@ class CompressionService:
 
     # -- request intake -----------------------------------------------------
 
+    @property
+    def live_workers(self) -> int:
+        with self._workers_lock:
+            return sum(t.is_alive() for t in self._workers)
+
     def health(self) -> dict:
-        return {"status": "draining" if self.draining else "ok",
+        live = self.live_workers
+        configured = self.config.workers if self._started else 0
+        if self.draining:
+            status = "draining"
+        elif live == 0:
+            status = "unhealthy"       # /healthz answers 503
+        elif live < configured:
+            status = "degraded"        # still serving; pool being healed
+        else:
+            status = "ok"
+        return {"status": status,
                 "queue_depth": self._batcher.depth,
-                "buckets": [list(b) for b in self.policy.buckets]}
+                "buckets": [list(b) for b in self.policy.buckets],
+                "workers_live": live,
+                "workers_configured": configured,
+                "worker_restarts":
+                    self.metrics.counter("serve_worker_restarts").value}
 
     def _deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
         return (None if deadline_ms is None
@@ -277,6 +357,13 @@ class CompressionService:
             self.metrics.counter("serve_rejected_drain").inc()
             raise ServiceDraining("service is draining; not accepting "
                                   "new requests")
+        if self._started and self.live_workers == 0:
+            # zero live workers: nothing would drain the queue, so the
+            # request could only hang until its deadline — fail fast and
+            # let the client retry elsewhere while the supervisor heals
+            self.metrics.counter("serve_rejected_unavailable").inc()
+            raise ServiceUnavailable(
+                "no live workers (pool is restarting); retry shortly")
         try:
             self._batcher.submit(request)
         except ServiceDraining:
@@ -308,15 +395,20 @@ class CompressionService:
 
     def submit_decode(self, blob: bytes,
                       deadline_ms: Optional[float] = None) -> Future:
-        """Framed DSRV stream -> Future[(h, w, 3) uint8 image]."""
+        """Framed DSRV stream -> Future[(h, w, 3) uint8 image]. A v2
+        frame failing its CRC raises IntegrityError here, at the door."""
         payload, shape, bucket = parse_stream(blob)
         if bucket not in self.policy.buckets:
             raise buckets_lib.NoBucketFits(
                 f"stream was encoded for bucket {bucket}, which this "
                 f"service does not serve (buckets: "
                 f"{list(self.policy.buckets)})")
+        # the payload's own CRC rides along so the worker re-verifies
+        # right before the entropy decode — catches corruption that
+        # happens AFTER admission (the serve.rans fault site's scenario)
         return self._submit(Request(
-            key=(DECODE, bucket), payload=(payload, shape),
+            key=(DECODE, bucket), payload=(payload, shape,
+                                           frame_crc(payload)),
             deadline=self._deadline(deadline_ms)))
 
     def encode(self, img: np.ndarray, deadline_ms: Optional[float] = None,
@@ -328,6 +420,22 @@ class CompressionService:
         return self.submit_decode(blob, deadline_ms).result(timeout)
 
     # -- worker side --------------------------------------------------------
+
+    def _spawn_worker(self, slot: int) -> threading.Thread:
+        t = threading.Thread(target=self._worker_main, args=(slot,),
+                             name=f"serve-worker-{slot}", daemon=True)
+        t.start()
+        return t
+
+    def _worker_main(self, slot: int) -> None:
+        """Thread target: run the loop; record a fatal exit for the
+        supervisor instead of spewing the default thread traceback."""
+        try:
+            self._worker_loop()
+        except BaseException as e:  # noqa: BLE001 — supervisor's evidence
+            with self._workers_lock:
+                self._worker_exits[slot] = e
+            self.metrics.counter("serve_worker_crashes").inc()
 
     def _worker_loop(self) -> None:
         while True:
@@ -342,8 +450,44 @@ class CompressionService:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
+                if not isinstance(e, Exception):
+                    # KeyboardInterrupt / InjectedCrash-class conditions
+                    # must kill this thread so the supervisor sees the
+                    # death — swallowing them here left the pool silently
+                    # shrunk (ISSUE 3 satellite)
+                    raise
+
+    # -- supervision --------------------------------------------------------
+
+    def _supervise_loop(self) -> None:
+        """Restart dead workers with capped exponential backoff. Exits
+        when the drain flag flips (dead workers stay dead during drain —
+        the queue close is what completes outstanding work then)."""
+        while not self._draining.is_set():
+            now = time.monotonic()
+            live = 0
+            with self._workers_lock:
+                for i, t in enumerate(self._workers):
+                    if t.is_alive():
+                        live += 1
+                        continue
+                    if self._restart_at[i] is None:
+                        # first observation of this death: schedule the
+                        # restart after the slot's current backoff
+                        self._restart_at[i] = now + self._restart_policy \
+                            .delay(self._restarts[i])
+                    elif now >= self._restart_at[i]:
+                        self._restarts[i] += 1
+                        self._restart_at[i] = None
+                        self._workers[i] = self._spawn_worker(i)
+                        self.metrics.counter("serve_worker_restarts").inc()
+                        live += 1
+            self.metrics.gauge("serve_workers_live").set(live)
+            self._draining.wait(self.config.supervise_every_s)
+        self.metrics.gauge("serve_workers_live").set(self.live_workers)
 
     def _process_batch(self, batch) -> None:
+        faults.inject("serve.worker.batch")
         if self._batch_hook is not None:
             self._batch_hook(batch)
         kind, bucket = batch[0].key
@@ -390,10 +534,18 @@ class CompressionService:
         per_item_exc = {}
         for i, r in enumerate(batch):
             try:
-                vol = self.codec.decode(r.payload[0])   # (C, bh/8, bw/8)
+                data = faults.corrupt("serve.rans", r.payload[0])
+                # re-verify right before the entropy decode: corruption
+                # past the door (buffer damage, injected faults) must
+                # raise typed, never decode to a plausible wrong image.
+                # IntegrityError lands on this request's future only.
+                verify_crc(r.payload[2], "DSRV payload (worker)", data)
+                vol = self.codec.decode(data)           # (C, bh/8, bw/8)
                 sym[i] = np.transpose(vol, (1, 2, 0))
             except Exception as e:  # noqa: BLE001 — isolate bad streams
                 per_item_exc[i] = e
+                if isinstance(e, IntegrityError):
+                    self.metrics.counter("serve_integrity_errors").inc()
         imgs = np.asarray(self._decode_fn(
             self.state.params, self.state.batch_stats, jnp.asarray(sym)))
         for i, r in enumerate(batch):

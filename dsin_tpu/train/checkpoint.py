@@ -18,31 +18,80 @@ the reference's text files. Directory layout:
       params_centers.msgpack     params_probclass.msgpack
       params_sinet.msgpack       batch_stats.msgpack
       opt_state.msgpack          meta.json
+
+Durability (ISSUE 3): `save_checkpoint` never touches the live directory.
+Everything is staged into a fsynced `<dir>.tmp-<pid>` sibling, the live
+dir is rotated aside to `<dir>.prev-NNNNNN`, and the staged dir takes its
+place — both steps are single atomic renames, so a kill at ANY point
+leaves either the old or the new checkpoint complete (and
+`latest_checkpoint` resolves whichever survives). Transient OSErrors on
+the staging writes retry with the shared bounded policy (utils/retry.py);
+`keep_last` bounds the rotated history. Fault-injection sites
+`ckpt.write` (every staged file write) and `ckpt.swap` (the window
+between the two renames) let the chaos tests kill a save at every
+crash point (utils/faults.py).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Iterable, Optional
+import shutil
+from typing import Any, Dict, Iterable, List, Optional
 
 import flax.serialization
 import jax
 import numpy as np
 
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.retry import RetryPolicy, call_with_retry
+
 AE_PARTITIONS = ("encoder", "decoder", "centers", "probclass")
+
+#: bounded retry for transient write failures (EIO on flaky NFS, EAGAIN);
+#: persistent failures still propagate after the third attempt
+WRITE_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                          max_delay_s=0.5)
 
 
 def _to_host(tree):
     return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
 
 
+def _fsync_dir(path: str) -> None:
+    """Flush a directory's entry table; best-effort where dirs can't be
+    opened (non-POSIX filesystems)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_bytes_durable(path: str, data: bytes) -> None:
+    """write + flush + fsync, with bounded retry on transient OSError.
+    Each attempt revisits the `ckpt.write` fault site."""
+
+    def _attempt():
+        faults.inject("ckpt.write")
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    call_with_retry(_attempt, WRITE_RETRY, retry_on=(OSError,))
+
+
 def _write_msgpack(path: str, tree) -> None:
     # to_state_dict first: opt_state holds optax NamedTuple/dataclass nodes
     # (e.g. multi_transform's PartitionState) that msgpack can't serialize raw
     state = flax.serialization.to_state_dict(_to_host(tree))
-    with open(path, "wb") as f:
-        f.write(flax.serialization.msgpack_serialize(state))
+    _write_bytes_durable(path, flax.serialization.msgpack_serialize(state))
 
 
 def _read_msgpack(path: str):
@@ -55,25 +104,54 @@ def _restore_like(template, loaded):
     return flax.serialization.from_state_dict(template, loaded)
 
 
-def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
-                    extra_meta: Optional[Dict[str, Any]] = None) -> None:
-    """Save a TrainState (params/batch_stats/opt_state/step) partitioned.
+def _prev_dirs(parent: str, name: str) -> List[str]:
+    """Rotated `<name>.prev-NNNNNN` siblings, oldest first."""
+    prefix = f"{name}.prev-"
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return []
+    return sorted(os.path.join(parent, e) for e in entries
+                  if e.startswith(prefix))
 
-    Overwrite ordering makes a torn write non-discoverable instead of
-    silently corrupt: meta.json is removed FIRST and rewritten LAST, so a
-    kill mid-overwrite (e.g. the relay watcher's kill-after escalation)
-    leaves a dir without meta — which `load_meta`-driven discovery
-    (resume, `_latest_resumable`) skips — never a dir whose old meta
-    points at half-written msgpacks."""
-    os.makedirs(ckpt_dir, exist_ok=True)
-    meta_path = os.path.join(ckpt_dir, "meta.json")
-    if os.path.exists(meta_path):
-        os.remove(meta_path)
+
+def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
+                    extra_meta: Optional[Dict[str, Any]] = None,
+                    keep_last: int = 1) -> None:
+    """Save a TrainState (params/batch_stats/opt_state/step) partitioned,
+    durably: the live dir is replaced only by a complete, fsynced copy.
+
+    The v0 scheme overwrote the live dir in place (meta removed first,
+    rewritten last) — a torn write was non-DISCOVERABLE, but a kill
+    mid-save still destroyed the only resumable state of a long run.
+    Now every kill point keeps a complete checkpoint on disk:
+
+      kill during staging   -> live dir untouched (the stale tmp sibling
+                               is swept by the next save);
+      kill between renames  -> live dir briefly absent, but the newest
+                               `<dir>.prev-*` is complete —
+                               `latest_checkpoint` resolves it;
+      kill after the swap   -> new live dir complete.
+
+    `keep_last` bounds how many rotated `.prev-*` dirs survive.
+    Concurrent saves into one `ckpt_dir` are not supported (they never
+    were); distinct dirs are independent."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    parent, name = os.path.split(ckpt_dir)
+    os.makedirs(parent or ".", exist_ok=True)
+    # sweep stale tmp dirs from earlier killed saves (any pid — a live
+    # concurrent saver to the same dir is unsupported, see docstring)
+    for entry in os.listdir(parent):
+        if entry.startswith(f"{name}.tmp-"):
+            shutil.rmtree(os.path.join(parent, entry), ignore_errors=True)
+
+    tmp = os.path.join(parent, f"{name}.tmp-{os.getpid()}")
+    os.makedirs(tmp)
     for part, sub in state.params.items():
-        _write_msgpack(os.path.join(ckpt_dir, f"params_{part}.msgpack"), sub)
-    _write_msgpack(os.path.join(ckpt_dir, "batch_stats.msgpack"),
+        _write_msgpack(os.path.join(tmp, f"params_{part}.msgpack"), sub)
+    _write_msgpack(os.path.join(tmp, "batch_stats.msgpack"),
                    state.batch_stats)
-    _write_msgpack(os.path.join(ckpt_dir, "opt_state.msgpack"),
+    _write_msgpack(os.path.join(tmp, "opt_state.msgpack"),
                    state.opt_state)
     meta = {"step": int(state.step),
             "partitions": sorted(state.params.keys())}
@@ -81,8 +159,38 @@ def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
         meta["best_val"] = float(best_val)
     if extra_meta:
         meta.update(extra_meta)
-    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    _write_bytes_durable(os.path.join(tmp, "meta.json"),
+                         json.dumps(meta, indent=2).encode())
+    _fsync_dir(tmp)
+
+    if os.path.isdir(ckpt_dir):
+        prevs = _prev_dirs(parent, name)
+        next_idx = (int(os.path.basename(prevs[-1]).rsplit("-", 1)[1]) + 1
+                    if prevs else 1)
+        os.rename(ckpt_dir, os.path.join(parent,
+                                         f"{name}.prev-{next_idx:06d}"))
+        faults.inject("ckpt.swap")    # the kill window between renames
+    os.rename(tmp, ckpt_dir)
+    _fsync_dir(parent)
+
+    for old in _prev_dirs(parent, name)[:-keep_last if keep_last else None]:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Resolve the most recent COMPLETE checkpoint for `ckpt_dir`: the
+    dir itself when its meta.json exists, else the newest rotated
+    `<dir>.prev-*` that has one (the kill-between-renames window), else
+    None. Completeness == meta.json present: the staged-swap protocol
+    guarantees a dir with meta has every msgpack it names."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    if os.path.exists(os.path.join(ckpt_dir, "meta.json")):
+        return ckpt_dir
+    parent, name = os.path.split(ckpt_dir)
+    for prev in reversed(_prev_dirs(parent, name)):
+        if os.path.exists(os.path.join(prev, "meta.json")):
+            return prev
+    return None
 
 
 def load_meta(ckpt_dir: str) -> Dict[str, Any]:

@@ -1,0 +1,45 @@
+"""Stream integrity: CRC32 framing checks + the typed error they raise.
+
+The DSIN context-model coupling makes payload corruption uniquely
+silent: a flipped bit in the rANS stream desynchronizes the decoder's
+PMFs from the encoder's, and every symbol after the flip decodes to a
+*plausible* wrong value — the output is a clean-looking garbage image,
+not a crash. The rANS layer cannot detect this (any byte string is a
+syntactically valid rANS stream), so integrity must live in the framing:
+DSIM v3 (coding/cli.py) and DSRV v2 (serve/service.py) carry a CRC32
+over header fields + payload, verified before any entropy decode.
+
+`IntegrityError` subclasses ValueError so every existing "bad stream"
+handler (the CLI's one-line exit 2, the serve worker's per-request
+isolation) already routes it correctly, while callers that care can
+still catch the distinct type.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+class IntegrityError(ValueError):
+    """A stream failed its CRC: corrupted in transit or on disk. The
+    payload must not be entropy-decoded (it would yield a plausible but
+    wrong reconstruction, silently)."""
+
+
+def frame_crc(*chunks: bytes) -> int:
+    """CRC32 over the concatenation of `chunks` (header fields then
+    payload; the CRC field itself is never included)."""
+    crc = 0
+    for chunk in chunks:
+        crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def verify_crc(expected: int, what: str, *chunks: bytes) -> None:
+    """Raise IntegrityError unless `frame_crc(*chunks) == expected`."""
+    got = frame_crc(*chunks)
+    if got != expected:
+        raise IntegrityError(
+            f"{what}: CRC mismatch (stored 0x{expected:08x}, computed "
+            f"0x{got:08x}) — the stream is corrupted; refusing to decode "
+            f"it into a plausible wrong image")

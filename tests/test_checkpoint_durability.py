@@ -1,0 +1,211 @@
+"""Durable checkpoint saves under injected kills (ISSUE 3).
+
+The invariant: a save killed at ANY point leaves a complete restorable
+checkpoint on disk — the fault sites `ckpt.write` (every staged file
+write) and `ckpt.swap` (between the two renames) cover every crash
+window the staged-swap protocol has. Restores go through the EXISTING
+`restore_partitions` API unchanged.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.train import checkpoint as ckpt_lib
+from dsin_tpu.train import optim as optim_lib
+from dsin_tpu.train.step import TrainState
+from dsin_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _params(seed=0):
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 5)
+    return {
+        "encoder": {"conv": {"kernel": jax.random.normal(ks[0], (3,))}},
+        "decoder": {"conv": {"kernel": jax.random.normal(ks[1], (3,))}},
+        "centers": jax.random.normal(ks[2], (6,)),
+        "probclass": {"conv": {"kernel": jax.random.normal(ks[3], (3,))}},
+        "sinet": {"conv": {"kernel": jax.random.normal(ks[4], (3,))}},
+    }
+
+
+def _cfgs():
+    ae = parse_config(
+        """
+        batch_size = 1
+        num_crops_per_img = 1
+        AE_only = False
+        optimizer = 'ADAM'
+        lr_initial = 0.1
+        lr_schedule = 'FIXED'
+        train_autoencoder = True
+        train_probclass = True
+        lr_centers_factor = None
+        load_train_step = False
+        train_model = True
+        test_model = False
+        """)
+    pc = parse_config(
+        "optimizer = 'ADAM'\nlr_initial = 0.001\nlr_schedule = 'FIXED'\n")
+    return ae, pc
+
+
+def _make_state(step=7, seed=0):
+    ae, pc = _cfgs()
+    params = _params(seed)
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    return TrainState(params=params,
+                      batch_stats={"encoder": {}, "decoder": {}},
+                      opt_state=tx.init(params),
+                      step=jnp.asarray(step, jnp.int32)), tx
+
+
+def _assert_restorable(ckpt_dir, template_state, want_params, want_step):
+    restored = ckpt_lib.restore_partitions(
+        ckpt_dir, template_state,
+        list(ckpt_lib.AE_PARTITIONS) + ["sinet"], load_opt_state=True)
+    for a, b in zip(jax.tree_util.tree_leaves(want_params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == want_step
+
+
+def test_save_rotates_previous_and_keep_last_bounds_history(tmp_path):
+    d = str(tmp_path / "ckpt")
+    for step in (1, 2, 3, 4):
+        state, _ = _make_state(step=step)
+        ckpt_lib.save_checkpoint(d, state, keep_last=2)
+    assert ckpt_lib.load_meta(d)["step"] == 4
+    prevs = ckpt_lib._prev_dirs(str(tmp_path), "ckpt")
+    assert len(prevs) == 2                     # keep_last bounds rotation
+    # the newest prev is the step-3 save, complete and loadable
+    assert ckpt_lib.load_meta(prevs[-1])["step"] == 3
+    # no stale tmp dirs survive a clean save
+    assert not [e for e in os.listdir(tmp_path)
+                if e.startswith("ckpt.tmp-")]
+
+
+def test_kill_during_staging_leaves_live_checkpoint_untouched(tmp_path):
+    """Crash injected at EVERY ckpt.write visit index in turn: whichever
+    staged write dies, the live checkpoint must stay bit-exact
+    restorable (the old torn-write design corrupted it in place)."""
+    d = str(tmp_path / "ckpt")
+    state, tx = _make_state(step=7)
+    ckpt_lib.save_checkpoint(d, state, best_val=1.5)
+    fresh = TrainState(params=_params(seed=9),
+                       batch_stats={"encoder": {}, "decoder": {}},
+                       opt_state=tx.init(_params(seed=9)),
+                       step=jnp.asarray(0, jnp.int32))
+    state2, _ = _make_state(step=8, seed=1)
+    # 8 staged writes per save: 5 params + batch_stats + opt_state + meta
+    for visit in range(8):
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="ckpt.write", after=visit, times=None)], seed=0)
+        with faults.installed(plan):
+            with pytest.raises(faults.InjectedFault):
+                ckpt_lib.save_checkpoint(d, state2)
+        assert plan.activations["ckpt.write"] >= 1
+        _assert_restorable(d, fresh, state.params, want_step=7)
+        assert ckpt_lib.load_meta(d)["best_val"] == 1.5
+        assert ckpt_lib.latest_checkpoint(d) == os.path.abspath(d)
+    # and with the plan gone, the same save goes through cleanly
+    ckpt_lib.save_checkpoint(d, state2)
+    _assert_restorable(d, fresh, state2.params, want_step=8)
+
+
+def test_kill_between_swap_renames_previous_still_restorable(tmp_path):
+    """The narrowest window: the live dir was renamed aside but the
+    staged dir not yet renamed in. latest_checkpoint must resolve the
+    rotated prev, and restore_partitions must load it unchanged."""
+    d = str(tmp_path / "ckpt")
+    state, tx = _make_state(step=7)
+    ckpt_lib.save_checkpoint(d, state)
+    state2, _ = _make_state(step=8, seed=1)
+    plan = faults.FaultPlan([faults.FaultSpec(site="ckpt.swap")], seed=0)
+    with faults.installed(plan):
+        with pytest.raises(faults.InjectedFault):
+            ckpt_lib.save_checkpoint(d, state2)
+    assert plan.activations["ckpt.swap"] == 1
+    assert not os.path.exists(os.path.join(d, "meta.json"))
+    recovered = ckpt_lib.latest_checkpoint(d)
+    assert recovered is not None and ".prev-" in recovered
+    fresh = TrainState(params=_params(seed=9),
+                       batch_stats={"encoder": {}, "decoder": {}},
+                       opt_state=tx.init(_params(seed=9)),
+                       step=jnp.asarray(0, jnp.int32))
+    _assert_restorable(recovered, fresh, state.params, want_step=7)
+    # the interrupted save's stale tmp is swept by the next save, which
+    # completes and becomes the live dir again
+    ckpt_lib.save_checkpoint(d, state2)
+    assert ckpt_lib.latest_checkpoint(d) == os.path.abspath(d)
+    _assert_restorable(d, fresh, state2.params, want_step=8)
+    assert not [e for e in os.listdir(tmp_path)
+                if e.startswith("ckpt.tmp-")]
+
+
+def test_transient_oserror_is_retried_to_success(tmp_path):
+    """Two injected transient OSErrors on one write ride the bounded
+    retry (utils/retry.py, 3 attempts) to a successful save."""
+    d = str(tmp_path / "ckpt")
+    state, _ = _make_state(step=3)
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="ckpt.write", times=2, exc=lambda: OSError("EIO"))], seed=0)
+    with faults.installed(plan):
+        ckpt_lib.save_checkpoint(d, state)
+    assert plan.activations["ckpt.write"] == 2
+    assert ckpt_lib.load_meta(d)["step"] == 3
+
+
+def test_persistent_oserror_propagates_and_live_dir_survives(tmp_path):
+    d = str(tmp_path / "ckpt")
+    state, _ = _make_state(step=7)
+    ckpt_lib.save_checkpoint(d, state)
+    state2, _ = _make_state(step=8, seed=1)
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="ckpt.write", exc=lambda: OSError("dead disk"))], seed=0)
+    with faults.installed(plan):
+        with pytest.raises(OSError, match="dead disk"):
+            ckpt_lib.save_checkpoint(d, state2)
+    assert plan.activations["ckpt.write"] == 3    # bounded: 3 attempts
+    assert ckpt_lib.load_meta(d)["step"] == 7     # live dir untouched
+
+
+def test_latest_checkpoint_none_when_nothing_exists(tmp_path):
+    assert ckpt_lib.latest_checkpoint(str(tmp_path / "nope")) is None
+
+
+def test_resume_discovery_finds_rotated_prev_after_swap_kill(tmp_path):
+    """The recovery path must be WIRED, not just available: synthetic_rd
+    resume discovery (`_latest_resumable`) must surface a checkpoint
+    that survives only as `.prev-*` after a kill between swap renames."""
+    from dsin_tpu.eval.synthetic_rd import _latest_resumable
+    ae, _ = _cfgs()
+    ae = ae.replace(H_target=0.04, num_chan_bn=32, AE_only=True)
+    name = ckpt_lib.model_name_for(ae, "ts")
+    d = str(tmp_path / "weights" / name)
+    state, tx = _make_state(step=7)
+    ckpt_lib.save_checkpoint(d, state)
+    # simulate the kill window: live dir rotated aside, staged dir lost
+    os.rename(d, d + ".prev-000001")
+    found, step = _latest_resumable(str(tmp_path), ae, ae_only=True)
+    assert found == f"{name}.prev-000001" and step == 7
+    # restore through the normal weights-root join, API unchanged
+    fresh = TrainState(params=_params(seed=9),
+                       batch_stats={"encoder": {}, "decoder": {}},
+                       opt_state=tx.init(_params(seed=9)),
+                       step=jnp.asarray(0, jnp.int32))
+    _assert_restorable(os.path.join(str(tmp_path), "weights", found),
+                       fresh, state.params, want_step=7)

@@ -1,0 +1,151 @@
+"""utils/faults.py: seeded deterministic fault plans over named sites.
+
+The harness itself must be trustworthy before anything built on it is:
+no-plan visits must be free of side effects, decisions must replay
+exactly from a seed, and every action (raise/crash/delay/corrupt) must
+do precisely what the chaos tests assume it does.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dsin_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """A leaked global plan would silently fault OTHER tests' site
+    visits — guarantee isolation both ways."""
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def test_no_plan_is_a_noop():
+    faults.inject("serve.worker.batch")          # must not raise
+    assert faults.corrupt("serve.rans", b"abc") == b"abc"
+    assert faults.active() is None
+
+
+def test_raise_action_fires_deterministically_from_seed():
+    def run(seed):
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="x", action="raise", probability=0.5, times=3)], seed=seed)
+        out = []
+        with faults.installed(plan):
+            for _ in range(12):
+                try:
+                    faults.inject("x")
+                    out.append(0)
+                except faults.InjectedFault:
+                    out.append(1)
+        return out, plan
+
+    a, plan_a = run(7)
+    b, _ = run(7)
+    c, _ = run(8)
+    assert a == b                     # same seed -> same firing sequence
+    assert sum(a) == 3                # `times` caps activations
+    assert a != c or sum(c) == 3      # different seed may differ
+    assert plan_a.visits["x"] == 12
+    assert plan_a.activations["x"] == 3
+    assert [act.site for act in plan_a.log] == ["x"] * 3
+
+
+def test_after_skips_early_visits():
+    plan = faults.FaultPlan([faults.FaultSpec(site="x", after=3)], seed=0)
+    with faults.installed(plan):
+        for _ in range(3):
+            faults.inject("x")        # visits 1..3: spec dormant
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("x")        # visit 4 fires
+
+
+def test_crash_action_is_not_an_exception():
+    """InjectedCrash must escape `except Exception` recovery blocks —
+    that is the whole point of the crash action (it models the
+    conditions only the supervisor may handle)."""
+    assert not issubclass(faults.InjectedCrash, Exception)
+    plan = faults.FaultPlan([faults.FaultSpec(site="x", action="crash")],
+                            seed=0)
+    with faults.installed(plan):
+        with pytest.raises(faults.InjectedCrash):
+            try:
+                faults.inject("x")
+            except Exception:  # noqa: BLE001 — the assertion under test
+                pytest.fail("InjectedCrash was swallowed by "
+                            "`except Exception`")
+
+
+def test_delay_action_sleeps_then_continues():
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="x", action="delay", delay_s=0.05, times=1)], seed=0)
+    with faults.installed(plan):
+        t0 = time.monotonic()
+        faults.inject("x")
+        assert time.monotonic() - t0 >= 0.045
+        t1 = time.monotonic()
+        faults.inject("x")            # times exhausted: no delay
+        assert time.monotonic() - t1 < 0.04
+
+
+def test_corrupt_flips_exactly_the_requested_bits():
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="c", action="corrupt", flips=1)], seed=3)
+    data = bytes(range(64))
+    with faults.installed(plan):
+        out = faults.corrupt("c", data)
+    assert len(out) == len(data)
+    diff = [(a ^ b) for a, b in zip(data, out)]
+    changed = [d for d in diff if d]
+    assert len(changed) == 1 and bin(changed[0]).count("1") == 1
+
+
+def test_corrupt_specs_do_not_act_through_inject():
+    """A corrupt spec needs bytes to act on; a bare inject() visit at
+    the same site must pass through untouched (and not raise)."""
+    plan = faults.FaultPlan([faults.FaultSpec(site="c", action="corrupt")],
+                            seed=0)
+    with faults.installed(plan):
+        faults.inject("c")            # no bytes -> no-op, no crash
+
+
+def test_installed_restores_previous_plan():
+    outer = faults.install(faults.FaultPlan([], seed=0))
+    inner = faults.FaultPlan([], seed=1)
+    with faults.installed(inner):
+        assert faults.active() is inner
+    assert faults.active() is outer
+
+
+def test_thread_safety_under_concurrent_visits():
+    """Counters must stay exact with many threads hammering one site
+    (the serve worker pool's usage pattern)."""
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="x", action="raise", probability=0.5, times=50)], seed=0)
+    fired = []
+
+    def worker():
+        for _ in range(100):
+            try:
+                faults.inject("x")
+            except faults.InjectedFault:
+                fired.append(1)
+
+    with faults.installed(plan):
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert plan.visits["x"] == 400
+    assert plan.activations["x"] == len(fired) == 50
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        faults.FaultSpec(site="x", action="explode")
+    with pytest.raises(ValueError):
+        faults.FaultSpec(site="x", probability=1.5)

@@ -1,0 +1,97 @@
+"""utils/retry.py: the one backoff policy every recovery path shares
+(serve supervisor restarts, durable checkpoint writes, rANS rebuild)."""
+
+import pytest
+
+from dsin_tpu.utils.retry import RetryPolicy, call_with_retry
+
+
+def test_succeeds_after_transient_failures_with_backoff_curve():
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.1, max_delay_s=10.0,
+                         backoff=2.0)
+    assert call_with_retry(flaky, policy, retry_on=(OSError,),
+                           sleep=slept.append) == "ok"
+    assert len(calls) == 3
+    assert slept == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_final_failure_propagates_unmasked():
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        call_with_retry(always, policy, retry_on=(OSError,),
+                        sleep=lambda s: None)
+    assert len(calls) == 3     # max_attempts counts tries, not retries
+
+
+def test_non_matching_exception_is_not_retried():
+    calls = []
+
+    def wrong_kind():
+        calls.append(1)
+        raise KeyError("not retriable")
+
+    with pytest.raises(KeyError):
+        call_with_retry(wrong_kind, RetryPolicy(max_attempts=5),
+                        retry_on=(OSError,), sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_delay_curve_is_capped_exponential():
+    policy = RetryPolicy(max_attempts=10, base_delay_s=0.05,
+                         max_delay_s=0.4, backoff=2.0)
+    delays = [policy.delay(k) for k in range(6)]
+    assert delays == [pytest.approx(v)
+                      for v in (0.05, 0.1, 0.2, 0.4, 0.4, 0.4)]
+
+
+def test_on_retry_hook_runs_before_each_backoff():
+    """The hook is where recovery forces a rebuild between attempts
+    (coding/rans.py drops the stale .so here)."""
+    seen = []
+
+    def fail_twice():
+        if len(seen) < 2:
+            raise OSError(f"attempt {len(seen)}")
+        return "done"
+
+    out = call_with_retry(
+        fail_twice, RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        retry_on=(OSError,),
+        on_retry=lambda attempt, exc: seen.append((attempt, str(exc))),
+        sleep=lambda s: None)
+    assert out == "done"
+    assert seen == [(0, "attempt 0"), (1, "attempt 1")]
+
+
+def test_delay_never_overflows_at_huge_attempt_counts():
+    """The serve supervisor feeds an unbounded per-slot restart counter
+    through delay(); a crash-looping worker reaches thousands of
+    attempts, where a naive float `backoff ** attempt` raises
+    OverflowError and would kill the supervisor thread."""
+    policy = RetryPolicy(max_attempts=1 << 30, base_delay_s=0.05,
+                         max_delay_s=2.0, backoff=2.0)
+    for attempt in (64, 1100, 10 ** 6, 1 << 30):
+        assert policy.delay(attempt) == pytest.approx(2.0)
+
+
+def test_policy_validates_its_fields():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff=0.5)
+    with pytest.raises(ValueError):
+        RetryPolicy(base_delay_s=-1.0)

@@ -1,0 +1,245 @@
+"""Self-healing serve workers under injected faults (ISSUE 3 tentpole).
+
+The acceptance scenario, end to end on a real (tiny) model: a fault plan
+crashes a worker mid-stream; every in-flight and queued request must
+still resolve (result or typed error — no hung Future), the supervisor
+must restore the pool, /healthz must walk degraded -> ok, and the
+recovery must reuse the warmed executables (CompilationSentinel
+budget 0). Plus the fail-fast contract at zero live workers.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import (CompressionService, EncodeResult, ServiceConfig,
+                            ServiceUnavailable)
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.recompile import CompilationSentinel
+
+pytestmark = pytest.mark.chaos
+
+BUCKETS = ((16, 24),)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("chaos_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def _service(tiny_cfg_files, **over):
+    ae_p, pc_p = tiny_cfg_files
+    kw = dict(ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS,
+              max_batch=2, max_wait_ms=1.0, max_queue=32, workers=2,
+              restart_backoff_s=0.02, restart_backoff_max_s=0.2,
+              metrics_port=0)
+    kw.update(over)
+    return CompressionService(ServiceConfig(**kw)).start()
+
+
+def _img(rng):
+    return rng.integers(0, 255, (16, 24, 3), dtype=np.uint8)
+
+
+def _wait_live(svc, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while svc.live_workers != n and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return svc.live_workers == n
+
+
+def test_kill_a_worker_under_load_heals_with_zero_compiles(tiny_cfg_files):
+    """The headline acceptance criterion in one run."""
+    svc = _service(tiny_cfg_files)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(0)
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.worker.batch", action="crash", after=1, times=1)],
+            seed=0)
+        with CompilationSentinel(budget=0, label="chaos recovery"):
+            with faults.installed(plan):
+                futures = [svc.submit_encode(_img(rng)) for _ in range(12)]
+                # every future resolves: a result, or the typed injected
+                # crash for the batch that died — never a hang
+                outcomes = [f.exception(timeout=30) for f in futures]
+            crashed = [e for e in outcomes if e is not None]
+            assert plan.activations["serve.worker.batch"] == 1
+            assert all(isinstance(e, faults.InjectedCrash) for e in crashed)
+            ok = [f.result(timeout=0) for f, e in zip(futures, outcomes)
+                  if e is None]
+            assert ok and all(isinstance(r, EncodeResult) for r in ok)
+            # supervisor restores the pool; health returns to ok
+            assert _wait_live(svc, svc.config.workers), \
+                f"pool not restored: {svc.live_workers}"
+            assert svc.health()["status"] == "ok"
+            # and the healed pool still serves — through the SAME
+            # executables (the surrounding sentinel pins zero compiles)
+            res = svc.encode(_img(rng), timeout=30)
+            assert svc.decode(res.stream, timeout=30).shape == (16, 24, 3)
+        assert svc.metrics.counter("serve_worker_restarts").value >= 1
+        assert svc.metrics.counter("serve_worker_crashes").value >= 1
+        assert svc.health()["worker_restarts"] >= 1
+    finally:
+        svc.drain()
+
+
+def test_degraded_then_ok_health_transition(tiny_cfg_files):
+    """With workers=2 and one crashed, /healthz must report `degraded`
+    (and the HTTP endpoint must still answer 200 — a degraded pool
+    serves), then return to `ok` once the supervisor heals it."""
+    import json
+    import urllib.request
+    svc = _service(tiny_cfg_files, restart_backoff_s=0.5,
+                   restart_backoff_max_s=0.5)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(1)
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.worker.batch", action="crash", times=1)], seed=0)
+        with faults.installed(plan):
+            f = svc.submit_encode(_img(rng))
+            assert isinstance(f.exception(timeout=30),
+                              faults.InjectedCrash)
+        assert _wait_live(svc, 1), "crashed worker still counted live"
+        health = svc.health()
+        assert health["status"] == "degraded"
+        assert health["workers_live"] == 1
+        assert health["workers_configured"] == 2
+        port = svc._metrics_server.port
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+        assert resp.status == 200           # degraded still serves
+        assert json.loads(resp.read())["status"] == "degraded"
+        assert _wait_live(svc, 2)
+        assert svc.health()["status"] == "ok"
+    finally:
+        svc.drain()
+
+
+def test_zero_workers_fails_fast_and_healthz_503(tiny_cfg_files):
+    """At zero live workers, submits must raise ServiceUnavailable at
+    the door (not hang until deadline) and /healthz must 503 with
+    `unhealthy` — then the pool heals and intake resumes."""
+    import urllib.error
+    import urllib.request
+    svc = _service(tiny_cfg_files, workers=1, restart_backoff_s=0.6,
+                   restart_backoff_max_s=0.6)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(2)
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.worker.batch", action="crash", times=1)], seed=0)
+        with faults.installed(plan):
+            f = svc.submit_encode(_img(rng))
+            assert isinstance(f.exception(timeout=30),
+                              faults.InjectedCrash)
+        assert _wait_live(svc, 0), "dead worker still counted live"
+        assert svc.health()["status"] == "unhealthy"
+        with pytest.raises(ServiceUnavailable):
+            svc.submit_encode(_img(rng))
+        assert svc.metrics.counter("serve_rejected_unavailable").value >= 1
+        port = svc._metrics_server.port
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=5)
+        assert exc.value.code == 503
+        # the supervisor heals the pool and intake resumes
+        assert _wait_live(svc, 1)
+        assert isinstance(svc.encode(_img(rng), timeout=30), EncodeResult)
+        assert svc.health()["status"] == "ok"
+    finally:
+        svc.drain()
+
+
+def test_worker_side_corruption_is_isolated_per_request(tiny_cfg_files):
+    """The serve.rans site corrupts ONE request's payload after
+    admission; that request alone resolves IntegrityError while its
+    batchmates decode fine — per-request isolation, not batch failure."""
+    from dsin_tpu.serve import IntegrityError
+    svc = _service(tiny_cfg_files, workers=1)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(3)
+        streams = [svc.encode(_img(rng), timeout=30).stream
+                   for _ in range(3)]
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.rans", action="corrupt", times=1)], seed=0)
+        with faults.installed(plan):
+            futs = [svc.submit_decode(s) for s in streams]
+            excs = [f.exception(timeout=30) for f in futs]
+        hit = [e for e in excs if e is not None]
+        assert len(hit) == 1 and isinstance(hit[0], IntegrityError)
+        assert plan.activations["serve.rans"] == 1
+        for f, e in zip(futs, excs):
+            if e is None:
+                assert f.result(timeout=0).shape == (16, 24, 3)
+        assert svc.metrics.counter("serve_integrity_errors").value == 1
+    finally:
+        svc.drain()
+
+
+def test_drain_still_clean_with_supervisor_running(tiny_cfg_files):
+    """The PR-2 drain contract must survive supervision: drain joins the
+    supervisor, no restarts fire during drain, workers exit."""
+    svc = _service(tiny_cfg_files)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(4)
+        assert isinstance(svc.encode(_img(rng), timeout=30), EncodeResult)
+        restarts_before = \
+            svc.metrics.counter("serve_worker_restarts").value
+        assert svc.drain(timeout=30), "drain did not complete"
+        assert not svc._supervisor.is_alive()
+        assert svc.metrics.counter(
+            "serve_worker_restarts").value == restarts_before
+        assert svc.health()["status"] == "draining"
+    finally:
+        svc.drain()
+
+
+def test_nonexception_escapes_worker_loop_after_answering(tiny_cfg_files):
+    """The satellite fix at _worker_loop: a BaseException (e.g.
+    KeyboardInterrupt) must still answer the batch's callers, then kill
+    the thread (recorded for the supervisor) instead of being swallowed
+    into an immortal zombie loop."""
+    svc = _service(tiny_cfg_files, workers=1, restart_backoff_s=2.0,
+                   restart_backoff_max_s=2.0, metrics_port=None)
+    try:
+        svc.warmup()
+        fire = threading.Event()
+
+        def hook(batch):  # noqa: ARG001
+            if fire.is_set():
+                raise KeyboardInterrupt("operator interrupt")
+        svc._batch_hook = hook
+        rng = np.random.default_rng(5)
+        fire.set()
+        f = svc.submit_encode(_img(rng))
+        exc = f.exception(timeout=30)     # caller answered, not hung
+        assert isinstance(exc, KeyboardInterrupt)
+        fire.clear()
+        assert _wait_live(svc, 0, timeout=5), \
+            "worker survived a KeyboardInterrupt (swallowed BaseException)"
+        assert svc.metrics.counter("serve_worker_crashes").value == 1
+        with svc._workers_lock:
+            assert isinstance(svc._worker_exits[0], KeyboardInterrupt)
+    finally:
+        svc.drain()

@@ -1,0 +1,190 @@
+"""Corrupted-stream fuzzing for both framed formats (ISSUE 3 satellite).
+
+The DSIN failure mode under test: the context-model coupling makes a
+flipped payload bit decode to a *plausible* garbage image with no error.
+The CRC framing (DSIM v3, DSRV v2; utils/integrity.py) must convert
+EVERY corruption — any single-bit flip anywhere in a frame, any
+truncation, any fuzzed header field — into a typed ValueError /
+IntegrityError. Never a raw traceback, never a silently wrong image.
+
+These tests run on bytes alone (parse_dsim / parse_stream are pure
+validators), so the exhaustive every-bit sweep costs milliseconds.
+"""
+
+import struct
+
+import pytest
+
+from dsin_tpu.coding import cli as codec_cli
+from dsin_tpu.coding.cli import frame_dsim, parse_dsim
+from dsin_tpu.serve.service import frame_stream, parse_stream
+from dsin_tpu.utils.integrity import IntegrityError
+
+pytestmark = pytest.mark.chaos
+
+PAYLOAD = bytes(range(48))
+
+
+def _flip(blob: bytes, bit: int) -> bytes:
+    out = bytearray(blob)
+    out[bit // 8] ^= 1 << (bit % 8)
+    return bytes(out)
+
+
+# -- DSRV (serve) -------------------------------------------------------------
+
+def test_dsrv_roundtrip_and_v1_compat():
+    blob = frame_stream(PAYLOAD, (10, 17), (16, 24))
+    payload, shape, bucket = parse_stream(blob)
+    assert payload == PAYLOAD and shape == (10, 17) and bucket == (16, 24)
+    # v1 (pre-CRC) frames remain readable
+    v1 = (b"DSRV" + struct.pack("<BHHHHI", 1, 10, 17, 16, 24, len(PAYLOAD))
+          + PAYLOAD)
+    payload, shape, bucket = parse_stream(v1)
+    assert payload == PAYLOAD and shape == (10, 17) and bucket == (16, 24)
+
+
+def test_dsrv_every_single_bit_flip_raises_typed():
+    """The strongest statement the format can make: no single-bit flip
+    anywhere in the frame — magic, any header field, CRC, payload —
+    parses. All failures are ValueError (IntegrityError included)."""
+    blob = frame_stream(PAYLOAD, (10, 17), (16, 24))
+    for bit in range(len(blob) * 8):
+        with pytest.raises(ValueError):
+            parse_stream(_flip(blob, bit))
+
+
+def test_dsrv_payload_flip_is_specifically_an_integrity_error():
+    blob = frame_stream(PAYLOAD, (10, 17), (16, 24))
+    # any bit inside the payload region (header is 21 bytes)
+    with pytest.raises(IntegrityError, match="CRC mismatch"):
+        parse_stream(_flip(blob, 21 * 8 + 5))
+
+
+def test_dsrv_truncations_raise_typed():
+    blob = frame_stream(PAYLOAD, (10, 17), (16, 24))
+    for cut in (0, 3, 4, 16, 20, len(blob) - 1):
+        with pytest.raises(ValueError):
+            parse_stream(blob[:cut])
+
+
+def test_dsrv_fuzzed_header_fields_raise_typed():
+    """Rewrite each header field to hostile values; the frame must never
+    parse (the CRC binds the header, not just the payload)."""
+    for offset, fmt, values in (
+            (4, "<B", (0, 3, 99, 255)),            # version
+            (5, "<H", (0, 999, 65535)),            # h
+            (7, "<H", (0, 999, 65535)),            # w
+            (9, "<H", (0, 65535)),                 # bh
+            (11, "<H", (0, 65535)),                # bw
+            (13, "<I", (0, 1, 2 ** 32 - 1)),       # payload_len
+            (17, "<I", (0, 2 ** 32 - 1))):         # crc
+        for v in values:
+            blob = bytearray(frame_stream(PAYLOAD, (10, 17), (16, 24)))
+            struct.pack_into(fmt, blob, offset, v)
+            with pytest.raises(ValueError):
+                parse_stream(bytes(blob))
+
+
+# -- DSIM (CLI file format) ---------------------------------------------------
+
+def test_dsim_roundtrip_and_v2_compat():
+    blob = frame_dsim(PAYLOAD, 16, 24, seed=3)
+    version, h, w, seed, payload = parse_dsim(blob)
+    assert (version, h, w, seed, payload) == (3, 16, 24, 3, PAYLOAD)
+    v2 = (b"DSIM" + struct.pack("<BHHII", 2, 16, 24, 3, len(PAYLOAD))
+          + PAYLOAD)
+    version, h, w, seed, payload = parse_dsim(v2)
+    assert (version, h, w, seed, payload) == (2, 16, 24, 3, PAYLOAD)
+
+
+def test_dsim_every_single_bit_flip_raises_typed():
+    blob = frame_dsim(PAYLOAD, 16, 24, seed=3)
+    for bit in range(len(blob) * 8):
+        with pytest.raises(ValueError):
+            parse_dsim(_flip(blob, bit))
+
+
+def test_dsim_truncations_raise_typed():
+    blob = frame_dsim(PAYLOAD, 16, 24, seed=3)
+    for cut in (0, 4, 8, 16, 20, len(blob) - 1):
+        with pytest.raises(ValueError):
+            parse_dsim(blob[:cut])
+
+
+def test_dsim_payload_flip_is_specifically_an_integrity_error():
+    blob = frame_dsim(PAYLOAD, 16, 24, seed=3)
+    with pytest.raises(IntegrityError, match="CRC mismatch"):
+        parse_dsim(_flip(blob, codec_cli._HEADER_LEN * 8 + 3))
+
+
+# -- the entropy layer fails typed too ---------------------------------------
+
+def test_codec_truncated_and_garbage_streams_raise_typed():
+    """BottleneckCodec.decode on structurally damaged bitstreams must be
+    ValueError, not struct.error / random tracebacks. (No model needed:
+    all these fail in header validation before any PMF is computed.)"""
+    from dsin_tpu.coding import codec as codec_lib
+
+    class _Hollow(codec_lib.BottleneckCodec):
+        def __init__(self):       # header checks only — skip model wiring
+            self.scale_bits = 16
+
+    c = _Hollow()
+    with pytest.raises(ValueError, match="truncated"):
+        c.decode(b"DTPC\x02")                      # header cut short
+    with pytest.raises(ValueError, match="bad magic"):
+        c.decode(b"JUNKJUNKJUNKJ")
+    with pytest.raises(ValueError, match="version"):
+        c.decode(b"DTPC" + struct.pack("<BBBHHH", 9, 2, 16, 1, 1, 1))
+    with pytest.raises(ValueError, match="scan mode"):
+        c.decode(b"DTPC" + struct.pack("<BBBHHH", 2, 7, 16, 1, 1, 1))
+    with pytest.raises(ValueError, match="implausible"):
+        c.decode(b"DTPC" + struct.pack("<BBBHHH", 2, 2, 16, 0, 4, 4))
+    with pytest.raises(ValueError, match="implausible"):
+        c.decode(b"DTPC" + struct.pack("<BBBHHH", 2, 2, 16,
+                                       65535, 65535, 65535))
+
+
+def test_rans_decoder_rejects_truncated_stream():
+    from dsin_tpu.coding import rans
+    with pytest.raises(ValueError, match="truncated"):
+        rans.Decoder(b"\x01\x02")
+
+
+# -- CLI: corruption is a clean one-line exit 2 -------------------------------
+
+def test_cli_decompress_corrupted_file_exits_2_one_line(tmp_path, capsys):
+    """End-to-end through main(): a bit-flipped .dsin file must exit 2
+    with a single integrity line on stderr — no traceback, no model load
+    (the CRC check runs before the expensive construction)."""
+    blob = frame_dsim(PAYLOAD, 16, 24, seed=0)
+    bad = str(tmp_path / "bad.dsin")
+    with open(bad, "wb") as f:
+        f.write(_flip(blob, (codec_cli._HEADER_LEN + 7) * 8))
+    with pytest.raises(SystemExit) as exc:
+        codec_cli.main(["decompress", bad, str(tmp_path / "out.png")])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert err.startswith("integrity error:") and "CRC mismatch" in err
+    assert "Traceback" not in err
+    assert len(err.strip().splitlines()) == 1
+
+
+def test_cli_decompress_detects_io_read_fault(tmp_path, capsys):
+    """The io.read injection site corrupts the blob AFTER the file read;
+    the CRC must catch it — the defense is in the parse, not the I/O."""
+    from dsin_tpu.utils import faults
+    blob = frame_dsim(PAYLOAD, 16, 24, seed=0)
+    path = str(tmp_path / "ok.dsin")
+    with open(path, "wb") as f:
+        f.write(blob)
+    plan = faults.FaultPlan([faults.FaultSpec(site="io.read",
+                                              action="corrupt")], seed=5)
+    with faults.installed(plan):
+        with pytest.raises(SystemExit) as exc:
+            codec_cli.main(["decompress", path, str(tmp_path / "out.png")])
+    assert exc.value.code == 2
+    assert plan.activations["io.read"] == 1
+    err = capsys.readouterr().err
+    assert "error:" in err and "Traceback" not in err

@@ -158,6 +158,38 @@ def test_serve_bench_smoke_json_contract(tmp_path):
     assert report["trajectory"], "empty trajectory time series"
 
 
+@pytest.mark.chaos
+def test_chaos_bench_smoke_json_contract(tmp_path):
+    """Tier-1 (NOT slow): the robustness acceptance surface in one run —
+    tools/chaos_bench.py --smoke must survive injected worker crashes
+    and stream corruption with ZERO hung futures, ZERO untyped errors,
+    ZERO integrity false negatives, a restored worker pool, and ZERO
+    steady-state compiles across the recovery."""
+    out = tmp_path / "chaos.json"
+    r = _run("chaos_bench.py", "--smoke", "--out", str(out))
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(out.read_text())
+    assert report["violations"] == []
+    inv = report["invariants"]
+    assert inv["hung_futures"] == 0
+    assert inv["untyped_errors"] == 0
+    assert inv["integrity_false_negatives"] == 0
+    assert report["faults_fired"]["serve.worker.batch"] >= 1, \
+        "no faults fired — the chaos run was vacuous"
+    sup = report["supervision"]
+    assert sup["pool_restored"] is True
+    assert sup["worker_restarts"] >= 1
+    integ = report["integrity"]
+    assert integ["door"]["corrupted"] > 0
+    assert integ["door"]["detected"] == integ["door"]["corrupted"]
+    assert integ["worker_side"]["detected"] == \
+        integ["worker_side"]["corrupted"] > 0
+    assert report["steady_compiles"] == 0, (
+        "worker recovery recompiled instead of reusing executables")
+    assert report["load"]["completed_ok"] > 0
+    assert report["clean_decodes_after_chaos"] > 0
+
+
 def test_cache_dir_keyed_by_host_fingerprint():
     """XLA:CPU AOT cache entries embed the COMPILE host's CPU features;
     a dir shared across hosts loads mismatched code with documented

@@ -1,0 +1,322 @@
+"""Seeded chaos soak for the serving + integrity + supervision stack.
+
+Runs CompressionService under a deterministic fault plan
+(dsin_tpu/utils/faults.py) — worker crashes mid-batch, corrupted rANS
+payloads, slow batches — and asserts the recovery invariants the
+robustness PR promises (exit 1 on any violation):
+
+  * every submitted request RESOLVES: a result or a typed error
+    (ServeError / IntegrityError / Injected*) — zero hung futures;
+  * every corrupted stream is DETECTED: zero integrity false negatives
+    (a corrupted stream decoding to an image would be the silent-garbage
+    failure mode the CRC framing exists to kill);
+  * the supervisor RESTORES the worker pool after injected crashes and
+    /healthz returns to ok;
+  * ZERO steady-state XLA compiles across all of it — recovery must
+    reuse the warmed executables, never rebuild them.
+
+Phases: (A) encode load with crash + delay faults; (B) door integrity —
+bit-flipped frames rejected at submit; (C) worker-side integrity — the
+`serve.rans` site corrupts payloads after admission, each decode must
+resolve IntegrityError; (D) fault-free decodes — the service still
+serves cleanly after the chaos.
+
+Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
+(tests/test_tools_smoke.py) and the `chaos-smoke` stage of
+tools/tpu_session.sh.
+
+Usage:
+    python tools/chaos_bench.py                        # committed artifact
+    python tools/chaos_bench.py --smoke --out /tmp/c.json   # tier-1 CI
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _classify(exc):
+    """-> 'ok' | 'typed' | 'untyped' for a resolved future's exception."""
+    from dsin_tpu.serve import ServeError
+    from dsin_tpu.utils.faults import InjectedCrash, InjectedFault
+    if exc is None:
+        return "ok"
+    # ValueError covers IntegrityError (its subclass) and bad-frame errors
+    if isinstance(exc, (ServeError, ValueError, InjectedFault,
+                        InjectedCrash)):
+        return "typed"
+    return "untyped"
+
+
+def _await_all(futures, timeout_s):
+    """Resolve every future; returns (counts dict, hung count)."""
+    counts = {"ok": 0, "typed": 0, "untyped": 0}
+    hung = 0
+    deadline = time.monotonic() + timeout_s
+    for f in futures:
+        remaining = max(0.0, deadline - time.monotonic())
+        try:
+            exc = f.exception(timeout=remaining)
+        except TimeoutError:
+            hung += 1
+            continue
+        counts[_classify(exc)] += 1
+    return counts, hung
+
+
+def _flip_bit(blob: bytes, bit: int) -> bytes:
+    out = bytearray(blob)
+    out[bit // 8] ^= 1 << (bit % 8)
+    return bytes(out)
+
+
+def run_chaos(args) -> dict:
+    from dsin_tpu.serve import (CompressionService, IntegrityError,
+                                ServeError, ServiceConfig)
+    from dsin_tpu.utils import faults
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    from tools.serve_bench import _parse_shapes
+
+    shapes = _parse_shapes(args.shapes)
+    buckets = _parse_shapes(args.buckets)
+    cfg = ServiceConfig(
+        ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
+        seed=args.seed, buckets=buckets, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        workers=args.workers, restart_backoff_s=0.02,
+        restart_backoff_max_s=0.25)
+    service = CompressionService(cfg).start()
+    warm = service.warmup()
+
+    rng = np.random.default_rng(args.seed)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+
+    violations = []
+    health_transitions = []
+
+    def note_health():
+        status = service.health()["status"]
+        if not health_transitions or health_transitions[-1] != status:
+            health_transitions.append(status)
+
+    t0 = time.monotonic()
+    with CompilationSentinel(budget=0, label="chaos steady state",
+                             raise_on_exceed=False) as sentinel:
+        # -- phase A: encode load under crashes + slow batches ------------
+        plan = faults.FaultPlan([
+            faults.FaultSpec(site="serve.worker.batch", action="crash",
+                             probability=args.crash_probability,
+                             after=2, times=args.crashes),
+            faults.FaultSpec(site="serve.worker.batch", action="delay",
+                             probability=0.1, delay_s=0.02, times=10),
+        ], seed=args.seed)
+        futures, door_rejects = [], 0
+        with faults.installed(plan):
+            for i in range(args.requests):
+                try:
+                    futures.append(service.submit_encode(
+                        images[i % len(images)]))
+                except ServeError:
+                    door_rejects += 1      # typed rejection at the door
+                note_health()
+                time.sleep(args.submit_gap_s)
+            load_counts, load_hung = _await_all(futures, args.timeout_s)
+
+        # -- pool restoration after the crash phase -----------------------
+        restore_deadline = time.monotonic() + 10.0
+        while (service.live_workers < cfg.workers
+               and time.monotonic() < restore_deadline):
+            time.sleep(0.02)
+        note_health()
+        pool_restored = service.live_workers == cfg.workers
+        restarts = service.metrics.counter("serve_worker_restarts").value
+        if plan.activations["serve.worker.batch"] == 0:
+            violations.append("no faults fired in phase A (vacuous run)")
+        if not pool_restored:
+            violations.append(
+                f"worker pool not restored: {service.live_workers}/"
+                f"{cfg.workers} live")
+        if service.health()["status"] != "ok":
+            violations.append(
+                f"health did not return to ok: {service.health()}")
+
+        # good streams for the integrity phases (guard on done(): a hung
+        # future would raise TimeoutError here and crash the bench with
+        # a traceback BEFORE the hung-futures violation gets reported)
+        good = [f.result(timeout=0) for f in futures
+                if f.done() and f.exception(timeout=0) is None]
+        if len(good) < 4:
+            violations.append(f"only {len(good)} successful encodes — "
+                              f"not enough to exercise integrity")
+
+        # -- phase B: door integrity (bit-flipped frames at submit) -------
+        door_detected, door_missed = 0, 0
+        for k, res in enumerate(good[:args.corrupt_streams]):
+            blob = res.stream
+            bit = int(rng.integers(0, len(blob) * 8))
+            try:
+                f = service.submit_decode(_flip_bit(blob, bit))
+            except (ValueError, ServeError):
+                # IntegrityError (CRC) or a structural ValueError — both
+                # are detections; nothing was admitted
+                door_detected += 1
+                continue
+            exc = f.exception(timeout=args.timeout_s)
+            if exc is None:
+                door_missed += 1     # decoded an image: false negative
+            else:
+                door_detected += 1
+
+        # -- phase C: worker-side integrity (serve.rans corruption) -------
+        rans_plan = faults.FaultPlan([
+            faults.FaultSpec(site="serve.rans", action="corrupt",
+                             probability=1.0)], seed=args.seed + 1)
+        rans_detected, rans_missed = 0, 0
+        with faults.installed(rans_plan):
+            for res in good[:args.corrupt_streams]:
+                f = service.submit_decode(res.stream)
+                exc = f.exception(timeout=args.timeout_s)
+                if isinstance(exc, IntegrityError):
+                    rans_detected += 1
+                else:
+                    rans_missed += 1
+        if door_missed or rans_missed:
+            violations.append(
+                f"integrity false negatives: {door_missed} at the door, "
+                f"{rans_missed} worker-side")
+
+        # -- phase D: the service still serves cleanly --------------------
+        clean_ok = 0
+        for res in good[:args.decode_samples]:
+            img = service.decode(res.stream, timeout=args.timeout_s)
+            if img.ndim == 3:
+                clean_ok += 1
+        if clean_ok < min(args.decode_samples, len(good)):
+            violations.append("fault-free decodes failed after the chaos")
+
+    if load_hung:
+        violations.append(f"{load_hung} hung futures in phase A")
+    if load_counts["untyped"]:
+        violations.append(f"{load_counts['untyped']} untyped errors")
+    if sentinel.compilations:
+        violations.append(f"{sentinel.compilations} steady-state XLA "
+                          f"compiles (recovery must reuse executables)")
+
+    service.drain()
+    report = {
+        "config": {
+            "shapes": [list(s) for s in shapes],
+            "buckets": [list(b) for b in buckets],
+            "workers": args.workers, "max_batch": args.max_batch,
+            "max_queue": args.max_queue, "requests": args.requests,
+            "crashes": args.crashes,
+            "crash_probability": args.crash_probability,
+            "corrupt_streams": args.corrupt_streams,
+            "seed": args.seed, "smoke": args.smoke,
+        },
+        "warmup": warm,
+        "load": {
+            "submitted": len(futures),
+            "door_rejects": door_rejects,
+            "completed_ok": load_counts["ok"],
+            "typed_errors": load_counts["typed"],
+        },
+        "faults_fired": {
+            "serve.worker.batch": plan.activations["serve.worker.batch"],
+            "serve.rans": rans_plan.activations["serve.rans"],
+        },
+        "supervision": {
+            "worker_restarts": restarts,
+            "worker_crashes":
+                service.metrics.counter("serve_worker_crashes").value,
+            "pool_restored": pool_restored,
+            "health_transitions": health_transitions,
+        },
+        "integrity": {
+            "door": {"corrupted": door_detected + door_missed,
+                     "detected": door_detected},
+            "worker_side": {"corrupted": rans_detected + rans_missed,
+                            "detected": rans_detected},
+            "false_negatives": door_missed + rans_missed,
+        },
+        "invariants": {
+            "hung_futures": load_hung,
+            "untyped_errors": load_counts["untyped"],
+            "integrity_false_negatives": door_missed + rans_missed,
+        },
+        "clean_decodes_after_chaos": clean_ok,
+        "steady_compiles": sentinel.compilations,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="seeded chaos soak for dsin_tpu/serve")
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "dsin_tpu", "configs")
+    p.add_argument("--ae_config",
+                   default=os.path.join(base, "ae_synthetic_micro"))
+    p.add_argument("--pc_config", default=os.path.join(base, "pc_default"))
+    p.add_argument("--ckpt", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--shapes", default="16,24 24,32 32,48")
+    p.add_argument("--buckets", default="24,32 32,48")
+    p.add_argument("--requests", type=int, default=120)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--max_batch", type=int, default=2)
+    p.add_argument("--max_wait_ms", type=float, default=2.0)
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--crashes", type=int, default=4,
+                   help="max injected worker crashes in phase A")
+    p.add_argument("--crash_probability", type=float, default=0.08)
+    p.add_argument("--corrupt_streams", type=int, default=12)
+    p.add_argument("--decode_samples", type=int, default=4)
+    p.add_argument("--submit_gap_s", type=float, default=0.002)
+    p.add_argument("--timeout_s", type=float, default=60.0)
+    p.add_argument("--out", default="CHAOS_BENCH.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny model + short run for tier-1 CI")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        import tempfile
+        args.ae_config, args.pc_config = _smoke_cfgs(tempfile.mkdtemp())
+        args.requests = 40
+        args.crashes = 2
+        args.crash_probability = 0.15
+        args.corrupt_streams = 6
+
+    report = run_chaos(args)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
+    print(json.dumps({k: report[k] for k in
+                      ("load", "supervision", "integrity", "invariants",
+                       "steady_compiles", "violations")}, indent=1))
+    if report["violations"]:
+        print(f"CHAOS_BENCH_FAILED: {report['violations']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _smoke_cfgs(tmpdir):
+    from tools.serve_bench import _write_smoke_cfgs
+    return _write_smoke_cfgs(tmpdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

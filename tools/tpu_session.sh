@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint bench checks breakdown mfu rd_sweep
+# Stages: lint chaos-smoke bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint chaos-smoke bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -30,6 +30,20 @@ lint)
     # a dirty tree aborts the whole queue — that is the point of the gate
     cat artifacts/jaxlint.log
     echo "TPU_SESSION_FAILED: lint (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
+chaos-smoke)
+  # fail fast AFTER lint, BEFORE chip time: the seeded chaos soak
+  # (tools/chaos_bench.py) must show zero hung futures, zero integrity
+  # false negatives, and a self-healed worker pool on CPU first — a
+  # robustness regression caught here costs seconds, not a relay window
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke \
+    --out artifacts/chaos_smoke.json > artifacts/chaos_smoke.log 2>&1 \
+    || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/chaos_smoke.log
+    echo "TPU_SESSION_FAILED: chaos-smoke (queue aborted before chip stages)"
     exit 1
   fi
   ;;
@@ -104,7 +118,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint chaos-smoke bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
