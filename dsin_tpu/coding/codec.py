@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import functools
 import struct
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -129,14 +130,30 @@ class BottleneckCodec:
         self._block_logits_batch = functools.partial(
             jax.jit(jax.vmap(_block_logits, in_axes=(None, 0))), variables)
         self._incremental = None  # lazy numpy engine (wavefront_np mode)
+        self._incremental_lock = threading.Lock()
 
     def _incremental_engine(self):
-        if self._incremental is None:
-            from dsin_tpu.coding.incremental import IncrementalResShallow
-            params_np = jax.tree_util.tree_map(np.asarray, self.pc_params)
-            self._incremental = IncrementalResShallow(
-                params_np, self.centers, self.pc_config, self.pad_value)
-        return self._incremental
+        with self._incremental_lock:
+            if self._incremental is None:
+                from dsin_tpu.coding.incremental import IncrementalResShallow
+                params_np = jax.tree_util.tree_map(np.asarray,
+                                                   self.pc_params)
+                self._incremental = IncrementalResShallow(
+                    params_np, self.centers, self.pc_config, self.pad_value)
+            return self._incremental
+
+    def thread_clone(self) -> "BottleneckCodec":
+        """A per-thread twin for entropy pools (dsin_tpu/serve): shares
+        this codec's read-only weights AND its incremental engine — whose
+        schedule cache is lock-guarded (coding/incremental.py), so clones
+        reuse schedules the parent's warmup already built — while every
+        encode/decode call keeps its per-pass buffers private. Giving
+        each pool thread its own instance also fences off any codec-level
+        mutable state a future change might add."""
+        clone = BottleneckCodec(self.model, self.pc_params, self.centers,
+                                self.pc_config, scale_bits=self.scale_bits)
+        clone._incremental = self._incremental_engine()
+        return clone
 
     # -- internals ----------------------------------------------------------
 

@@ -31,10 +31,18 @@ bit-for-bit on a given machine/BLAS. Like the jit engine's
 same-executable guarantee, streams are not portable across machines with
 different float behavior; cross-machine portability would need an
 integer/fixed-point context model (out of scope, as in the reference).
+
+Thread safety: one `IncrementalResShallow` may be shared across threads
+(the serve entropy pool runs per-image encodes/decodes concurrently,
+dsin_tpu/serve/service.py). The weights/masks/centers are read-only
+after __init__, every `begin()` returns a `_VolumePass` owning all of
+its mutable buffers, and the only shared mutable state — the per-shape
+schedule cache — is guarded by a lock in `schedule()`.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -179,12 +187,21 @@ class IncrementalResShallow:
         self.centers = np.asarray(centers, dtype=np.float32)
         self.pad_value = np.float32(pad_value)
         self._schedules: Dict[Tuple[int, int, int], _Schedule] = {}
+        self._sched_lock = threading.Lock()
 
     def schedule(self, shape: Tuple[int, int, int]) -> _Schedule:
         shape = tuple(int(s) for s in shape)
-        if shape not in self._schedules:
-            self._schedules[shape] = _Schedule(shape, self.k, self.masks)
-        return self._schedules[shape]
+        with self._sched_lock:
+            sch = self._schedules.get(shape)
+        if sch is None:
+            # build OUTSIDE the lock: a first-seen large shape must not
+            # stall pool threads coding other (cached) shapes; racing
+            # builders converge via setdefault (schedules are pure
+            # functions of (shape, kernel, masks), so either copy wins)
+            sch = _Schedule(shape, self.k, self.masks)
+            with self._sched_lock:
+                sch = self._schedules.setdefault(shape, sch)
+        return sch
 
     def begin(self, shape) -> "_VolumePass":
         return _VolumePass(self, self.schedule(shape))

@@ -22,13 +22,23 @@ import jax.numpy as jnp
 
 def load_model_state(ae_config_path: str, pc_config_path: str,
                      ckpt_dir: Optional[str], img_shape: Tuple[int, int],
-                     need_sinet: bool, seed: int = 0):
+                     need_sinet: bool, seed: int = 0,
+                     persistent_cache: bool = False):
     """Build DSIN (+ optional checkpoint restore) with a minimal state.
 
     `seed` drives the parameter init and only matters when no checkpoint
     is restored (smoke runs / tests); callers thread their --seed flag
     through so un-checkpointed runs are reproducible without a
-    hard-coded key."""
+    hard-coded key.
+
+    `persistent_cache` points jax's persistent compilation cache at the
+    shared repo cache dir (utils/cache.py) BEFORE anything compiles, so
+    a restarted long-lived process (dsin_tpu/serve) re-warms from disk
+    instead of re-running XLA — the serve warmup dict reports the split
+    (compiles vs cache_hits, utils/recompile.py)."""
+    if persistent_cache:
+        from dsin_tpu.utils.cache import enable_compilation_cache
+        enable_compilation_cache()
     from dsin_tpu.config import parse_config_file
     from dsin_tpu.models.dsin import DSIN
     from dsin_tpu.train import checkpoint as ckpt_lib

@@ -14,10 +14,14 @@ failure semantics instead of the two silent ones:
 
 Coalescing: requests carry an opaque hashable `key` ((kind, bucket) in
 the service); a batch only ever contains one key, because one key maps
-to one XLA executable. A worker picks the key with the OLDEST head
-request (FIFO fairness across buckets), then waits up to `max_wait_ms`
-for that key's queue to fill to `max_batch` — the head request's age
-bounds added latency, late same-bucket arrivals ride along free.
+to one XLA executable. A worker picks keys ROUND-ROBIN across the live
+(non-empty) key queues — the probe resumes after the last key served,
+so a hot small bucket whose queue never drains cannot monopolize the
+workers: every live key is at most #live-keys pops from service
+(weighted-fair across buckets; FIFO within a key). The worker then
+waits up to `max_wait_ms` for the chosen key's queue to fill to
+`max_batch` — the head request's age bounds added latency, late
+same-bucket arrivals ride along free.
 
 Pure stdlib threading (one Condition), so tier-1 exercises all of it on
 CPU with no jax in sight.
@@ -122,6 +126,8 @@ class MicroBatcher:
         self.on_expired = on_expired
         self._cond = threading.Condition()
         self._queues: Dict[Hashable, deque] = {}
+        self._order: List[Hashable] = []   # live keys, first-seen order
+        self._rr = 0                       # ring index of the next probe
         self._depth = 0
         self._closed = False
 
@@ -135,7 +141,11 @@ class MicroBatcher:
             if self._depth >= self.max_queue:
                 raise ServiceOverloaded(
                     f"request queue full ({self._depth}/{self.max_queue})")
-            self._queues.setdefault(request.key, deque()).append(request)
+            q = self._queues.get(request.key)
+            if q is None:
+                q = self._queues[request.key] = deque()
+                self._order.append(request.key)
+            q.append(request)
             self._depth += 1
             self._cond.notify_all()
 
@@ -150,6 +160,15 @@ class MicroBatcher:
             return self._closed
 
     # -- consumer side ------------------------------------------------------
+
+    def _drop_key_locked(self, key: Hashable) -> None:
+        """Remove an emptied key's queue AND its ring slot, keeping the
+        round-robin probe pointed at the same successor key."""
+        del self._queues[key]
+        idx = self._order.index(key)
+        del self._order[idx]
+        if idx < self._rr:
+            self._rr -= 1
 
     def _expire_locked(self) -> None:
         """Complete every already-dead queued request with DeadlineExceeded
@@ -173,16 +192,28 @@ class MicroBatcher:
             if alive:
                 self._queues[key] = alive
             else:
-                del self._queues[key]
+                self._drop_key_locked(key)
         if expired and self.on_expired is not None:
             self.on_expired(expired)
 
-    def _oldest_key_locked(self) -> Optional[Hashable]:
-        best, best_t = None, None
-        for key, q in self._queues.items():
-            if q and (best_t is None or q[0].arrival < best_t):
-                best, best_t = key, q[0].arrival
-        return best
+    def _next_key_locked(self) -> Optional[Hashable]:
+        """Weighted-fair pop order: round-robin over the live keys in
+        first-seen ring order, resuming after the last key served. Every
+        live key is at most len(ring) pops from service, so a hot bucket
+        with a continuously-refilling queue cannot starve the others
+        (oldest-head selection could: its head is always the oldest
+        while a backlog of its own requests keeps arriving behind it)."""
+        n = len(self._order)
+        if n == 0:
+            return None
+        start = self._rr % n
+        for i in range(n):
+            idx = (start + i) % n
+            key = self._order[idx]
+            if self._queues.get(key):
+                self._rr = idx + 1
+                return key
+        return None
 
     def next_batch(self, timeout: Optional[float] = None
                    ) -> Optional[List[Request]]:
@@ -193,7 +224,7 @@ class MicroBatcher:
         with self._cond:
             while True:
                 self._expire_locked()
-                key = self._oldest_key_locked()
+                key = self._next_key_locked()
                 if key is None:
                     if self._closed:
                         return None
@@ -224,7 +255,7 @@ class MicroBatcher:
                     batch.append(q.popleft())
                     self._depth -= 1
                 if not q:
-                    del self._queues[key]
+                    self._drop_key_locked(key)
                 return batch
 
     # -- drain --------------------------------------------------------------
@@ -244,6 +275,8 @@ class MicroBatcher:
                     r.future.set_exception(ServiceDraining(
                         "service drained before this request was started"))
             self._queues.clear()
+            self._order.clear()
+            self._rr = 0
             self._depth = 0
             self._cond.notify_all()
             return rejected

@@ -57,6 +57,26 @@ class Gauge:
             return self._value
 
 
+class Accumulator:
+    """Lock-guarded float total — a Counter for non-integer quantities
+    (stage milliseconds, bytes). The pipelined dataplane keeps its
+    device/entropy/busy wall-time sums here so `serve_overlap_ratio`
+    can be recomputed from the snapshot alone."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._value += float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
 class Histogram:
     """Bounded-reservoir summary: count/mean over everything ever
     observed, quantiles over the most recent `maxlen` samples."""
@@ -102,6 +122,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._accumulators: Dict[str, Accumulator] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -115,16 +136,23 @@ class MetricsRegistry:
         with self._lock:
             return self._histograms.setdefault(name, Histogram())
 
+    def accumulator(self, name: str) -> Accumulator:
+        with self._lock:
+            return self._accumulators.setdefault(name, Accumulator())
+
     def snapshot(self) -> dict:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
+            accumulators = dict(self._accumulators)
         return {
             "counters": {k: c.value for k, c in sorted(counters.items())},
             "gauges": {k: g.value for k, g in sorted(gauges.items())},
             "histograms": {k: h.summary()
                            for k, h in sorted(histograms.items())},
+            "accumulators": {k: a.value
+                             for k, a in sorted(accumulators.items())},
         }
 
     def render_text(self) -> str:
@@ -133,6 +161,8 @@ class MetricsRegistry:
         for k, v in snap["counters"].items():
             lines.append(f"{k}_total {v}")
         for k, v in snap["gauges"].items():
+            lines.append(f"{k} {v:g}")
+        for k, v in snap["accumulators"].items():
             lines.append(f"{k} {v:g}")
         for k, s in snap["histograms"].items():
             lines.append(f"{k}_count {s['count']}")

@@ -17,9 +17,42 @@ module is the amortized form the ROADMAP's serving goal needs:
   submits are refused.
 
 The jitted work is the batched AE encode/decode; the per-image rANS
-entropy stage runs on the worker thread with the pure-numpy incremental
-engine (coding/incremental.py), which holds no jax state and therefore
-never contributes to the compile budget.
+entropy stage runs on the pure-numpy incremental engine
+(coding/incremental.py), which holds no jax state and therefore never
+contributes to the compile budget.
+
+Pipelined dataplane (ISSUE 4): the two stages are heterogeneous — a
+device batch and per-image CPU entropy coding — and running them
+serialized on one worker thread leaves whichever side is idle (the
+classic learned-codec serving bottleneck, PAPERS.md 2207.14524 /
+1912.08771). With `entropy_workers > 0` each worker instead runs a
+two-stage pipeline:
+
+  encode:  [worker] assemble + dispatch jitted batch (async)
+           [pool]   one task per image: single shared device->host
+                    transfer, then rANS encode + frame + resolve future
+  decode:  [pool]   one task per image: CRC re-verify + rANS decode
+           [worker] jitted batch decode over the gathered symbols,
+                    crop + resolve futures
+
+The worker dispatches batch N+1's device stage while batch N's entropy
+tasks run on the pool (`pipeline_depth` bounds how many batches may be
+in flight), so device and host stages genuinely overlap: nothing blocks
+on a device->host transfer before the next device call is dispatched —
+the transfer happens in the pool task that first needs the values.
+Every pool thread owns a private codec clone (BottleneckCodec
+.thread_clone) sharing the warmed, lock-guarded schedule cache. Fault
+isolation is preserved inside pool tasks: the `serve.rans` site and the
+payload-CRC re-verify run per task, and an IntegrityError lands on that
+request's future only. A worker that dies mid-pipeline (crash between
+device dispatch and entropy completion) flushes its in-flight records
+on the way out — completed or failed, never hung — and the supervisor
+restarts it. Per-stage observability: `serve_device_ms`,
+`serve_entropy_ms` histograms, `serve_pipeline_inflight`, and
+`serve_overlap_ratio` = 1 - busy/(device+entropy) where busy is the
+wall time workers actually spent on batches (serialized mode pins it to
+~0; at steady state a pipelined worker pays ~max(stage) per batch
+instead of the sum).
 
 Stream framing (little-endian, v2), around the BottleneckCodec payload:
     b"DSRV" | u8 version | u16 h | u16 w | u16 bh | u16 bw
@@ -48,6 +81,8 @@ from __future__ import annotations
 import struct
 import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
@@ -83,6 +118,19 @@ class ServiceConfig:
     max_wait_ms: float = 5.0
     max_queue: int = 64
     workers: int = 1
+    #: rANS pool size per service; 0 = serialized legacy path (entropy
+    #: runs inline on the worker thread after/before the device call);
+    #: None = auto: min(4, cores - 1), at least 1 — the entropy stage is
+    #: GIL-heavy numpy, so a pool wider than the spare cores actively
+    #: hurts (measured 0.5x per-encode at 2 threads on a 2-core host)
+    entropy_workers: Optional[int] = None
+    #: max batches a worker may hold in flight (device dispatched,
+    #: entropy pending) before finishing the oldest; >= 2 overlaps
+    #: batch N's entropy with batch N+1's device stage
+    pipeline_depth: int = 2
+    #: persistent XLA compilation cache (utils/cache.py) at start(), so
+    #: a restarted service re-warms from disk instead of recompiling
+    persistent_cache: bool = True
     #: None = no HTTP endpoint; 0 = ephemeral port (tests)
     metrics_port: Optional[int] = None
     #: supervisor restart backoff: base and cap of the exponential curve
@@ -166,6 +214,58 @@ def _make_batched_fns(model):
     return jax.jit(encode_fn), jax.jit(decode_fn)
 
 
+class _DeviceBatch:
+    """One dispatched jitted batch. The device computes while the worker
+    thread moves on to the next batch; the FIRST entropy task to need
+    host values performs the single device->host transfer (np.asarray
+    blocks until the computation finishes), siblings block briefly on
+    the lock and share the copy. `device_ms` therefore measures
+    dispatch -> results-on-host: queueing + compute + transfer."""
+
+    __slots__ = ("_lock", "_dev", "_host", "dispatched", "transfer_done")
+
+    def __init__(self, dev):
+        self._lock = threading.Lock()
+        self._dev = dev
+        self._host = None
+        self.dispatched = time.monotonic()
+        self.transfer_done: Optional[float] = None
+
+    def host(self) -> np.ndarray:
+        with self._lock:
+            if self._host is None:
+                self._host = np.asarray(self._dev)
+                self._dev = None
+                self.transfer_done = time.monotonic()
+            return self._host
+
+    @property
+    def device_ms(self) -> float:
+        done = self.transfer_done if self.transfer_done is not None \
+            else time.monotonic()
+        return (done - self.dispatched) * 1e3
+
+
+class _Inflight:
+    """One batch moving through the pipeline: the worker's handle for
+    finishing it (wait for entropy tasks; decode's device stage) and the
+    per-batch ledger the stage metrics come from."""
+
+    __slots__ = ("kind", "batch", "bucket", "t0", "tasks", "handle",
+                 "sym", "per_item_exc", "crash")
+
+    def __init__(self, kind, batch, bucket, t0):
+        self.kind = kind
+        self.batch = batch
+        self.bucket = bucket
+        self.t0 = t0
+        self.tasks = []
+        self.handle: Optional[_DeviceBatch] = None   # encode
+        self.sym: Optional[np.ndarray] = None        # decode gather
+        self.per_item_exc = {}
+        self.crash: Optional[BaseException] = None
+
+
 class CompressionService:
     """Thread-per-worker micro-batching codec service.
 
@@ -198,6 +298,9 @@ class CompressionService:
         self._drained = threading.Event()
         self._metrics_server: Optional[metrics_lib.MetricsServer] = None
         self._batch_hook = None   # test/diagnostic: called with each batch
+        self._entropy_hook = None  # test/diagnostic: called per pool task
+        self._entropy_pool: Optional[ThreadPoolExecutor] = None
+        self._codec_local = threading.local()
         self.model = None
         self.state = None
         self.codec = None
@@ -213,11 +316,20 @@ class CompressionService:
         init_shape = self.policy.buckets[-1]
         self.model, self.state = load_model_state(
             self.config.ae_config, self.config.pc_config, self.config.ckpt,
-            init_shape, need_sinet=False, seed=self.config.seed)
+            init_shape, need_sinet=False, seed=self.config.seed,
+            persistent_cache=self.config.persistent_cache)
         self.codec = make_codec(self.model, self.state)
         self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
         self._bn_channels = int(self.model.ae_config.num_chan_bn)
         recompile.install()
+        ew = self.config.entropy_workers
+        if ew is None:
+            import os
+            ew = max(1, min(4, (os.cpu_count() or 2) - 1))
+        self._entropy_workers = ew
+        if ew > 0:
+            self._entropy_pool = ThreadPoolExecutor(
+                max_workers=ew, thread_name_prefix="serve-entropy")
         with self._workers_lock:
             for i in range(self.config.workers):
                 self._workers.append(self._spawn_worker(i))
@@ -236,12 +348,18 @@ class CompressionService:
         return self
 
     def warmup(self) -> dict:
-        """Compile every (bucket, direction) executable and prime the
-        numpy entropy engine, so the first real request pays nothing.
-        Returns {"compiles": n, "seconds": s}."""
+        """Compile every (bucket, direction) executable, prime the numpy
+        entropy engine's schedules, and spin up the entropy pool threads
+        (each builds its codec clone), so the first real request pays
+        nothing. Returns {"compiles": n, "cache_hits": h, "seconds": s}
+        — with the persistent compilation cache on, a restarted service
+        reports compiles == cache_hits: every executable was loaded from
+        disk, none rebuilt (utils/recompile.py counts a cache load in
+        BOTH numbers)."""
         assert self._started, "start() before warmup()"
         t0 = time.monotonic()
         before = recompile.compilation_count()
+        before_hits = recompile.cache_hit_count()
         params, bs = self.state.params, self.state.batch_stats
         for bh, bw in self.policy.buckets:
             x = jnp.zeros((self.config.max_batch, bh, bw, 3), jnp.float32)
@@ -255,10 +373,25 @@ class CompressionService:
                  bw // buckets_lib.SUBSAMPLING, self._bn_channels),
                 jnp.int32)
             np.asarray(self._decode_fn(params, bs, sym_batch))
+        if self._entropy_pool is not None:
+            # force every pool thread into existence and build its codec
+            # clone now (the barrier keeps the tasks on distinct
+            # threads), so the first pipelined batch pays no lazy setup
+            n = self._entropy_workers
+            barrier = threading.Barrier(n)
+
+            def _prime():
+                barrier.wait(timeout=60)
+                self._thread_codec()
+
+            for f in [self._entropy_pool.submit(_prime) for _ in range(n)]:
+                f.result(timeout=120)
         compiles = recompile.compilation_count() - before
+        cache_hits = recompile.cache_hit_count() - before_hits
         self.metrics.gauge("serve_warmup_compiles").set(compiles)
         self.metrics.gauge("serve_buckets").set(len(self.policy.buckets))
         return {"compiles": compiles,
+                "cache_hits": cache_hits,
                 "seconds": time.monotonic() - t0}
 
     @property
@@ -298,6 +431,10 @@ class CompressionService:
         alive = any(t.is_alive() for t in workers)
         if not alive:
             self._drained.set()
+            if self._entropy_pool is not None:
+                # workers flushed their pipelines before exiting, so the
+                # pool is idle; shutdown is immediate (and idempotent)
+                self._entropy_pool.shutdown(wait=True)
             if self._metrics_server is not None:
                 self._metrics_server.stop()
                 self._metrics_server = None
@@ -438,24 +575,66 @@ class CompressionService:
             self.metrics.counter("serve_worker_crashes").inc()
 
     def _worker_loop(self) -> None:
-        while True:
-            batch = self._batcher.next_batch(timeout=0.25)
-            if batch is None:
-                return            # closed and empty: drain complete
-            if not batch:
-                continue
-            try:
-                self._process_batch(batch)
-            except BaseException as e:  # noqa: BLE001 — must answer callers
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
-                if not isinstance(e, Exception):
-                    # KeyboardInterrupt / InjectedCrash-class conditions
-                    # must kill this thread so the supervisor sees the
-                    # death — swallowing them here left the pool silently
-                    # shrunk (ISSUE 3 satellite)
-                    raise
+        inflight: deque = deque()
+        depth = max(1, int(self.config.pipeline_depth)) \
+            if self._entropy_pool is not None else 1
+        gauge = self.metrics.gauge("serve_pipeline_inflight")
+        try:
+            while True:
+                # with work in flight, poll instead of blocking: an empty
+                # queue means it is time to finish the oldest batch, not
+                # to sit on it for the poll interval
+                batch = self._batcher.next_batch(
+                    timeout=0.0 if inflight else 0.25)
+                if batch is None:
+                    return        # closed and empty: finally flushes
+                if not batch:
+                    if inflight:
+                        self._finish_oldest(inflight, gauge)
+                    continue
+                t_start = time.monotonic()
+                try:
+                    rec = self._start_batch(batch)
+                except BaseException as e:  # noqa: BLE001 — answer callers
+                    for r in batch:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                    if not isinstance(e, Exception):
+                        # KeyboardInterrupt / InjectedCrash-class
+                        # conditions must kill this thread so the
+                        # supervisor sees the death — swallowing them
+                        # left the pool silently shrunk (ISSUE 3)
+                        raise
+                    continue
+                if rec is not None:
+                    self._busy_ms.add((time.monotonic() - t_start) * 1e3)
+                    inflight.append(rec)
+                    gauge.set(len(inflight))
+                while len(inflight) >= depth:
+                    self._finish_oldest(inflight, gauge)
+        finally:
+            # the pipeline's no-hung-futures guarantee: whether this
+            # thread exits a drain (None batch) or dies on a crash
+            # between a batch's device dispatch and its entropy
+            # completion, every in-flight record is completed or failed
+            # before the thread ends — the supervisor restarts a clean
+            # slot, never one with orphaned futures
+            while inflight:
+                self._finish_oldest(inflight, gauge, swallow=True)
+            gauge.set(0)
+
+    def _finish_oldest(self, inflight: deque, gauge,
+                       swallow: bool = False) -> None:
+        rec = inflight.popleft()
+        gauge.set(len(inflight))
+        try:
+            self._finish_batch(rec)
+        except BaseException as e:  # noqa: BLE001 — must answer callers
+            for r in rec.batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            if not isinstance(e, Exception) and not swallow:
+                raise
 
     # -- supervision --------------------------------------------------------
 
@@ -486,7 +665,32 @@ class CompressionService:
             self._draining.wait(self.config.supervise_every_s)
         self.metrics.gauge("serve_workers_live").set(self.live_workers)
 
-    def _process_batch(self, batch) -> None:
+    @property
+    def _busy_ms(self) -> metrics_lib.Accumulator:
+        """Wall time workers actually spent on batches (assemble +
+        dispatch + finish); the denominator-side input of
+        serve_overlap_ratio."""
+        return self.metrics.accumulator("serve_busy_ms_total")
+
+    def _thread_codec(self):
+        """Entropy-stage codec for the CURRENT thread: pool threads each
+        own a BottleneckCodec clone (per-pass rANS/buffer state stays
+        thread-private) sharing the service codec's schedule-cached,
+        lock-guarded incremental engine (coding/incremental.py)."""
+        if self._entropy_pool is None:
+            return self.codec
+        codec = getattr(self._codec_local, "codec", None)
+        if codec is None:
+            codec = self.codec.thread_clone()
+            self._codec_local.codec = codec
+        return codec
+
+    def _start_batch(self, batch) -> Optional[_Inflight]:
+        """Stage 1, on the worker thread. Serialized mode
+        (entropy_workers=0) runs the whole batch here and returns None;
+        pipelined mode dispatches the device stage / fans the entropy
+        work out to the pool and returns the in-flight record for
+        _finish_batch."""
         faults.inject("serve.worker.batch")
         if self._batch_hook is not None:
             self._batch_hook(batch)
@@ -495,28 +699,173 @@ class CompressionService:
         self.metrics.gauge("serve_queue_depth").set(self._batcher.depth)
         self.metrics.histogram("serve_batch_occupancy").observe(
             len(batch) / self.config.max_batch)
+        if self._entropy_pool is None:
+            if kind == ENCODE:
+                device_ms, entropy_ms = self._run_encode(batch, bucket)
+            else:
+                device_ms, entropy_ms = self._run_decode(batch, bucket)
+            self._busy_ms.add((time.monotonic() - t0) * 1e3)
+            self._note_batch_done(batch, t0, device_ms, entropy_ms,
+                                  observe_latency=True)
+            return None
+        rec = _Inflight(kind, batch, bucket, t0)
         if kind == ENCODE:
-            self._run_encode(batch, bucket)
+            bh, bw = bucket
+            x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
+            for i, r in enumerate(batch):
+                x[i] = r.payload[0]
+            # async dispatch: the jit call returns before the device
+            # finishes; the transfer happens in whichever pool task
+            # first calls rec.handle.host() — the worker never blocks
+            # here, so batch N+1's device call can follow immediately
+            rec.handle = _DeviceBatch(self._encode_fn(
+                self.state.params, self.state.batch_stats,
+                jnp.asarray(x)))
         else:
-            self._run_decode(batch, bucket)
+            bh, bw = bucket
+            sub = buckets_lib.SUBSAMPLING
+            rec.sym = np.zeros((self.config.max_batch, bh // sub,
+                                bw // sub, self._bn_channels), np.int32)
+        rec.tasks = [self._entropy_pool.submit(self._entropy_task,
+                                               rec, i, r)
+                     for i, r in enumerate(batch)]
+        return rec
+
+    def _entropy_task(self, rec: _Inflight, i: int, req) -> tuple:
+        """Stage 2, on an entropy-pool thread: per-image rANS work.
+        Resolves THIS request's future (result for encode; exception on
+        any per-item failure — the serve.rans fault site and the
+        payload-CRC re-verify both live here, so an IntegrityError is
+        isolated to one request). Never raises: a non-`Exception`
+        (InjectedCrash class) is recorded on the record and re-raised by
+        _finish_batch on the worker thread, where it kills the worker
+        the supervisor owns. Returns the (start, end) entropy span."""
+        te0 = te1 = None
+        try:
+            if self._entropy_hook is not None:
+                self._entropy_hook(rec, i, req)
+            codec = self._thread_codec()
+            if rec.kind == ENCODE:
+                symbols = rec.handle.host()   # shared one-time transfer
+                te0 = time.monotonic()
+                h, w = req.payload[1]
+                payload = codec.encode(
+                    np.transpose(symbols[i], (2, 0, 1)))
+                te1 = time.monotonic()
+                req.future.set_result(EncodeResult(
+                    stream=frame_stream(payload, (h, w), rec.bucket),
+                    payload_bytes=len(payload),
+                    bpp=len(payload) * 8.0 / (h * w),
+                    shape=(h, w), bucket=rec.bucket))
+                self._observe_latency(req)
+            else:
+                te0 = time.monotonic()
+                data = faults.corrupt("serve.rans", req.payload[0])
+                # re-verify right before the entropy decode: corruption
+                # past the door (buffer damage, injected faults) must
+                # raise typed, never decode to a plausible wrong image
+                verify_crc(req.payload[2], "DSRV payload (worker)", data)
+                vol = codec.decode(data)            # (C, bh/8, bw/8)
+                rec.sym[i] = np.transpose(vol, (1, 2, 0))
+                te1 = time.monotonic()
+        except BaseException as e:  # noqa: BLE001 — isolate bad streams
+            rec.per_item_exc[i] = e
+            if not req.future.done():
+                req.future.set_exception(e)
+                self._observe_latency(req)
+            if isinstance(e, IntegrityError):
+                self.metrics.counter("serve_integrity_errors").inc()
+            if not isinstance(e, Exception):
+                rec.crash = e
+        return (te0, te1)
+
+    def _finish_batch(self, rec: _Inflight) -> None:
+        """Stage 3, back on the worker thread: wait for the record's
+        entropy tasks, run the decode device stage, publish the batch
+        metrics, then surface a recorded crash."""
+        tf0 = time.monotonic()
+        spans = [t.result() for t in rec.tasks]   # tasks never raise
+        device_ms = 0.0
+        if rec.kind == ENCODE:
+            device_ms = rec.handle.device_ms
+        elif len(rec.per_item_exc) == len(rec.batch):
+            # every item already failed (CRC/decode): the jitted decode
+            # would only reconstruct a zero tensor nobody reads — skip
+            # the device call entirely (ISSUE 4 satellite)
+            self.metrics.counter("serve_device_skipped_batches").inc()
+        else:
+            t_dev = time.monotonic()
+            imgs = np.asarray(self._decode_fn(
+                self.state.params, self.state.batch_stats,
+                jnp.asarray(rec.sym)))
+            device_ms = (time.monotonic() - t_dev) * 1e3
+            for i, r in enumerate(rec.batch):
+                if i in rec.per_item_exc:
+                    continue       # its future already holds the error
+                h, w = r.payload[1]
+                r.future.set_result(
+                    buckets_lib.crop_from_bucket(imgs[i], (h, w))
+                    .astype(np.uint8))
+                self._observe_latency(r)
+        starts = [s[0] for s in spans if s[0] is not None]
+        ends = [s[1] for s in spans if s[1] is not None]
+        entropy_ms = (max(ends) - min(starts)) * 1e3 \
+            if starts and ends else 0.0
+        self._busy_ms.add((time.monotonic() - tf0) * 1e3)
+        self._note_batch_done(rec.batch, rec.t0, device_ms, entropy_ms)
+        if rec.crash is not None:
+            raise rec.crash
+
+    def _observe_latency(self, req) -> None:
+        """Record arrival -> future-RESOLUTION latency — called at the
+        moment the request's future is set, so pipelined mode does not
+        bill the caller for pipeline dwell after their answer landed."""
+        self.metrics.histogram("serve_latency_ms").observe(
+            (time.monotonic() - req.arrival) * 1e3)
+
+    def _note_batch_done(self, batch, t0, device_ms, entropy_ms,
+                         observe_latency: bool = False) -> None:
         now = time.monotonic()
-        for r in batch:
-            self.metrics.histogram("serve_latency_ms").observe(
-                (now - r.arrival) * 1e3)
+        if observe_latency:
+            # serialized path: futures resolved moments ago in _run_*,
+            # so note-time latency is resolution-time latency
+            for r in batch:
+                self._observe_latency(r)
         self.metrics.counter("serve_batches").inc()
         self.metrics.counter("serve_completed").inc(len(batch))
         self.metrics.histogram("serve_batch_ms").observe((now - t0) * 1e3)
+        self.metrics.histogram("serve_device_ms").observe(device_ms)
+        self.metrics.histogram("serve_entropy_ms").observe(entropy_ms)
+        self.metrics.accumulator("serve_device_ms_total").add(device_ms)
+        self.metrics.accumulator("serve_entropy_ms_total").add(entropy_ms)
         self.metrics.gauge("serve_xla_compiles").set(
             recompile.compilation_count())
+        self._update_overlap_gauge()
 
-    def _run_encode(self, batch, bucket) -> None:
+    def _update_overlap_gauge(self) -> None:
+        """serve_overlap_ratio = 1 - busy/(device+entropy): 0 when the
+        stages run strictly serialized on the worker (busy == their
+        sum), approaching 1 - max/sum as the pipeline hides one stage
+        behind the other. Clamped at 0 — bookkeeping overhead can push
+        a serialized worker's busy time slightly past the stage sum."""
+        dev = self.metrics.accumulator("serve_device_ms_total").value
+        ent = self.metrics.accumulator("serve_entropy_ms_total").value
+        busy = self._busy_ms.value
+        if dev + ent > 0:
+            self.metrics.gauge("serve_overlap_ratio").set(
+                max(0.0, 1.0 - busy / (dev + ent)))
+
+    def _run_encode(self, batch, bucket) -> Tuple[float, float]:
+        """Serialized encode (entropy_workers=0): device then entropy,
+        inline on the worker thread. Returns (device_ms, entropy_ms)."""
         bh, bw = bucket
-        n = len(batch)
         x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
         for i, r in enumerate(batch):
             x[i] = r.payload[0]
+        t_dev = time.monotonic()
         symbols = np.asarray(self._encode_fn(
             self.state.params, self.state.batch_stats, jnp.asarray(x)))
+        t_ent = time.monotonic()
         for i, r in enumerate(batch):
             h, w = r.payload[1]
             payload = self.codec.encode(np.transpose(symbols[i], (2, 0, 1)))
@@ -525,13 +874,17 @@ class CompressionService:
                 payload_bytes=len(payload),
                 bpp=len(payload) * 8.0 / (h * w),
                 shape=(h, w), bucket=bucket))
+        return ((t_ent - t_dev) * 1e3, (time.monotonic() - t_ent) * 1e3)
 
-    def _run_decode(self, batch, bucket) -> None:
+    def _run_decode(self, batch, bucket) -> Tuple[float, float]:
+        """Serialized decode (entropy_workers=0): entropy then device,
+        inline on the worker thread. Returns (device_ms, entropy_ms)."""
         bh, bw = bucket
         sub = buckets_lib.SUBSAMPLING
         sym = np.zeros((self.config.max_batch, bh // sub, bw // sub,
                         self._bn_channels), np.int32)
         per_item_exc = {}
+        t_ent = time.monotonic()
         for i, r in enumerate(batch):
             try:
                 data = faults.corrupt("serve.rans", r.payload[0])
@@ -546,8 +899,19 @@ class CompressionService:
                 per_item_exc[i] = e
                 if isinstance(e, IntegrityError):
                     self.metrics.counter("serve_integrity_errors").inc()
+        entropy_ms = (time.monotonic() - t_ent) * 1e3
+        if len(per_item_exc) == len(batch):
+            # whole batch failed before the device stage: decoding a
+            # zero tensor would be pure wasted device work — answer the
+            # callers and skip the jitted call (ISSUE 4 satellite)
+            for i, r in enumerate(batch):
+                r.future.set_exception(per_item_exc[i])
+            self.metrics.counter("serve_device_skipped_batches").inc()
+            return (0.0, entropy_ms)
+        t_dev = time.monotonic()
         imgs = np.asarray(self._decode_fn(
             self.state.params, self.state.batch_stats, jnp.asarray(sym)))
+        device_ms = (time.monotonic() - t_dev) * 1e3
         for i, r in enumerate(batch):
             if i in per_item_exc:
                 r.future.set_exception(per_item_exc[i])
@@ -556,3 +920,4 @@ class CompressionService:
             r.future.set_result(
                 buckets_lib.crop_from_bucket(imgs[i], (h, w))
                 .astype(np.uint8))
+        return (device_ms, entropy_ms)

@@ -67,4 +67,14 @@ def enable_compilation_cache(tag: str | None = None) -> str:
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # jax latches the cache's initialized-ness at the FIRST backend
+    # compile: if anything compiled before this call (a long-lived
+    # process starting a serve instance late, a test suite), the dir
+    # update above is silently ignored — no writes, no reads. Reset so
+    # the new dir takes effect; a no-op when nothing compiled yet.
+    try:
+        from jax._src.compilation_cache import reset_cache
+        reset_cache()
+    except Exception:  # noqa: BLE001 — private API; cache stays best-effort
+        pass
     return cache_dir

@@ -32,21 +32,35 @@ import jax.monitoring
 
 #: events that mean "XLA built a new executable". jaxpr_trace fires for
 #: cheap retraces that hit the executable cache; backend_compile is the
-#: expensive one the budget is about.
+#: expensive one the budget is about. NOTE: a persistent-cache load
+#: (utils/cache.py) also fires backend_compile_duration — the retrieval
+#: happens inside jax's compile path — so it counts here AND in the
+#: cache-hit counter below; `compiles - cache_hits` is the number of
+#: executables actually built from scratch.
 _COMPILE_EVENTS = frozenset({
     "/jax/core/compile/backend_compile_duration",
+})
+
+#: fired when jax's persistent compilation cache served the executable
+#: instead of XLA building it (observed on jax 0.4.37).
+_CACHE_HIT_EVENTS = frozenset({
+    "/jax/compilation_cache/cache_retrieval_time_sec",
 })
 
 _lock = threading.Lock()
 _installed = False
 _count = 0
+_cache_hits = 0
 
 
 def _listener(event: str, duration: float, **kwargs) -> None:
-    global _count
+    global _count, _cache_hits
     if event in _COMPILE_EVENTS:
         with _lock:
             _count += 1
+    elif event in _CACHE_HIT_EVENTS:
+        with _lock:
+            _cache_hits += 1
 
 
 def install() -> None:
@@ -63,6 +77,15 @@ def compilation_count() -> int:
     """Backend compiles observed process-wide since install()."""
     install()
     return _count
+
+
+def cache_hit_count() -> int:
+    """Persistent-compilation-cache hits observed since install(). Each
+    hit ALSO increments compilation_count() (jax fires both events), so
+    a region whose compile delta equals its cache-hit delta built zero
+    new executables — the warm-restart property serve warmup reports."""
+    install()
+    return _cache_hits
 
 
 class RecompilationBudgetExceeded(AssertionError):

@@ -28,15 +28,42 @@ def test_coalesces_same_key_up_to_max_batch():
     assert b.depth == 0
 
 
-def test_batches_never_mix_keys_and_oldest_head_goes_first():
+def test_batches_never_mix_keys_and_round_robin_across_keys():
+    """Pop order is round-robin over the live keys in first-seen ring
+    order (ISSUE 4 weighted-fair satellite) — NOT oldest-head: the probe
+    visits every live key once per ring lap regardless of arrival age."""
     b = MicroBatcher(max_batch=4, max_wait_ms=0, max_queue=16)
     ra, rb = _req(key="a"), _req(key="b")
-    rb.arrival -= 1.0          # b's head is older
+    rb.arrival -= 1.0          # b's head is older; a was SUBMITTED first
     b.submit(ra)
     b.submit(rb)
     first = b.next_batch(timeout=1)
     second = b.next_batch(timeout=1)
-    assert first == [rb] and second == [ra]
+    assert first == [ra] and second == [rb]
+
+
+def test_round_robin_hot_bucket_cannot_starve_the_other():
+    """Two contending buckets, one with a deep (older) backlog: the
+    round-robin probe alternates into the second bucket after ONE batch
+    of the hot one, instead of draining the hot backlog first (which is
+    what oldest-head selection would do, and what lets a hot small
+    bucket starve large buckets under continuous load)."""
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=16)
+    hot = [_req(key="hot") for _ in range(6)]
+    for r in hot:
+        r.arrival -= 1.0       # the whole hot backlog predates "cold"
+    cold = [_req(key="cold") for _ in range(2)]
+    for r in hot:
+        b.submit(r)
+    for r in cold:
+        b.submit(r)
+    batches = [b.next_batch(timeout=1) for _ in range(4)]
+    assert [batch[0].key for batch in batches] == \
+        ["hot", "cold", "hot", "hot"]
+    # FIFO preserved within each key
+    assert batches[0] == hot[:2] and batches[1] == cold
+    assert batches[2] == hot[2:4] and batches[3] == hot[4:6]
+    assert b.depth == 0
 
 
 def test_partial_batch_released_after_max_wait():

@@ -1,0 +1,267 @@
+"""Pipelined serve dataplane (ISSUE 4 tentpole): device/entropy overlap.
+
+The PR-2/PR-3 suites already run on the (now default) pipelined path;
+this file pins the contracts that are NEW with the pipeline:
+
+  * a worker that dies BETWEEN a batch's device dispatch and its entropy
+    completion leaves zero hung futures — the in-flight record is
+    flushed (completed) on the way out, the crashed batch's callers get
+    the typed crash, and the supervisor heals the pool with zero new
+    XLA compiles;
+  * the flush also runs a decode batch's pending DEVICE stage, so an
+    in-flight decode still yields its image;
+  * whole-batch decode failure skips the jitted device call entirely
+    (no device work for a zero tensor nobody reads), in both the
+    pipelined and the serialized legacy path;
+  * per-stage observability: serve_device_ms / serve_entropy_ms /
+    serve_pipeline_inflight / serve_overlap_ratio are emitted, and the
+    serialized path's overlap ratio is exactly 0 (stage spans nest
+    inside the worker's busy span, so busy >= device+entropy).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import (CompressionService, EncodeResult,
+                            IntegrityError, ServiceConfig)
+from dsin_tpu.serve.service import ENCODE
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.recompile import CompilationSentinel
+
+pytestmark = pytest.mark.chaos
+
+BUCKETS = ((16, 24),)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("pipeline_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def _service(tiny_cfg_files, **over):
+    ae_p, pc_p = tiny_cfg_files
+    kw = dict(ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS,
+              max_batch=2, max_wait_ms=1.0, max_queue=32, workers=1,
+              entropy_workers=2, pipeline_depth=2,
+              restart_backoff_s=0.02, restart_backoff_max_s=0.2)
+    kw.update(over)
+    return CompressionService(ServiceConfig(**kw)).start()
+
+
+def _img(rng):
+    return rng.integers(0, 255, (16, 24, 3), dtype=np.uint8)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return pred()
+
+
+def _wait_healed(svc, timeout=10.0):
+    """Crashed worker restarted AND the pool back at strength. Waiting
+    on live_workers alone is racy: the dying thread is still unwinding
+    (flushing its pipeline) when its batch's futures resolve, so it can
+    be sampled as 'live' before the supervisor has replaced it."""
+    restarts = svc.metrics.counter("serve_worker_restarts")
+    return _wait(lambda: restarts.value >= 1
+                 and svc.live_workers == svc.config.workers, timeout)
+
+
+def test_crash_between_dispatch_and_entropy_no_hung_futures(tiny_cfg_files):
+    """The pipelined-crash acceptance scenario: batch A is dispatched to
+    the device and its entropy task is still running when the worker
+    dies starting batch B. B's callers get the typed crash immediately;
+    A completes through the worker's exit flush; the supervisor heals
+    the pool; zero XLA compiles throughout."""
+    svc = _service(tiny_cfg_files, max_batch=1)
+    a_may_start = threading.Event()   # released once B is queued
+    entropy_gate = threading.Event()  # holds A's entropy open
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(0)
+        calls = []
+
+        def bhook(batch):  # noqa: ARG001 — first batch waits for B
+            calls.append(1)
+            if len(calls) == 1:
+                assert a_may_start.wait(30)
+
+        def ehook(rec, i, req):  # noqa: ARG001 — gate encode entropy
+            if rec.kind == ENCODE:
+                assert entropy_gate.wait(30)
+
+        svc._batch_hook = bhook
+        svc._entropy_hook = ehook
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.worker.batch", action="crash", after=1, times=1)],
+            seed=0)
+        with CompilationSentinel(budget=0, label="pipelined crash"):
+            with faults.installed(plan):
+                fa = svc.submit_encode(_img(rng))   # visit 1: survives
+                fb = svc.submit_encode(_img(rng))   # visit 2: crashes
+                a_may_start.set()
+                # B resolves with the injected crash even though A sits
+                # between device dispatch and entropy completion
+                assert isinstance(fb.exception(timeout=30),
+                                  faults.InjectedCrash)
+                assert plan.activations["serve.worker.batch"] == 1
+                assert not fa.done(), "A finished early — the crash did " \
+                    "not land inside A's pipeline window"
+                entropy_gate.set()
+                assert isinstance(fa.result(timeout=30), EncodeResult)
+            # the worker died AFTER flushing A; supervisor restores the
+            # pool and the healed pipeline serves through the same
+            # executables (the surrounding sentinel pins zero compiles)
+            assert _wait_healed(svc), \
+                f"pool not restored: {svc.live_workers}"
+            res = svc.encode(_img(rng), timeout=30)
+            assert svc.decode(res.stream, timeout=30).shape == (16, 24, 3)
+        assert svc.metrics.counter("serve_worker_crashes").value == 1
+        assert svc.metrics.counter("serve_worker_restarts").value >= 1
+    finally:
+        a_may_start.set()
+        entropy_gate.set()
+        svc._batch_hook = svc._entropy_hook = None
+        svc.drain()
+
+
+def test_crash_flush_still_runs_decode_device_stage(tiny_cfg_files):
+    """Same crash window, but the in-flight batch is a DECODE: its
+    device stage has not run yet when the worker dies, so the exit
+    flush must run it — the caller still gets a real image, not a hang
+    and not an error."""
+    svc = _service(tiny_cfg_files, max_batch=1)
+    a_may_start = threading.Event()
+    entropy_gate = threading.Event()
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(1)
+        stream = svc.encode(_img(rng), timeout=30).stream
+        calls = []
+
+        def bhook(batch):
+            calls.append(batch[0].key[0])
+            if len(calls) == 1:
+                assert calls[0] != ENCODE, "decode batch must go first"
+                assert a_may_start.wait(30)
+
+        def ehook(rec, i, req):  # noqa: ARG001
+            if rec.kind != ENCODE:
+                assert entropy_gate.wait(30)
+
+        svc._batch_hook = bhook
+        svc._entropy_hook = ehook
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.worker.batch", action="crash", after=1, times=1)],
+            seed=0)
+        with faults.installed(plan):
+            fa = svc.submit_decode(stream)          # visit 1: in flight
+            fb = svc.submit_encode(_img(rng))       # visit 2: crashes
+            a_may_start.set()
+            assert isinstance(fb.exception(timeout=30),
+                              faults.InjectedCrash)
+            assert not fa.done()
+            entropy_gate.set()
+            out = fa.result(timeout=30)             # flush ran the device
+            assert out.shape == (16, 24, 3) and out.dtype == np.uint8
+        assert _wait_healed(svc)
+    finally:
+        a_may_start.set()
+        entropy_gate.set()
+        svc._batch_hook = svc._entropy_hook = None
+        svc.drain()
+
+
+@pytest.mark.parametrize("entropy_workers", [2, 0],
+                         ids=["pipelined", "serialized"])
+def test_whole_batch_decode_failure_skips_device(tiny_cfg_files,
+                                                 entropy_workers):
+    """ISSUE 4 satellite: when CRC/decode failures cover the entire
+    batch, the jitted decode call is skipped — the device would only
+    reconstruct a zero tensor nobody reads. Every caller still gets its
+    typed IntegrityError, and the service keeps serving."""
+    svc = _service(tiny_cfg_files, entropy_workers=entropy_workers)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(2)
+        streams = [svc.encode(_img(rng), timeout=30).stream
+                   for _ in range(2)]
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.rans", action="corrupt", probability=1.0)], seed=0)
+        with faults.installed(plan):
+            futs = [svc.submit_decode(s) for s in streams]
+            excs = [f.exception(timeout=30) for f in futs]
+        assert all(isinstance(e, IntegrityError) for e in excs), excs
+        # the futures resolve in the entropy stage; the skip decision is
+        # the FINISH stage's, a beat later on the worker thread
+        skipped = svc.metrics.counter("serve_device_skipped_batches")
+        assert _wait(lambda: skipped.value >= 1), \
+            "whole-batch failure still ran the jitted decode"
+        # fault-free decodes still work afterwards
+        assert svc.decode(streams[0], timeout=30).shape == (16, 24, 3)
+    finally:
+        svc.drain()
+
+
+def test_stage_metrics_and_overlap_ratio_emitted(tiny_cfg_files):
+    """The per-stage observability contract: device/entropy histograms
+    fill, the in-flight gauge exists, and serve_overlap_ratio lands in
+    [0, 1] on the pipelined path."""
+    svc = _service(tiny_cfg_files)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(3)
+        futs = [svc.submit_encode(_img(rng)) for _ in range(8)]
+        for f in futs:
+            assert isinstance(f.result(timeout=30), EncodeResult)
+        # results resolve in the entropy stage; stage metrics publish at
+        # finish — wait for the last batch's finish to land
+        assert _wait(lambda: svc.metrics.histogram(
+            "serve_entropy_ms").summary()["count"] > 0)
+        snap = svc.metrics.snapshot()
+        assert snap["histograms"]["serve_device_ms"]["count"] > 0
+        assert snap["histograms"]["serve_entropy_ms"]["count"] > 0
+        assert "serve_pipeline_inflight" in snap["gauges"]
+        assert 0.0 <= snap["gauges"]["serve_overlap_ratio"] <= 1.0
+        assert snap["accumulators"]["serve_busy_ms_total"] > 0
+    finally:
+        svc.drain()
+
+
+def test_serialized_mode_overlap_ratio_is_zero(tiny_cfg_files):
+    """entropy_workers=0 pins the legacy dataplane: stage spans nest
+    strictly inside the worker's busy span, so the overlap ratio clamps
+    to exactly 0 — the honest baseline the pipelined ratio is read
+    against (and what SERVE_BENCH.json's serialized section shows)."""
+    svc = _service(tiny_cfg_files, entropy_workers=0)
+    try:
+        svc.warmup()
+        rng = np.random.default_rng(4)
+        futs = [svc.submit_encode(_img(rng)) for _ in range(6)]
+        for f in futs:
+            assert isinstance(f.result(timeout=30), EncodeResult)
+        snap = svc.metrics.snapshot()
+        assert snap["gauges"]["serve_overlap_ratio"] == 0.0
+        assert snap["histograms"]["serve_device_ms"]["count"] > 0
+    finally:
+        svc.drain()

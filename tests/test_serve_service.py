@@ -192,6 +192,52 @@ def test_full_queue_rejects_with_service_overloaded(tiny_cfg_files):
         svc.drain()
 
 
+# -- persistent compilation cache (ISSUE 4 satellite) ------------------------
+
+def test_second_service_warms_from_persistent_cache(tiny_cfg_files):
+    """Serve startup wires utils/cache.enable_compilation_cache (via
+    coding/loader.py), so warm-up survives restarts. In-process restart
+    proxy: a SECOND CompressionService builds fresh jit closures (a full
+    retrace, nothing shared in memory), and its warmup must materialize
+    every executable from the on-disk cache — cache_hits == compiles,
+    i.e. zero executables actually rebuilt by XLA."""
+    import jax
+    ae_p, pc_p = tiny_cfg_files
+
+    def build():
+        return CompressionService(ServiceConfig(
+            ae_config=ae_p, pc_config=pc_p, buckets=((16, 24),),
+            max_batch=1, max_wait_ms=0.0, max_queue=8, workers=1)).start()
+
+    svc1 = build()
+    # enable_compilation_cache's 1s floor keeps trivial executables out
+    # of the shared cache; drop it so THIS test's tiny warmup persists
+    # (start() re-raises the floor for later instances — that only
+    # affects writes, and svc1's entries are already on disk by then)
+    prev_floor = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        warm1 = svc1.warmup()
+        assert warm1["compiles"] > 0
+        svc1.drain()
+        svc2 = build()
+        try:
+            warm2 = svc2.warmup()
+            assert warm2["compiles"] > 0, "vacuous: nothing materialized"
+            assert warm2["cache_hits"] == warm2["compiles"], (
+                f"second service rebuilt "
+                f"{warm2['compiles'] - warm2['cache_hits']} executables "
+                f"instead of loading them from the persistent cache: "
+                f"{warm2}")
+        finally:
+            svc2.drain()
+    finally:
+        svc1.drain()
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_floor)
+
+
 # -- graceful drain (utils/signals.py path) ----------------------------------
 
 def test_sigterm_drains_in_flight_completes_queued_rejected(tiny_cfg_files):

@@ -21,6 +21,13 @@ bit-flipped frames rejected at submit; (C) worker-side integrity — the
 resolve IntegrityError; (D) fault-free decodes — the service still
 serves cleanly after the chaos.
 
+Since ISSUE 4 the default run exercises the PIPELINED dataplane
+(entropy_workers > 0): crashes land while other batches sit between
+device dispatch and entropy-pool completion, and the serve.rans site
+fires inside pool tasks — the invariants above (zero hung futures in
+particular) must hold regardless. `--entropy_workers 0` soaks the
+serialized legacy path.
+
 Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
 (tests/test_tools_smoke.py) and the `chaos-smoke` stage of
 tools/tpu_session.sh.
@@ -91,7 +98,8 @@ def run_chaos(args) -> dict:
         ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
         seed=args.seed, buckets=buckets, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
-        workers=args.workers, restart_backoff_s=0.02,
+        workers=args.workers, entropy_workers=args.entropy_workers,
+        pipeline_depth=args.pipeline_depth, restart_backoff_s=0.02,
         restart_backoff_max_s=0.25)
     service = CompressionService(cfg).start()
     warm = service.warmup()
@@ -216,7 +224,10 @@ def run_chaos(args) -> dict:
         "config": {
             "shapes": [list(s) for s in shapes],
             "buckets": [list(b) for b in buckets],
-            "workers": args.workers, "max_batch": args.max_batch,
+            "workers": args.workers,
+            "entropy_workers": service._entropy_workers,
+            "pipeline_depth": args.pipeline_depth,
+            "max_batch": args.max_batch,
             "max_queue": args.max_queue, "requests": args.requests,
             "crashes": args.crashes,
             "crash_probability": args.crash_probability,
@@ -275,6 +286,14 @@ def main(argv=None) -> int:
     p.add_argument("--buckets", default="24,32 32,48")
     p.add_argument("--requests", type=int, default=120)
     p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--entropy_workers", type=int, default=None,
+                   help="rANS pool size (0 = serialized legacy path; "
+                        "default: the ServiceConfig auto policy). The "
+                        "default exercises the PIPELINED dataplane: "
+                        "crashes/corruption land while batches are in "
+                        "flight between device dispatch and entropy "
+                        "completion, and the invariants must still hold")
+    p.add_argument("--pipeline_depth", type=int, default=2)
     p.add_argument("--max_batch", type=int, default=2)
     p.add_argument("--max_wait_ms", type=float, default=2.0)
     p.add_argument("--max_queue", type=int, default=64)
@@ -295,7 +314,13 @@ def main(argv=None) -> int:
         args.ae_config, args.pc_config = _smoke_cfgs(tempfile.mkdtemp())
         args.requests = 40
         args.crashes = 2
-        args.crash_probability = 0.15
+        # deterministic, not probabilistic, in CI: batch composition
+        # (and so the per-site visit count) depends on scheduler timing,
+        # and 0.15^-style draws left a few-percent chance of a run whose
+        # visits produce ZERO crashes — which then fails the
+        # worker_restarts>=1 contract. p=1.0 fires the capped 2 crashes
+        # at the first two eligible visits regardless of timing.
+        args.crash_probability = 1.0
         args.corrupt_streams = 6
 
     report = run_chaos(args)

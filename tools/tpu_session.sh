@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint chaos-smoke bench checks breakdown mfu rd_sweep
+# Stages: lint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint chaos-smoke bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -44,6 +44,21 @@ chaos-smoke)
   if [ "$rc" -ne 0 ]; then
     cat artifacts/chaos_smoke.log
     echo "TPU_SESSION_FAILED: chaos-smoke (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
+serve-smoke)
+  # serialized-vs-pipelined serve comparison on CPU before chip time:
+  # tools/serve_bench.py --smoke runs the same open-loop stream through
+  # both dataplanes and FAILS unless serve_overlap_ratio > 0.25 and the
+  # median pair speedup clears the broken-pipeline floor (ISSUE 4; the
+  # committed SERVE_BENCH.json carries the full speedup evidence)
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke \
+    --out artifacts/serve_smoke.json > artifacts/serve_smoke.log 2>&1 \
+    || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/serve_smoke.log
+    echo "TPU_SESSION_FAILED: serve-smoke (queue aborted before chip stages)"
     exit 1
   fi
   ;;
@@ -118,7 +133,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint chaos-smoke bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
