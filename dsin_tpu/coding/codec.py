@@ -55,7 +55,6 @@ from __future__ import annotations
 
 import functools
 import struct
-import threading
 from typing import Optional, Tuple
 
 import jax
@@ -64,6 +63,7 @@ import numpy as np
 
 from dsin_tpu.coding import rans
 from dsin_tpu.models import probclass as pc_lib
+from dsin_tpu.utils import locks as locks_lib
 
 MAGIC = b"DTPC"
 VERSION = 2
@@ -129,17 +129,26 @@ class BottleneckCodec:
         # one bucket size so encode and decode hit the same executable.
         self._block_logits_batch = functools.partial(
             jax.jit(jax.vmap(_block_logits, in_axes=(None, 0))), variables)
-        self._incremental = None  # lazy numpy engine (wavefront_np mode)
-        self._incremental_lock = threading.Lock()
+        # lazy numpy engine (wavefront_np mode)
+        self._incremental = None  # guarded-by: self._incremental_lock
+        self._incremental_lock = locks_lib.RankedLock("codec.engine")
 
     def _incremental_engine(self):
         with self._incremental_lock:
             if self._incremental is None:
                 from dsin_tpu.coding.incremental import IncrementalResShallow
+                # one-shot device->host param pull held under the lock
+                # on purpose: every caller needs the engine before
+                # proceeding, so the convoy IS the point (N racing
+                # builders would each pay the transfer only to discard
+                # N-1 engines). The blocking-call-under-lock rule does
+                # not see np.asarray as tree_map's callable — this is
+                # intent prose, not a policed suppression.
                 params_np = jax.tree_util.tree_map(np.asarray,
                                                    self.pc_params)
                 self._incremental = IncrementalResShallow(
-                    params_np, self.centers, self.pc_config, self.pad_value)
+                    params_np, self.centers, self.pc_config,
+                    self.pad_value)
             return self._incremental
 
     def thread_clone(self) -> "BottleneckCodec":

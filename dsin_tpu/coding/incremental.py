@@ -42,12 +42,12 @@ schedule cache — is guarded by a lock in `schedule()`.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Tuple
 
 import numpy as np
 
 from dsin_tpu.models import probclass as pc_lib
+from dsin_tpu.utils import locks as locks_lib
 
 
 def wavefront_coeffs(pad: int) -> Tuple[int, int]:
@@ -186,8 +186,9 @@ class IncrementalResShallow:
                                      dtype=np.float32))
         self.centers = np.asarray(centers, dtype=np.float32)
         self.pad_value = np.float32(pad_value)
+        # guarded-by: self._sched_lock
         self._schedules: Dict[Tuple[int, int, int], _Schedule] = {}
-        self._sched_lock = threading.Lock()
+        self._sched_lock = locks_lib.RankedLock("codec.schedules")
 
     def schedule(self, shape: Tuple[int, int, int]) -> _Schedule:
         shape = tuple(int(s) for s in shape)

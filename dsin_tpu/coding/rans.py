@@ -18,10 +18,11 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional, Sequence
 
 import numpy as np
+
+from dsin_tpu.utils import locks as locks_lib
 
 RANS_L = 1 << 23
 DEFAULT_SCALE_BITS = 16
@@ -31,9 +32,9 @@ _SRC = os.path.join(_HERE, "native", "range_coder.cpp")
 _BUILD_DIR = os.path.join(_HERE, "native", "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "librange_coder.so")
 
-_lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_lib_tried = False
+_lib_lock = locks_lib.RankedLock("rans.native")
+_lib: Optional[ctypes.CDLL] = None    # guarded-by: _lib_lock (module)
+_lib_tried = False                    # guarded-by: _lib_lock (module)
 
 
 class _NativeLoadError(RuntimeError):

@@ -23,8 +23,11 @@ waits up to `max_wait_ms` for the chosen key's queue to fill to
 `max_batch` — the head request's age bounds added latency, late
 same-bucket arrivals ride along free.
 
-Pure stdlib threading (one Condition), so tier-1 exercises all of it on
-CPU with no jax in sight.
+All batcher state lives under ONE condition — the named
+`serve.batcher` rung (rank 10, the hierarchy's outermost: the
+`on_expired` callback runs under it and reports into the metrics leaf
+locks, utils/locks.py) — so tier-1 exercises all of it on CPU with no
+jax in sight.
 """
 
 from __future__ import annotations
@@ -34,6 +37,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Hashable, List, Optional
+
+from dsin_tpu.utils import locks as locks_lib
 
 
 class ServeError(RuntimeError):
@@ -124,12 +129,13 @@ class MicroBatcher:
         #: called with the count of deadline-expired requests (under the
         #: batcher lock — keep it leaf-locked and cheap, e.g. a counter)
         self.on_expired = on_expired
-        self._cond = threading.Condition()
-        self._queues: Dict[Hashable, deque] = {}
-        self._order: List[Hashable] = []   # live keys, first-seen order
-        self._rr = 0                       # ring index of the next probe
-        self._depth = 0
-        self._closed = False
+        self._cond = locks_lib.RankedCondition("serve.batcher")
+        self._queues: Dict[Hashable, deque] = {}  # guarded-by: self._cond
+        # live keys in first-seen order / ring index of the next probe
+        self._order: List[Hashable] = []   # guarded-by: self._cond
+        self._rr = 0                       # guarded-by: self._cond
+        self._depth = 0                    # guarded-by: self._cond
+        self._closed = False               # guarded-by: self._cond
 
     # -- producer side ------------------------------------------------------
 

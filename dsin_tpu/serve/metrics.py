@@ -26,11 +26,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import urlparse
 
+from dsin_tpu.utils import locks as locks_lib
+
 
 class Counter:
     def __init__(self):
-        self._lock = threading.Lock()
-        self._value = 0
+        self._lock = locks_lib.RankedLock("metrics.metric")
+        self._value = 0                    # guarded-by: self._lock
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -44,8 +46,8 @@ class Counter:
 
 class Gauge:
     def __init__(self):
-        self._lock = threading.Lock()
-        self._value = 0.0
+        self._lock = locks_lib.RankedLock("metrics.metric")
+        self._value = 0.0                  # guarded-by: self._lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -64,8 +66,8 @@ class Accumulator:
     can be recomputed from the snapshot alone."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._value = 0.0
+        self._lock = locks_lib.RankedLock("metrics.metric")
+        self._value = 0.0                  # guarded-by: self._lock
 
     def add(self, v: float) -> None:
         with self._lock:
@@ -82,10 +84,10 @@ class Histogram:
     observed, quantiles over the most recent `maxlen` samples."""
 
     def __init__(self, maxlen: int = 4096):
-        self._lock = threading.Lock()
-        self._window: deque = deque(maxlen=maxlen)
-        self._count = 0
-        self._sum = 0.0
+        self._lock = locks_lib.RankedLock("metrics.metric")
+        self._window: deque = deque(maxlen=maxlen)  # guarded-by: self._lock
+        self._count = 0                    # guarded-by: self._lock
+        self._sum = 0.0                    # guarded-by: self._lock
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -118,27 +120,44 @@ class MetricsRegistry:
     `registry.counter('x').inc()` without wiring declarations around."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._histograms: Dict[str, Histogram] = {}
-        self._accumulators: Dict[str, Accumulator] = {}
+        self._lock = locks_lib.RankedLock("metrics.registry")
+        self._counters: Dict[str, Counter] = {}          # guarded-by: self._lock
+        self._gauges: Dict[str, Gauge] = {}              # guarded-by: self._lock
+        self._histograms: Dict[str, Histogram] = {}      # guarded-by: self._lock
+        self._accumulators: Dict[str, Accumulator] = {}  # guarded-by: self._lock
+
+    # construct only on miss (not setdefault's eager default): building
+    # a metric builds its RankedLock, which registers a stats ledger —
+    # per-call throwaway construction would funnel every hot-path
+    # accessor hit through that registration
 
     def counter(self, name: str) -> Counter:
         with self._lock:
-            return self._counters.setdefault(name, Counter())
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            return c
 
     def gauge(self, name: str) -> Gauge:
         with self._lock:
-            return self._gauges.setdefault(name, Gauge())
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge()
+            return g
 
     def histogram(self, name: str) -> Histogram:
         with self._lock:
-            return self._histograms.setdefault(name, Histogram())
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram()
+            return h
 
     def accumulator(self, name: str) -> Accumulator:
         with self._lock:
-            return self._accumulators.setdefault(name, Accumulator())
+            a = self._accumulators.get(name)
+            if a is None:
+                a = self._accumulators[name] = Accumulator()
+            return a
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -153,6 +172,11 @@ class MetricsRegistry:
                            for k, h in sorted(histograms.items())},
             "accumulators": {k: a.value
                              for k, a in sorted(accumulators.items())},
+            # the ranked-lock ledgers (hold time, contention, inversions
+            # — utils/locks.py) ride the same snapshot so one /metrics
+            # scrape answers "is anything fighting over a lock"
+            "locks": locks_lib.stats_snapshot(),
+            "lock_order_inversions": locks_lib.inversion_count(),
         }
 
     def render_text(self) -> str:
@@ -168,6 +192,14 @@ class MetricsRegistry:
             lines.append(f"{k}_count {s['count']}")
             for stat in ("mean", "p50", "p99"):
                 lines.append(f"{k}_{stat} {s[stat]:g}")
+        for name, s in snap["locks"].items():
+            stem = "lock_" + name.replace(".", "_")
+            lines.append(f"{stem}_acquisitions_total "
+                         f"{s['acquisitions']}")
+            lines.append(f"{stem}_contentions_total {s['contentions']}")
+            lines.append(f"{stem}_hold_ms_total {s['hold_ms_total']:g}")
+        lines.append(f"lock_order_inversions_total "
+                     f"{snap['lock_order_inversions']}")
         return "\n".join(lines) + "\n"
 
 

@@ -95,6 +95,7 @@ from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve.batcher import (Future, MicroBatcher, Request,
                                     ServiceDraining, ServiceUnavailable)
 from dsin_tpu.utils import faults, recompile
+from dsin_tpu.utils import locks as locks_lib
 from dsin_tpu.utils.integrity import IntegrityError, frame_crc, verify_crc
 from dsin_tpu.utils.retry import RetryPolicy
 
@@ -225,15 +226,18 @@ class _DeviceBatch:
     __slots__ = ("_lock", "_dev", "_host", "dispatched", "transfer_done")
 
     def __init__(self, dev):
-        self._lock = threading.Lock()
-        self._dev = dev
-        self._host = None
+        self._lock = locks_lib.RankedLock("serve.device_batch")
+        self._dev = dev                      # guarded-by: self._lock
+        self._host = None                    # guarded-by: self._lock
         self.dispatched = time.monotonic()
-        self.transfer_done: Optional[float] = None
+        self.transfer_done: Optional[float] = None  # guarded-by: self._lock
 
     def host(self) -> np.ndarray:
         with self._lock:
             if self._host is None:
+                # jaxlint: disable=blocking-call-under-lock -- the point
+                # of this class: ONE shared device->host transfer;
+                # sibling tasks block briefly and reuse the copy
                 self._host = np.asarray(self._dev)
                 self._dev = None
                 self.transfer_done = time.monotonic()
@@ -241,8 +245,10 @@ class _DeviceBatch:
 
     @property
     def device_ms(self) -> float:
-        done = self.transfer_done if self.transfer_done is not None \
-            else time.monotonic()
+        with self._lock:
+            done = self.transfer_done
+        if done is None:
+            done = time.monotonic()
         return (done - self.dispatched) * 1e3
 
 
@@ -281,11 +287,12 @@ class CompressionService:
             config.max_batch, config.max_wait_ms, config.max_queue,
             on_expired=lambda n: self.metrics.counter(
                 "serve_rejected_deadline").inc(n))
-        self._workers = []
-        self._workers_lock = threading.Lock()
-        self._worker_exits = {}            # slot -> last fatal BaseException
-        self._restarts = []                # slot -> consecutive restarts
-        self._restart_at = []              # slot -> monotonic restart time
+        self._workers = []                 # guarded-by: self._workers_lock
+        self._workers_lock = locks_lib.RankedLock("serve.workers")
+        # slot -> last fatal exit / consecutive restarts / restart time
+        self._worker_exits = {}            # guarded-by: self._workers_lock
+        self._restarts = []                # guarded-by: self._workers_lock
+        self._restart_at = []              # guarded-by: self._workers_lock
         self._restart_policy = RetryPolicy(
             max_attempts=1 << 30,          # supervise forever; cap is on
             base_delay_s=config.restart_backoff_s,   # the DELAY, not the

@@ -115,6 +115,29 @@ def _prev_dirs(parent: str, name: str) -> List[str]:
                   if e.startswith(prefix))
 
 
+def _rescue_nested_dirs(src_dir: str, live_dir: str) -> None:
+    """Move foreign SUBDIRECTORIES (nested periodic/emergency
+    checkpoints — a checkpoint's own payload is files-only) out of a
+    rotated-aside dir into the live dir. The live dir's copy, when one
+    exists, is newer (it was carried at swap time) and wins."""
+    try:
+        entries = os.listdir(src_dir)
+    except OSError:
+        return
+    moved = False
+    for entry in entries:
+        src = os.path.join(src_dir, entry)
+        dst = os.path.join(live_dir, entry)
+        if os.path.isdir(src) and not os.path.exists(dst):
+            try:
+                os.rename(src, dst)
+                moved = True
+            except OSError:
+                pass   # cross-device or racing saver: leave it in place
+    if moved:
+        _fsync_dir(live_dir)
+
+
 def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
                     extra_meta: Optional[Dict[str, Any]] = None,
                     keep_last: int = 1) -> None:
@@ -172,8 +195,28 @@ def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
         faults.inject("ckpt.swap")    # the kill window between renames
     os.rename(tmp, ckpt_dir)
     _fsync_dir(parent)
+    # a checkpoint's own payload is files-only, so any SUBDIRECTORY in a
+    # rotated-aside dir is foreign nested content — e.g. the periodic/
+    # and emergency/ checkpoints main.py keeps inside ckpt_dir. Carry it
+    # from the NEWEST .prev into the fresh live dir: leaving it there
+    # would strand it and the keep_last prune below would silently
+    # delete the very saves that bound crash loss. The newest prev is
+    # consulted even when THIS save found no live dir to rotate — that
+    # is exactly the resume-after-a-kill-in-the-swap-window state, where
+    # the nested content sits in a prev that may be KEPT (not pruned)
+    # for several more saves. EVERY prev is swept, newest first (newest
+    # copy wins — _rescue only fills absences): with keep_last >= 2 a
+    # kill before a previous save's rescue leaves the content in a prev
+    # that is neither the newest nor due for pruning.
+    for prev in reversed(_prev_dirs(parent, name)):
+        _rescue_nested_dirs(prev, ckpt_dir)
 
     for old in _prev_dirs(parent, name)[:-keep_last if keep_last else None]:
+        # rescue again right before deleting: a kill between the swap
+        # above and its carry-over leaves nested content only in a
+        # .prev dir — the prune must never be the thing that destroys
+        # the last copy of a periodic/emergency checkpoint
+        _rescue_nested_dirs(old, ckpt_dir)
         shutil.rmtree(old, ignore_errors=True)
 
 

@@ -28,12 +28,13 @@ produces the same faults.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from collections import Counter
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
+
+from dsin_tpu.utils import locks as locks_lib
 
 SITES = ("serve.worker.batch", "serve.rans", "ckpt.write", "ckpt.swap",
          "io.read")
@@ -105,9 +106,9 @@ class FaultPlan:
         self.visits: Counter = Counter()
         self.activations: Counter = Counter()
         self.log: List[Activation] = []
-        self._rng = random.Random(seed)
-        self._fired = [0] * len(self.specs)
-        self._lock = threading.Lock()
+        self._rng = random.Random(seed)     # guarded-by: self._lock
+        self._fired = [0] * len(self.specs)  # guarded-by: self._lock
+        self._lock = locks_lib.RankedLock("faults.plan")
 
     def _select(self, site: str) -> Optional[Tuple[FaultSpec, int]]:
         """Count one visit at `site`; return the first spec that fires

@@ -1,0 +1,402 @@
+"""Named, hierarchy-ranked lock wrappers — the runtime half of threadlint.
+
+PRs 2-4 grew a threaded serving stack whose safety contracts lived in
+docstrings ("the schedule cache is lock-guarded", "callbacks under the
+batcher lock must stay leaf-locked"). This module turns the two
+contracts a machine can check at runtime into code:
+
+* **Lock ordering.** Every lock in `dsin_tpu/` is constructed through
+  `RankedLock`/`RankedCondition` with a name from the repo-wide
+  `HIERARCHY` below (raw `threading.Lock()` construction elsewhere is a
+  threadlint finding, tools/jaxlint/concurrency.py). Acquires must be
+  strictly rank-increasing per thread: taking a lock whose rank is <=
+  any lock the thread already holds is a *lock-order inversion* — the
+  shape every cross-thread deadlock needs — and raises
+  `LockOrderViolation` at acquire time (long before an actual deadlock
+  needs the unlucky interleaving to manifest). The check is two list
+  reads behind one module flag, cheap enough to stay on in production.
+
+* **Observability.** Per-lock acquisition / contention counts and
+  hold-time totals aggregate by lock NAME (instances of the same rung,
+  e.g. every `metrics.metric` leaf lock, share one ledger) and surface
+  through `stats_snapshot()` — `serve/metrics.py` folds them into
+  `/metrics`, and `tools/chaos_bench.py` asserts zero inversions under
+  the seeded soak.
+
+The repo lock hierarchy (rank ascending = acquire order outer->inner;
+a thread holding rank r may only acquire ranks > r):
+
+    rank  name                where
+      10  serve.batcher       MicroBatcher's condition (serve/batcher.py)
+      20  serve.workers       worker-pool bookkeeping (serve/service.py)
+      30  codec.engine        lazy incremental-engine slot (coding/codec.py)
+      35  codec.schedules     per-shape schedule cache (coding/incremental.py)
+      40  rans.native         native-library load (coding/rans.py)
+      50  serve.device_batch  shared device->host transfer (serve/service.py)
+      60  faults.plan         fault-plan bookkeeping (utils/faults.py)
+      70  recompile.counter   XLA compile listener (utils/recompile.py)
+      80  metrics.registry    metric-name namespace (serve/metrics.py)
+      90  metrics.metric      per-metric leaf locks (serve/metrics.py)
+
+The leaf rungs are deliberately the metrics locks: every layer reports
+into metrics (the batcher's `on_expired` callback fires under rank 10,
+the supervisor increments counters under rank 20), so counters must be
+acquirable while anything else is held — which is exactly "highest
+rank". Growing the hierarchy: give a new lock a rank strictly between
+its outermost caller and the innermost thing its critical section
+touches; never reuse a rank (equal ranks cannot nest, by design).
+
+Tests force interleavings deterministically through
+`set_acquire_hook(fn)`: `fn(lock)` runs at the top of every acquire, so
+a test can park one thread at a specific lock until another thread has
+won the race (tests/test_serve_batcher.py's deadline-vs-drain races).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: the repo-wide lock hierarchy: name -> rank. See the module docstring
+#: for the rationale per rung.
+HIERARCHY: Dict[str, int] = {
+    "serve.batcher": 10,
+    "serve.workers": 20,
+    "codec.engine": 30,
+    "codec.schedules": 35,
+    "rans.native": 40,
+    "serve.device_batch": 50,
+    "faults.plan": 60,
+    "recompile.counter": 70,
+    "metrics.registry": 80,
+    "metrics.metric": 90,
+}
+
+
+class LockOrderViolation(AssertionError):
+    """A thread tried to acquire a lock at a rank <= one it already
+    holds — the acquisition pattern every lock-order deadlock needs.
+    Raised at acquire time so the bug surfaces deterministically instead
+    of waiting for the losing interleaving in production."""
+
+
+class _LockStats:
+    """Per-NAME ledger (instances of a rung share it). Each ledger owns
+    its own raw micro-lock so two different rungs' releases never
+    serialize against each other — a single global stats mutex would
+    funnel EVERY lock release in the process (including the hot
+    metrics.metric leaves) through one point.
+
+    Deliberate trade-off: same-rung instances DO share one micro-lock
+    (every metrics.metric leaf updates the same ledger). The
+    alternative — per-instance plain counters folded at snapshot time —
+    needs a weak registry of every live lock and makes snapshots O(live
+    instances) (_DeviceBatch mints one lock per batch). A shared
+    uncontended raw-lock bump is ~100ns on a path that runs per
+    request/batch, not per symbol; the serve/chaos relative perf gates
+    hold with it in place. Revisit only if a profile shows this ledger
+    contended."""
+
+    __slots__ = ("lock", "acquisitions", "contentions", "hold_ms_total",
+                 "max_hold_ms", "inversions")
+
+    def __init__(self):
+        # raw by necessity: the wrappers cannot bootstrap on themselves.
+        # Leaf by construction — nothing under it touches another lock.
+        # jaxlint: disable=raw-lock-construction -- wrapper-internal per-ledger micro-lock; a RankedLock here would recurse
+        self.lock = threading.Lock()
+        self.acquisitions = 0
+        self.contentions = 0
+        self.hold_ms_total = 0.0
+        self.max_hold_ms = 0.0
+        self.inversions = 0
+
+    def zero_locked(self) -> None:
+        self.acquisitions = 0
+        self.contentions = 0
+        self.hold_ms_total = 0.0
+        self.max_hold_ms = 0.0
+        self.inversions = 0
+
+    def as_dict(self) -> dict:
+        with self.lock:
+            return {"acquisitions": self.acquisitions,
+                    "contentions": self.contentions,
+                    "hold_ms_total": round(self.hold_ms_total, 3),
+                    "max_hold_ms": round(self.max_hold_ms, 3),
+                    "inversions": self.inversions}
+
+
+# registry lock: guards the _stats dict shape and the inversion log
+# ONLY (never the per-ledger counters — those live under each ledger's
+# own micro-lock, see _LockStats). Raw by necessity, leaf by
+# construction.
+# jaxlint: disable=raw-lock-construction -- the wrapper module's own internal leaf lock; cannot be a RankedLock without infinite regress
+_meta_lock = threading.Lock()
+_stats: Dict[str, _LockStats] = {}    # guarded-by: _meta_lock (module)
+_inversion_log: List[str] = []        # guarded-by: _meta_lock (module)
+
+_tls = threading.local()            # per-thread stack of held RankedLocks
+
+#: one module flag for every assert-style check (ordering + equal-rank
+#: nesting). Default ON — the checks are two list reads per acquire.
+_enforce = os.environ.get("DSIN_LOCK_CHECKS", "1") != "0"
+
+#: test-only deterministic interleaving point: called as fn(lock) at the
+#: top of every acquire when set. One None check on the hot path.
+_acquire_hook: Optional[Callable[["RankedLock"], None]] = None
+
+
+def set_enforcement(on: bool) -> bool:
+    """Flip the lock-discipline checks; returns the previous value."""
+    global _enforce
+    prev = _enforce
+    _enforce = bool(on)
+    return prev
+
+
+def enforcement_enabled() -> bool:
+    return _enforce
+
+
+def set_acquire_hook(fn: Optional[Callable[["RankedLock"], None]]
+                     ) -> Optional[Callable]:
+    """Install (or clear, with None) the deterministic acquire hook.
+    Returns the previous hook so tests can restore it."""
+    global _acquire_hook
+    prev = _acquire_hook
+    _acquire_hook = fn
+    return prev
+
+
+def _held_stack() -> List["RankedLock"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def held_locks() -> Tuple[str, ...]:
+    """Names of the locks the CURRENT thread holds, outermost first
+    (diagnostics and tests)."""
+    return tuple(lk.name for lk in _held_stack())
+
+
+def _stats_for(name: str) -> _LockStats:
+    with _meta_lock:
+        s = _stats.get(name)
+        if s is None:
+            s = _stats[name] = _LockStats()
+        return s
+
+
+def stats_snapshot() -> Dict[str, dict]:
+    """{name: {acquisitions, contentions, hold_ms_total, max_hold_ms,
+    inversions}} for every lock name seen so far."""
+    with _meta_lock:
+        return {name: s.as_dict() for name, s in sorted(_stats.items())}
+
+
+def inversion_count() -> int:
+    with _meta_lock:
+        return len(_inversion_log)
+
+
+def inversions() -> List[str]:
+    """The recorded inversion descriptions ("held -> attempted")."""
+    with _meta_lock:
+        return list(_inversion_log)
+
+
+def reset_stats() -> None:
+    """Zero every ledger and the inversion log (benches and tests).
+    Ledgers are zeroed IN PLACE — existing RankedLock instances cache a
+    reference to theirs at construction, so dropping the dict would
+    orphan every pre-existing lock's accounting."""
+    with _meta_lock:
+        for s in _stats.values():
+            with s.lock:
+                s.zero_locked()
+        _inversion_log.clear()
+
+
+class RankedLock:
+    """A named `threading.Lock` with hierarchy enforcement and stats.
+
+    `name` must appear in `HIERARCHY` unless an explicit `rank` is
+    given (ad-hoc ranks are for tests; production locks belong in the
+    table so the repo has ONE ordering story).
+    """
+
+    __slots__ = ("name", "rank", "_lock", "_stats", "_t_acquire")
+
+    def __init__(self, name: str, rank: Optional[int] = None):
+        if rank is None:
+            rank = HIERARCHY.get(name)
+            if rank is None:
+                raise ValueError(
+                    f"lock name {name!r} is not in the repo hierarchy — "
+                    f"add it to dsin_tpu/utils/locks.HIERARCHY (or pass "
+                    f"an explicit rank= in tests)")
+        self.name = name
+        self.rank = int(rank)
+        # jaxlint: disable=raw-lock-construction -- this IS the sanctioned wrapper; the one place raw primitives are built
+        self._lock = threading.Lock()
+        self._stats = _stats_for(name)
+        self._t_acquire = 0.0
+
+    # -- discipline ---------------------------------------------------------
+
+    def _check_order(self) -> None:
+        stack = _held_stack()
+        if not stack:
+            return
+        top = stack[-1]
+        # the stack is rank-sorted by induction (every push passed this
+        # check), so comparing against the top suffices
+        if top.rank >= self.rank:
+            desc = (f"{top.name}(rank {top.rank}) -> "
+                    f"{self.name}(rank {self.rank})")
+            with _meta_lock:
+                _inversion_log.append(desc)
+            with self._stats.lock:
+                self._stats.inversions += 1
+            raise LockOrderViolation(
+                f"lock-order inversion: thread "
+                f"{threading.current_thread().name!r} holds {top.name} "
+                f"(rank {top.rank}) and tried to acquire {self.name} "
+                f"(rank {self.rank}) — acquires must be strictly "
+                f"rank-increasing (hierarchy: dsin_tpu/utils/locks.py)")
+
+    def _note_acquired(self) -> None:
+        _held_stack().append(self)
+        self._t_acquire = time.monotonic()
+
+    def _note_released(self) -> None:
+        held_ms = (time.monotonic() - self._t_acquire) * 1e3
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        s = self._stats
+        with s.lock:
+            s.acquisitions += 1
+            s.hold_ms_total += held_ms
+            if held_ms > s.max_hold_ms:
+                s.max_hold_ms = held_ms
+
+    # -- lock API -----------------------------------------------------------
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        hook = _acquire_hook
+        if hook is not None:
+            hook(self)
+        if _enforce:
+            self._check_order()
+        if self._lock.acquire(False):
+            self._note_acquired()
+            return True
+        with self._stats.lock:
+            self._stats.contentions += 1
+        if not blocking:
+            return False
+        if not self._lock.acquire(True, timeout):
+            return False
+        self._note_acquired()
+        return True
+
+    def release(self) -> None:
+        self._note_released()
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "RankedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"RankedLock({self.name!r}, rank={self.rank})"
+
+
+class RankedCondition:
+    """A `threading.Condition` over a RankedLock: `with cond:` runs the
+    ordering check and stats; `wait()` books the release/re-acquire the
+    underlying condition performs, so hold-time excludes the sleep and
+    the per-thread held-stack stays truthful while waiting."""
+
+    __slots__ = ("_rlock", "_cond")
+
+    def __init__(self, name: str, rank: Optional[int] = None):
+        self._rlock = RankedLock(name, rank)
+        # jaxlint: disable=raw-lock-construction -- wrapper-internal: the Condition shares the RankedLock's raw lock so wait() keeps single-lock semantics
+        self._cond = threading.Condition(self._rlock._lock)
+
+    @property
+    def name(self) -> str:
+        return self._rlock.name
+
+    @property
+    def rank(self) -> int:
+        return self._rlock.rank
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._rlock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._rlock.release()
+
+    def __enter__(self) -> "RankedCondition":
+        self._rlock.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._rlock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if _enforce:
+            stack = _held_stack()
+            if not stack or stack[-1] is not self._rlock:
+                # waiting while holding an INNER lock is the same
+                # deadlock shape as an inverted acquire (the inner lock
+                # stays held across the park, and the mid-stack pop
+                # would also break _check_order's rank-sorted-stack
+                # invariant) — refuse it the same way
+                inner = [lk.name for lk in stack
+                         if lk is not self._rlock]
+                desc = f"wait on {self.name} while holding {inner}"
+                with _meta_lock:
+                    _inversion_log.append(desc)
+                with self._rlock._stats.lock:
+                    self._rlock._stats.inversions += 1
+                raise LockOrderViolation(
+                    f"{self.name}.wait() called while the thread holds "
+                    f"inner locks {inner} — those stay locked for the "
+                    f"whole park, deadlocking whoever must notify; "
+                    f"release them before waiting")
+        # the condition releases the raw lock internally; mirror that in
+        # the wrapper's books so (a) hold-time measures the critical
+        # section, not the sleep, and (b) the held-stack does not claim
+        # a lock the thread does not hold while parked
+        self._rlock._note_released()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._rlock._note_acquired()
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"RankedCondition({self.name!r}, rank={self.rank})"

@@ -25,10 +25,11 @@ Use:
 from __future__ import annotations
 
 import functools
-import threading
 from typing import Callable, Optional
 
 import jax.monitoring
+
+from dsin_tpu.utils import locks as locks_lib
 
 #: events that mean "XLA built a new executable". jaxpr_trace fires for
 #: cheap retraces that hit the executable cache; backend_compile is the
@@ -47,10 +48,10 @@ _CACHE_HIT_EVENTS = frozenset({
     "/jax/compilation_cache/cache_retrieval_time_sec",
 })
 
-_lock = threading.Lock()
-_installed = False
-_count = 0
-_cache_hits = 0
+_lock = locks_lib.RankedLock("recompile.counter")
+_installed = False                    # guarded-by: _lock (module)
+_count = 0                            # guarded-by: _lock (module)
+_cache_hits = 0                       # guarded-by: _lock (module)
 
 
 def _listener(event: str, duration: float, **kwargs) -> None:
@@ -76,7 +77,8 @@ def install() -> None:
 def compilation_count() -> int:
     """Backend compiles observed process-wide since install()."""
     install()
-    return _count
+    with _lock:
+        return _count
 
 
 def cache_hit_count() -> int:
@@ -85,7 +87,8 @@ def cache_hit_count() -> int:
     a region whose compile delta equals its cache-hit delta built zero
     new executables — the warm-restart property serve warmup reports."""
     install()
-    return _cache_hits
+    with _lock:
+        return _cache_hits
 
 
 class RecompilationBudgetExceeded(AssertionError):

@@ -1,0 +1,32 @@
+"""Bad: blocking work inside lock-shaped `with` blocks."""
+
+import time
+
+import numpy as np
+
+from dsin_tpu.utils.locks import RankedLock
+
+
+class Pipeline:
+    def __init__(self, lock, pool):
+        self._lock = lock
+        self._pool = pool
+
+    def gather(self, future, dev):
+        with self._lock:
+            out = future.result()           # fires
+            host = np.asarray(dev)          # fires: device->host transfer
+        return out, host
+
+    def pace(self):
+        with self._lock:
+            time.sleep(0.1)                 # fires
+
+    def stop(self, worker):
+        lock = RankedLock("serve.workers")
+        with lock:
+            worker.join()                   # fires
+
+    def drain(self, work_queue):
+        with self._lock:
+            return work_queue.get()         # fires: blocking queue pop

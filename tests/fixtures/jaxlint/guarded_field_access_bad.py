@@ -1,0 +1,48 @@
+"""Bad: fields declared guarded-by a lock, touched without it."""
+
+from dsin_tpu.utils.locks import RankedLock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = RankedLock("metrics.registry")
+        self._items = {}        # guarded-by: self._lock
+        self._depth = 0         # guarded-by: self._lock
+
+    def add(self, key, value):
+        self._items[key] = value        # fires: no lock held
+        with self._lock:
+            self._depth += 1            # ok
+
+    def depth_racy(self):
+        return self._depth              # fires: read outside the lock
+
+    def flush_async(self):
+        with self._lock:
+            def later():
+                # fires: the closure runs after the with exited
+                self._items.clear()
+            return later
+
+
+_TOTAL = 0              # guarded-by: _state_lock
+
+
+def bump_racy():
+    global _TOTAL
+    _TOTAL += 1                         # fires: module global, no lock
+
+
+def outer_with_closure():
+    def closure():
+        global _TOTAL
+        _TOTAL += 1                     # fires ONCE (closure's own scope)
+    return closure
+
+
+def outer_shadow_is_scoped():
+    def helper():
+        _TOTAL = 5                      # helper-local; no global decl
+        return _TOTAL
+    helper()
+    return _TOTAL                       # fires: outer reads the global

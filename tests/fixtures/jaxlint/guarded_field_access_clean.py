@@ -1,0 +1,46 @@
+"""Clean: every guarded access holds the documented lock (or uses the
+`_locked` called-with-lock-held convention), plus one justified direct
+read."""
+
+from dsin_tpu.utils.locks import RankedLock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = RankedLock("metrics.registry")
+        self._items = {}        # guarded-by: self._lock
+        self._depth = 0         # guarded-by: self._lock
+        self._items["seed"] = 1   # ok: declaring method (pre-sharing)
+
+    def add(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._depth += 1            # ok: _locked suffix, caller holds it
+
+    @property
+    def depth(self):
+        with self._lock:
+            return self._depth
+
+    def depth_hint(self):
+        # jaxlint: disable=guarded-field-access -- monitoring-only racy
+        # read; staleness is acceptable and the GIL keeps it atomic
+        return self._depth
+
+
+_STATE_LOCK = RankedLock("metrics.registry")
+_TOTAL = 0              # guarded-by: _STATE_LOCK
+
+
+def bump():
+    global _TOTAL
+    with _STATE_LOCK:
+        _TOTAL += 1
+
+
+def shadowed():
+    _TOTAL = 99                         # ok: plain local, shadows global
+    return _TOTAL

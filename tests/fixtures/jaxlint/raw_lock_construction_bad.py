@@ -1,0 +1,14 @@
+"""Bad: raw threading primitives built outside utils/locks.py."""
+
+import threading
+from threading import RLock
+
+MODULE_LOCK = threading.Lock()          # fires (dotted form)
+REENTRANT = RLock()                     # fires (bare imported name)
+
+
+class Worker:
+    def __init__(self):
+        self._cond = threading.Condition()   # fires
+        self._stop = threading.Event()       # ok: Event has no ordering
+        self._tls = threading.local()        # ok: not a lock

@@ -1,0 +1,16 @@
+"""Clean: locks come from the ranked wrappers; one justified raw lock."""
+
+import threading
+
+from dsin_tpu.utils.locks import RankedCondition, RankedLock
+
+GOOD = RankedLock("metrics.metric")
+
+
+class Worker:
+    def __init__(self):
+        self._cond = RankedCondition("serve.batcher")
+        self._stop = threading.Event()
+        # jaxlint: disable=raw-lock-construction -- interop: handed to a
+        # third-party API that requires a raw primitive
+        self._legacy = threading.Lock()

@@ -1,0 +1,20 @@
+"""Bad: values read out of threading.local() published to shared state."""
+
+import threading
+
+_TLS = threading.local()
+_SHARED_CODEC = None
+
+
+def leak_to_global():
+    global _SHARED_CODEC
+    _SHARED_CODEC = _TLS.codec          # fires: global publication
+
+
+class Pool:
+    def __init__(self):
+        self._tls = threading.local()
+        self.fallback = None
+
+    def leak_to_attr(self):
+        self.fallback = self._tls.codec     # fires: self.* publication

@@ -1,0 +1,26 @@
+"""Clean: thread-local state stays inside its owning thread; one
+justified escape."""
+
+import threading
+
+_TLS = threading.local()
+
+
+def use_locally(build):
+    codec = getattr(_TLS, "codec", None)    # ok: local variable
+    if codec is None:
+        codec = build()
+        _TLS.codec = codec                  # ok: writing INTO the local
+    return codec                            # ok: same-thread caller
+
+
+class Pool:
+    def __init__(self):
+        self._tls = threading.local()
+        self.template = None
+
+    def snapshot_for_debug(self):
+        # jaxlint: disable=thread-local-escape -- read-only debug dump;
+        # the clone is discarded after rendering, never mutated
+        self.template = self._tls.codec
+        return self.template

@@ -152,3 +152,31 @@ def test_model_name_for():
     ae, _ = _cfgs(H_target=0.04, num_chan_bn=32, AE_only=True)
     name = ckpt_lib.model_name_for(ae, "ts")
     assert name == "target_bpp0.02_AE_only_ts"
+
+
+def test_nested_checkpoints_survive_rotation_and_swap_kill(tmp_path):
+    """main.py nests periodic/ and emergency/ checkpoints INSIDE the
+    best-val ckpt dir; the durable save's rotate-aside + keep_last
+    prune must never strand or delete them — including on the resume
+    after a kill in the swap window (live dir absent, nested content
+    only inside the newest kept .prev-*)."""
+    import os
+    ae, pc = _cfgs()
+    params = _params()
+    tx = optim_lib.build_optimizer(params, ae, pc, num_training_imgs=10)
+    live = str(tmp_path / "model")
+    state = _state(params, tx)
+    ckpt_lib.save_checkpoint(live, state)
+    ckpt_lib.save_checkpoint(os.path.join(live, "periodic"), state,
+                             extra_meta={"kind": "periodic"})
+    # ordinary rotation: nested dir rides into the fresh live dir
+    ckpt_lib.save_checkpoint(live, state, best_val=1.0, keep_last=1)
+    assert os.path.exists(os.path.join(live, "periodic", "meta.json"))
+    # swap-window kill: rotate by hand WITHOUT the carry-over, as a
+    # kill between the two renames leaves things
+    os.rename(live, live + ".prev-000009")
+    ckpt_lib.save_checkpoint(live, state, best_val=0.5, keep_last=1)
+    assert os.path.exists(os.path.join(live, "periodic", "meta.json"))
+    # further saves prune the old prevs without touching the rescue
+    ckpt_lib.save_checkpoint(live, state, best_val=0.25, keep_last=1)
+    assert os.path.exists(os.path.join(live, "periodic", "meta.json"))

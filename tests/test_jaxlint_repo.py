@@ -50,3 +50,87 @@ def test_suppressions_stay_justified():
     assert suppressed >= 5, (
         f"expected the repo's intentional-violation suppressions to be "
         f"visible to the linter, saw {suppressed}")
+
+
+# -- threadlint: the concurrency family is part of the gate -------------------
+
+THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
+    ("dsin_tpu", "serve", "service.py"),
+    ("dsin_tpu", "serve", "batcher.py"),
+    ("dsin_tpu", "serve", "metrics.py"),
+    ("dsin_tpu", "coding", "codec.py"),
+    ("dsin_tpu", "coding", "incremental.py"),
+    ("dsin_tpu", "coding", "rans.py"),
+    ("dsin_tpu", "utils", "recompile.py"),
+    ("dsin_tpu", "utils", "faults.py"),
+    ("dsin_tpu", "utils", "locks.py"),
+)]
+
+
+def test_concurrency_gate_via_cli_contract(capsys):
+    """The tpu_session.sh threadlint stage: the concurrency family alone
+    must also exit clean over the production trees."""
+    assert run(["--concurrency",
+                os.path.join(REPO, "dsin_tpu"),
+                os.path.join(REPO, "tools")]) == EXIT_CLEAN
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_threaded_modules_are_in_the_concurrency_walk():
+    """Exempting serve/ (or ANY threaded module) from the concurrency
+    walk must fail this gate — mirroring
+    test_serve_subsystem_is_in_the_gate: the walked file set is pinned,
+    so a path-filter change cannot silently carve the threaded code out
+    of threadlint."""
+    from tools.jaxlint import LintConfig
+    walked = set(LintConfig().iter_files([os.path.join(REPO, "dsin_tpu"),
+                                          os.path.join(REPO, "tools")]))
+    missing = [p for p in THREADED_MODULES if p not in walked]
+    assert not missing, f"threaded modules exempted from the " \
+                        f"concurrency walk: {missing}"
+
+
+def test_raw_lock_ban_is_enforced_by_the_lint():
+    """The acceptance contract 'no raw threading.Lock() outside
+    utils/locks.py' is the lint's job: the same source fires in any
+    ordinary module and is exempt ONLY under the locks module stem."""
+    from tools.jaxlint import lint_source
+    src = "import threading\nLOCK = threading.Lock()\n"
+    active, _ = lint_source(src, os.path.join(
+        REPO, "dsin_tpu", "serve", "somefile.py"))
+    assert [f.rule for f in active] == ["raw-lock-construction"]
+    active, _ = lint_source(src, os.path.join(
+        REPO, "dsin_tpu", "utils", "locks.py"))
+    assert not active
+
+
+def test_no_raw_locks_remain_in_dsin_tpu():
+    """Belt + suspenders over the lint: grep-level scan that every
+    threading.Lock/RLock/Condition construction in dsin_tpu/ lives in
+    utils/locks.py (the lint proves the same through suppression-free
+    findings; this pins it without trusting rule wiring)."""
+    import re
+    pat = re.compile(r"threading\.(Lock|RLock|Condition)\(")
+    offenders = []
+    for root, dirs, files in os.walk(os.path.join(REPO, "dsin_tpu")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            if path.endswith(os.path.join("utils", "locks.py")):
+                continue
+            with open(path, encoding="utf-8") as f:
+                if pat.search(f.read()):
+                    offenders.append(path)
+    assert not offenders, f"raw lock construction outside " \
+                          f"utils/locks.py: {offenders}"
+
+
+def test_suppression_audit_lists_the_repo_and_is_stale_free(capsys):
+    """`--list-suppressions` over the gate targets: every suppression
+    prints with file:line + justification and none is stale (exit 0)."""
+    assert run(["--list-suppressions"] + LINT_TARGETS) == EXIT_CLEAN
+    out = capsys.readouterr().out
+    assert "0 stale" in out
+    assert "disable=" in out and "-- " in out

@@ -177,3 +177,70 @@ def test_list_rules_names_all_rules(capsys):
     out = capsys.readouterr().out
     for rule in ALL_RULES:
         assert rule.name in out
+
+
+# -- threadlint (concurrency family) ------------------------------------------
+
+def test_expected_counts_on_concurrency_bad_fixtures():
+    """Pin exact firing counts for the threadlint fixtures, like the JAX
+    rules above: a rule that silently widens or narrows diffs here."""
+    active, _ = _lint_fixture("raw_lock_construction_bad.py")
+    assert len([f for f in active
+                if f.rule == "raw-lock-construction"]) == 3
+    active, _ = _lint_fixture("guarded_field_access_bad.py")
+    assert len([f for f in active
+                if f.rule == "guarded-field-access"]) == 6
+    active, _ = _lint_fixture("blocking_call_under_lock_bad.py")
+    assert len([f for f in active
+                if f.rule == "blocking-call-under-lock"]) == 5
+    active, _ = _lint_fixture("thread_local_escape_bad.py")
+    assert len([f for f in active
+                if f.rule == "thread-local-escape"]) == 2
+
+
+def test_concurrency_flag_runs_only_the_family(tmp_path):
+    """--concurrency must both (a) fire on a lock hazard and (b) NOT
+    fire the JAX rules — it is the fail-fast tpu_session stage that runs
+    before anything jax-shaped is even relevant."""
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        "import threading\n"
+        "import jax\n"
+        "import numpy as np\n\n"
+        "LOCK = threading.Lock()\n\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.mean(x)\n")
+    assert run([str(mixed)]) == EXIT_FINDINGS              # both fire
+    import io
+    buf = io.StringIO()
+    assert run(["--concurrency", str(mixed)], out=buf) == EXIT_FINDINGS
+    out = buf.getvalue()
+    assert "raw-lock-construction" in out
+    assert "host-call-in-jit" not in out
+    # --concurrency intersected with a non-concurrency --select must be
+    # an explicit error, never a silent widen-to-all-rules
+    assert run(["--concurrency", "--select", "host-call-in-jit",
+                str(mixed)]) == EXIT_INTERNAL
+    # a concurrency rule named in --select narrows the family
+    buf2 = io.StringIO()
+    assert run(["--concurrency", "--select", "raw-lock-construction",
+                str(mixed)], out=buf2) == EXIT_FINDINGS
+    assert "raw-lock-construction" in buf2.getvalue()
+
+
+def test_list_suppressions_audit_mode(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text(BAD_SNIPPET.format(
+        "  # jaxlint: disable=host-call-in-jit -- exercised by tests"))
+    assert run(["--list-suppressions", str(ok)]) == EXIT_CLEAN
+    out = capsys.readouterr().out
+    assert "ok.py:6: disable=host-call-in-jit -- exercised by tests" in out
+    assert "1 suppression(s), 0 stale" in out
+
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # jaxlint: disable=retired-rule -- was ok\n")
+    assert run(["--list-suppressions", str(stale)]) == EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert "STALE(retired-rule)" in out
+    assert "1 stale" in out

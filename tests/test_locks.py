@@ -1,0 +1,214 @@
+"""Ranked-lock runtime discipline (dsin_tpu/utils/locks.py): hierarchy
+enforcement at acquire time, inversion accounting, contention/hold-time
+stats, condition bookkeeping, and the deterministic acquire hook the
+race tests lean on. Pure stdlib — no jax."""
+
+import threading
+import time
+
+import pytest
+
+from dsin_tpu.utils import locks
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Every test starts with enforcement ON, no hook, fresh ledgers —
+    and restores whatever was set, so test order cannot leak state."""
+    prev_enforce = locks.set_enforcement(True)
+    prev_hook = locks.set_acquire_hook(None)
+    locks.reset_stats()
+    yield
+    locks.set_enforcement(prev_enforce)
+    locks.set_acquire_hook(prev_hook)
+    locks.reset_stats()
+
+
+def test_hierarchy_is_strictly_ranked():
+    ranks = list(locks.HIERARCHY.values())
+    assert len(set(ranks)) == len(ranks), "ranks must be unique (equal " \
+        "ranks cannot nest, so sharing one wedges unrelated subsystems)"
+    assert ranks == sorted(ranks), "keep the table in acquire order"
+
+
+def test_named_lock_resolves_rank_from_hierarchy():
+    lk = locks.RankedLock("metrics.metric")
+    assert lk.rank == locks.HIERARCHY["metrics.metric"]
+    with pytest.raises(ValueError):
+        locks.RankedLock("no.such.lock")
+
+
+def test_ordered_nesting_is_legal():
+    outer = locks.RankedLock("outer", rank=10)
+    inner = locks.RankedLock("inner", rank=20)
+    with outer:
+        with inner:
+            assert locks.held_locks() == ("outer", "inner")
+    assert locks.held_locks() == ()
+    assert locks.inversion_count() == 0
+
+
+def test_inversion_detected_and_raised():
+    """The acceptance contract: an intentionally inverted acquisition is
+    detected AND raised at acquire time."""
+    hi = locks.RankedLock("hi", rank=60)
+    lo = locks.RankedLock("lo", rank=50)
+    with hi:
+        with pytest.raises(locks.LockOrderViolation) as exc:
+            lo.acquire()
+        assert "hi" in str(exc.value) and "lo" in str(exc.value)
+    assert locks.inversion_count() == 1
+    assert "hi(rank 60) -> lo(rank 50)" in locks.inversions()[0]
+    assert locks.stats_snapshot()["lo"]["inversions"] == 1
+    # the failed acquire must not corrupt the books: the lock is free
+    with lo:
+        assert locks.held_locks() == ("lo",)
+
+
+def test_equal_rank_nesting_is_an_inversion():
+    a = locks.RankedLock("metrics.metric")
+    b = locks.RankedLock("metrics.metric")
+    with a:
+        with pytest.raises(locks.LockOrderViolation):
+            b.acquire()
+
+
+def test_enforcement_flag_disables_the_raise_only():
+    hi = locks.RankedLock("hi2", rank=60)
+    lo = locks.RankedLock("lo2", rank=50)
+    locks.set_enforcement(False)
+    with hi:
+        with lo:       # tolerated: checks are off
+            pass
+    assert locks.inversion_count() == 0
+
+
+def test_contention_and_hold_time_are_recorded():
+    lk = locks.RankedLock("contended", rank=5)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            release.wait(5)
+            time.sleep(0.01)   # measurable hold
+
+    t = threading.Thread(target=holder, name="holder")
+    t.start()
+    assert entered.wait(5)
+    t2_done = threading.Event()
+
+    def waiter():
+        with lk:
+            pass
+        t2_done.set()
+
+    t2 = threading.Thread(target=waiter, name="waiter")
+    t2.start()
+    time.sleep(0.05)           # waiter is now blocked on the lock
+    release.set()
+    assert t2_done.wait(5)
+    t.join(5)
+    t2.join(5)
+    s = locks.stats_snapshot()["contended"]
+    assert s["acquisitions"] == 2
+    assert s["contentions"] >= 1
+    assert s["hold_ms_total"] >= 10.0
+    assert s["max_hold_ms"] >= 10.0
+
+
+def test_condition_wait_releases_the_books():
+    cond = locks.RankedCondition("cv", rank=15)
+    seen = {}
+    started = threading.Event()
+
+    def waiter():
+        with cond:
+            started.set()
+            cond.wait(5)
+            seen["held_after_wake"] = locks.held_locks()
+
+    t = threading.Thread(target=waiter, name="cv-waiter")
+    t.start()
+    assert started.wait(5)
+    # while the waiter is parked it does NOT hold the lock: this acquire
+    # must go straight through instead of deadlocking
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if cond.acquire(blocking=False):
+            break
+        time.sleep(0.005)
+    else:
+        pytest.fail("condition lock never became free during wait()")
+    cond.notify_all()
+    cond.release()
+    t.join(5)
+    assert seen["held_after_wake"] == ("cv",)
+
+
+def test_acquire_hook_forces_a_deterministic_ordering():
+    """The interleaving tool the batcher race tests use: a hook parks a
+    chosen thread at a chosen lock until the test releases it."""
+    lk = locks.RankedLock("hooked", rank=5)
+    gate = threading.Event()
+    order = []
+
+    def hook(lock):
+        if lock.name == "hooked" and \
+                threading.current_thread().name == "second":
+            gate.wait(5)
+
+    locks.set_acquire_hook(hook)
+
+    def first():
+        with lk:
+            order.append("first")
+        gate.set()
+
+    def second():
+        with lk:
+            order.append("second")
+
+    t2 = threading.Thread(target=second, name="second")
+    t2.start()
+    time.sleep(0.05)       # second is parked in the hook, lock untaken
+    t1 = threading.Thread(target=first, name="first")
+    t1.start()
+    t1.join(5)
+    t2.join(5)
+    assert order == ["first", "second"]
+
+
+def test_repo_rungs_accept_their_real_nesting():
+    """The documented cross-layer path: batcher cond (10) held while the
+    expiry callback reports into registry (80) then a metric leaf (90)."""
+    cond = locks.RankedCondition("serve.batcher")
+    registry = locks.RankedLock("metrics.registry")
+    metric = locks.RankedLock("metrics.metric")
+    with cond:
+        with registry:
+            pass
+        with metric:
+            pass
+    assert locks.inversion_count() == 0
+
+
+def test_condition_wait_holding_inner_lock_raises():
+    """Waiting while an INNER lock is held parks the thread with that
+    lock locked — the notifier (or anyone needing it) deadlocks. The
+    wrapper refuses at wait() time, same as an inverted acquire (and a
+    mid-stack pop would corrupt the rank-sorted held-stack the order
+    check relies on)."""
+    cond = locks.RankedCondition("cv2", rank=15)
+    inner = locks.RankedLock("cv2-inner", rank=25)
+    with cond:
+        with inner:
+            with pytest.raises(locks.LockOrderViolation) as exc:
+                cond.wait(0.1)
+            assert "cv2-inner" in str(exc.value)
+    assert locks.inversion_count() == 1
+    # books intact: both locks fully released, a clean wait still works
+    assert locks.held_locks() == ()
+    with cond:
+        cond.wait(0.01)

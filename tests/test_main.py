@@ -116,8 +116,11 @@ def test_full_run_train_val_test(tmp_path):
 
     # best-val checkpoint + sidecars exist
     weights = os.path.join(out, "weights")
+    # durable saves rotate the previous live dir to `<name>.prev-NNNNNN`
+    # (train/checkpoint.py keep_last); only the LIVE dir counts here
     names = [d for d in os.listdir(weights)
-             if os.path.isdir(os.path.join(weights, d))]
+             if os.path.isdir(os.path.join(weights, d))
+             and ".prev-" not in d]
     assert len(names) == 1
     ckpt = os.path.join(weights, names[0])
     assert os.path.exists(os.path.join(ckpt, "params_encoder.msgpack"))

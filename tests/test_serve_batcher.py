@@ -144,3 +144,85 @@ def test_next_batch_timeout_returns_empty_list():
     t0 = time.monotonic()
     assert b.next_batch(timeout=0.05) == []
     assert time.monotonic() - t0 < 1.0
+
+
+# -- deadline expiry racing drain (forced interleavings) ----------------------
+#
+# Both orderings of the previously-untested race: a queued request whose
+# deadline has passed while a worker (expiry path) and a drain thread
+# (close path) contend for the batcher lock. The named serve.batcher
+# lock's deterministic acquire hook (dsin_tpu/utils/locks.py) parks a
+# chosen thread at the lock until the other side has won, so each test
+# pins ONE ordering instead of hoping the scheduler produces it. The
+# invariant under both: the future resolves exactly once, with a typed
+# error, never hung.
+
+from dsin_tpu.utils import locks as locks_lib
+
+
+def _run_expiry_vs_drain(first: str):
+    """Force `first` ('drain' or 'expire') to win the lock race."""
+    b = MicroBatcher(max_batch=4, max_wait_ms=0, max_queue=16)
+    dead = _req(deadline=time.monotonic() - 0.01)
+    b.submit(dead)
+
+    loser = "worker" if first == "drain" else "drainer"
+    release_loser = threading.Event()
+    out = {}
+
+    def hook(lock):
+        if lock.name == "serve.batcher" and \
+                threading.current_thread().name == loser:
+            release_loser.wait(5)
+
+    prev = locks_lib.set_acquire_hook(hook)
+    try:
+        worker = threading.Thread(
+            target=lambda: out.__setitem__("batch",
+                                           b.next_batch(timeout=5.0)),
+            name="worker")
+        drainer = threading.Thread(
+            target=lambda: out.__setitem__("rejected", b.close()),
+            name="drainer")
+        worker.start()
+        drainer.start()
+        # release the parked loser only once the winner has actually
+        # won: close() returned, or the expiry pass resolved the future
+        if first == "drain":
+            drainer.join(5)
+            assert not drainer.is_alive()
+        else:
+            assert dead.future.exception(timeout=5) is not None
+        release_loser.set()
+        for t in (worker, drainer):
+            t.join(5)
+            assert not t.is_alive()
+    finally:
+        locks_lib.set_acquire_hook(prev)
+    return b, dead, out
+
+
+def test_deadline_expiry_loses_race_to_drain():
+    """close() wins the lock: the dead request is rejected as draining
+    (it was never started), and the later expiry pass finds an empty
+    queue instead of double-resolving the future."""
+    b, dead, out = _run_expiry_vs_drain(first="drain")
+    exc = dead.future.exception(timeout=0)        # resolved, not hung
+    assert isinstance(exc, ServiceDraining)
+    assert out["rejected"] == 1
+    assert out["batch"] is None                   # worker saw closed+empty
+    assert b.depth == 0
+
+
+def test_deadline_expiry_wins_race_against_drain():
+    """The worker's expiry pass wins: the dead request completes with
+    DeadlineExceeded, and the later close() must NOT overwrite that
+    resolution (it rejects zero requests — the queue is already empty)."""
+    b, dead, out = _run_expiry_vs_drain(first="expire")
+    exc = dead.future.exception(timeout=0)
+    assert isinstance(exc, DeadlineExceeded)
+    assert out["rejected"] == 0
+    # having expired the backlog, the worker was waiting for new work
+    # when the close landed — it exits via the None signal
+    assert out["batch"] is None
+    assert b.depth == 0

@@ -126,3 +126,30 @@ def test_drain_handlers_skipped_off_main_thread():
     t.start()
     t.join()
     assert out["r"] is False
+
+
+def test_install_off_main_thread_leaves_handlers_untouched():
+    """The skipped path must be a true no-op: a worker thread calling
+    either installer (e.g. a test driving train() or serve from a
+    thread) must not clobber whatever handlers the main thread owns."""
+    from dsin_tpu.utils.signals import (install_drain_handlers,
+                                        install_interrupt_handlers)
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    marker_int = lambda signum, frame: None    # noqa: E731
+    marker_term = lambda signum, frame: None   # noqa: E731
+    try:
+        signal.signal(signal.SIGINT, marker_int)
+        signal.signal(signal.SIGTERM, marker_term)
+        results = []
+        t = threading.Thread(target=lambda: results.extend([
+            install_interrupt_handlers(),
+            install_drain_handlers(lambda: None)]))
+        t.start()
+        t.join(5)
+        assert results == [False, False]
+        assert signal.getsignal(signal.SIGINT) is marker_int
+        assert signal.getsignal(signal.SIGTERM) is marker_term
+    finally:
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)

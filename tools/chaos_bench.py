@@ -13,7 +13,12 @@ robustness PR promises (exit 1 on any violation):
   * the supervisor RESTORES the worker pool after injected crashes and
     /healthz returns to ok;
   * ZERO steady-state XLA compiles across all of it — recovery must
-    reuse the warmed executables, never rebuild them.
+    reuse the warmed executables, never rebuild them;
+  * ZERO lock-order inversions with the ranked-lock discipline checks
+    ON (dsin_tpu/utils/locks.py): the whole soak — worker crashes,
+    supervisor restarts, pipelined entropy, concurrent /metrics reads —
+    runs under acquire-time hierarchy enforcement, and per-lock
+    contention stats land in the report's `lock_discipline` section.
 
 Phases: (A) encode load with crash + delay faults; (B) door integrity —
 bit-flipped frames rejected at submit; (C) worker-side integrity — the
@@ -87,10 +92,19 @@ def _flip_bit(blob: bytes, bit: int) -> bytes:
 def run_chaos(args) -> dict:
     from dsin_tpu.serve import (CompressionService, IntegrityError,
                                 ServeError, ServiceConfig)
-    from dsin_tpu.utils import faults
+    from dsin_tpu.utils import faults, locks
     from dsin_tpu.utils.recompile import CompilationSentinel
 
     from tools.serve_bench import _parse_shapes
+
+    # lock discipline is part of the soak's contract: the ranked-lock
+    # checks (utils/locks.py) must be ON, and the whole run — crashes,
+    # restarts, pipelined entropy, metric scrapes — must produce ZERO
+    # lock-order inversions
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled (DSIN_LOCK_CHECKS=0?) — " \
+        "the chaos soak must run with them on"
+    locks.reset_stats()
 
     shapes = _parse_shapes(args.shapes)
     buckets = _parse_shapes(args.buckets)
@@ -220,6 +234,12 @@ def run_chaos(args) -> dict:
                           f"compiles (recovery must reuse executables)")
 
     service.drain()
+    lock_stats = locks.stats_snapshot()
+    inversions = locks.inversion_count()
+    if inversions:
+        violations.append(
+            f"{inversions} lock-order inversions under the soak: "
+            f"{locks.inversions()[:5]}")
     report = {
         "config": {
             "shapes": [list(s) for s in shapes],
@@ -263,6 +283,15 @@ def run_chaos(args) -> dict:
             "hung_futures": load_hung,
             "untyped_errors": load_counts["untyped"],
             "integrity_false_negatives": door_missed + rans_missed,
+            "lock_order_inversions": inversions,
+        },
+        "lock_discipline": {
+            "enforced": locks.enforcement_enabled(),
+            "inversions": inversions,
+            "contentions": {k: v["contentions"]
+                            for k, v in lock_stats.items()
+                            if v["contentions"]},
+            "stats": lock_stats,
         },
         "clean_decodes_after_chaos": clean_ok,
         "steady_compiles": sentinel.compilations,
@@ -330,7 +359,8 @@ def main(argv=None) -> int:
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
     print(json.dumps({k: report[k] for k in
                       ("load", "supervision", "integrity", "invariants",
-                       "steady_compiles", "violations")}, indent=1))
+                       "lock_discipline", "steady_compiles",
+                       "violations")}, indent=1))
     if report["violations"]:
         print(f"CHAOS_BENCH_FAILED: {report['violations']}",
               file=sys.stderr)

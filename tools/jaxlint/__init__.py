@@ -4,10 +4,15 @@ An AST-based linter (stdlib only) for the JAX failure modes pytest cannot
 see: host calls and Python control flow inside jitted bodies, PRNG key
 reuse, host syncs inside the step hot loop, recompilation hazards from
 captured Python containers, under-specified shard_map/pmap, bare
-jax.experimental imports, and argument-pytree mutation.
+jax.experimental imports, and argument-pytree mutation — plus the
+threadlint concurrency family (tools/jaxlint/concurrency.py): raw lock
+construction outside the ranked wrappers, `# guarded-by:` fields touched
+without their lock, blocking calls under locks, thread-local escapes.
 
 Entry points:
-    python -m tools.jaxlint dsin_tpu/          # CLI (exit 0/1/2)
+    python -m tools.jaxlint dsin_tpu/           # CLI (exit 0/1/2)
+    python -m tools.jaxlint --concurrency ...   # threadlint family only
+    python -m tools.jaxlint --list-suppressions ...  # audit; 1 on stale
     from tools.jaxlint import lint_paths        # in-process (tests, CI)
 
 Suppressions: `# jaxlint: disable=<rule>[,<rule>...] -- <justification>`
@@ -18,7 +23,9 @@ The justification is mandatory — a bare disable is itself a finding.
 from tools.jaxlint.config import LintConfig
 from tools.jaxlint.framework import Finding, Rule, lint_source
 from tools.jaxlint.rules import ALL_RULES, RULES_BY_NAME
-from tools.jaxlint.cli import lint_paths, run
+from tools.jaxlint.concurrency import CONCURRENCY_RULE_NAMES
+from tools.jaxlint.cli import audit_suppressions, lint_paths, run
 
-__all__ = ["ALL_RULES", "RULES_BY_NAME", "Finding", "LintConfig", "Rule",
+__all__ = ["ALL_RULES", "CONCURRENCY_RULE_NAMES", "RULES_BY_NAME",
+           "Finding", "LintConfig", "Rule", "audit_suppressions",
            "lint_paths", "lint_source", "run"]

@@ -15,7 +15,7 @@ import traceback
 from typing import List, Optional, Sequence, Tuple
 
 from tools.jaxlint.config import LintConfig
-from tools.jaxlint.framework import Finding, lint_source
+from tools.jaxlint.framework import Finding, Suppressions, lint_source
 from tools.jaxlint import reporting
 
 EXIT_CLEAN = 0
@@ -40,6 +40,30 @@ def lint_paths(paths: Sequence[str], config: Optional[LintConfig] = None
     return findings, suppressed, len(files)
 
 
+def audit_suppressions(paths: Sequence[str],
+                       config: Optional[LintConfig] = None
+                       ) -> Tuple[list, int]:
+    """The `--list-suppressions` audit: every inline disable with its
+    file:line and justification, plus how many are STALE (name a rule
+    that no longer exists — dead suppressions otherwise rot invisibly
+    as rules are renamed or retired). Returns (rows, stale_count) where
+    each row is (path, line, rules, reason, stale_rules)."""
+    from tools.jaxlint.rules import RULES_BY_NAME
+    config = config or LintConfig()
+    rows = []
+    stale_total = 0
+    for path in config.iter_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        for entry in Suppressions(source).entries:
+            stale = sorted(r for r in entry.rules - {"*"}
+                           if r not in RULES_BY_NAME)
+            stale_total += len(stale)
+            rows.append((path, entry.line, sorted(entry.rules),
+                         entry.reason, stale))
+    return rows, stale_total
+
+
 def _parse_args(argv: Optional[Sequence[str]]) -> argparse.Namespace:
     p = argparse.ArgumentParser(
         prog="python -m tools.jaxlint",
@@ -51,8 +75,17 @@ def _parse_args(argv: Optional[Sequence[str]]) -> argparse.Namespace:
     p.add_argument("--ignore", default="",
                    help="comma-separated rule names to skip")
     p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run only the threadlint concurrency rule "
+                        "family (lock discipline, guarded fields, "
+                        "blocking calls under locks, thread-local "
+                        "escapes)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit 0")
+    p.add_argument("--list-suppressions", action="store_true",
+                   help="audit mode: print every inline disable with "
+                        "file:line and justification; exit 1 if any "
+                        "names a rule that no longer exists")
     return p.parse_args(argv)
 
 
@@ -68,9 +101,29 @@ def run(argv: Optional[Sequence[str]] = None,
         if args.list_rules:
             print(reporting.format_rules(), file=out)
             return EXIT_CLEAN
+        select = tuple(s for s in args.select.split(",") if s)
+        if args.concurrency:
+            from tools.jaxlint.concurrency import CONCURRENCY_RULE_NAMES
+            if select:
+                select = tuple(n for n in CONCURRENCY_RULE_NAMES
+                               if n in select)
+                if not select:
+                    # an empty intersection must not silently widen to
+                    # "all rules" (LintConfig treats empty select as
+                    # everything-enabled)
+                    print("--concurrency intersected with --select "
+                          "names no concurrency rule; nothing would "
+                          "run", file=sys.stderr)
+                    return EXIT_INTERNAL
+            else:
+                select = tuple(CONCURRENCY_RULE_NAMES)
         config = LintConfig(
-            select=tuple(s for s in args.select.split(",") if s),
+            select=select,
             ignore=tuple(s for s in args.ignore.split(",") if s))
+        if args.list_suppressions:
+            rows, stale = audit_suppressions(args.paths, config)
+            print(reporting.format_suppressions(rows, stale), file=out)
+            return EXIT_FINDINGS if stale else EXIT_CLEAN
         findings, suppressed, files = lint_paths(args.paths, config)
         fmt = (reporting.format_json if args.format == "json"
                else reporting.format_text)

@@ -1,0 +1,459 @@
+"""threadlint: the concurrency rule family.
+
+The serve dataplane (PRs 2-4) is a threaded system whose safety
+contracts — "this field is only touched under that lock", "never block
+while holding this" — lived in docstrings. These rules turn the
+checkable subset into lint findings, the same way the JAX rules turned
+"don't capture containers under jit" into one. Like every jaxlint rule
+they are syntactic and deliberately conservative: they catch the direct
+form each hazard takes in this repo and pin exact semantics with golden
+fixtures (tests/fixtures/jaxlint/).
+
+The four rules:
+
+* **raw-lock-construction** — `threading.Lock()` / `RLock()` /
+  `Condition()` built anywhere but the sanctioned wrapper module
+  (`dsin_tpu/utils/locks.py`, config.lock_modules). A raw lock is
+  invisible to the runtime hierarchy checks and the contention ledger;
+  the whole point of the ranked wrappers is that EVERY lock is seen.
+
+* **guarded-field-access** — the `# guarded-by: <lock>` annotation
+  convention, enforced. Declaring an attribute
+
+      self._depth = 0            # guarded-by: self._cond
+
+  makes any read/write of `self._depth` elsewhere in the class a
+  finding unless it sits lexically inside `with self._cond:`; the same
+  applies to annotated MODULE-level globals, checked across every
+  function in the file (import-time statements are exempt, and a local
+  assignment without `global` shadows the name). Exempt:
+  the method containing the declaration (construction happens before
+  the object is shared) and methods named `*_locked` (the repo's
+  existing called-with-lock-held convention, e.g. MicroBatcher's
+  `_expire_locked`). Nested functions are checked with an EMPTY lock
+  set — a closure may run on another thread long after the enclosing
+  `with` exited.
+
+* **blocking-call-under-lock** — calls that can block indefinitely
+  (`.result()`, `.join()`, `.block_until_ready()`, `jax.device_get`,
+  `time.sleep`, `subprocess.run`, and `np.asarray`/`np.array` as the
+  device->host transfer idiom) lexically inside a `with <lock>:` block
+  (any context expression whose last segment contains lock/cond/mutex).
+  Holding a lock across a blocking call converts one slow item into a
+  convoy — every thread needing the lock now waits on the slow one's
+  I/O. The intentional exception (the serve pipeline's single shared
+  device->host transfer under the `serve.device_batch` lock) carries a
+  justified inline suppression.
+
+* **thread-local-escape** — a value read from a `threading.local()`
+  slot stored into shared state (a `self.` attribute or a declared
+  global). Thread-local codec clones exist precisely because their
+  buffers are not safe to share; publishing one to shared state
+  silently reintroduces the race the local was bought to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Iterator, Optional, Set, Tuple
+
+from tools.jaxlint.framework import (FileContext, Finding, Rule,
+                                     dotted_name)
+
+#: threading factories that must go through dsin_tpu/utils/locks.
+#: threading.local / Event / Barrier stay legal: they carry no ordering
+#: semantics for the hierarchy to police.
+LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+
+#: with-items whose context expression names something lock-like
+LOCKISH_RE = re.compile(r"(lock|cond|mutex)", re.IGNORECASE)
+
+#: attribute methods that can block indefinitely. `.wait()` is excluded
+#: on purpose: Condition.wait RELEASES the lock it runs under.
+BLOCKING_METHODS = frozenset({"result", "join", "block_until_ready"})
+
+#: receivers whose `.get()` is a blocking queue pop, not dict lookup
+QUEUEISH_RE = re.compile(r"(queue|_q)$|^q$", re.IGNORECASE)
+
+#: dotted calls that block (or force a device->host transfer)
+BLOCKING_DOTTED = frozenset({
+    "jax.block_until_ready", "jax.device_get", "device_get",
+    "time.sleep", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+})
+
+
+def _own_scope_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own statements, excluding nested def/lambda/
+    class SUBTREES entirely (framework.body_walk descends into a nested
+    def when it is a direct body statement — here a nested scope's
+    locals and `global` declarations must stay its own)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None
+                  ) -> Optional[str]:
+    """`self.<x>` -> 'x' (optionally requiring x == attr), else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        if attr is None or node.attr == attr:
+            return node.attr
+    return None
+
+
+class RawLockConstruction(Rule):
+    name = "raw-lock-construction"
+    description = ("threading.Lock/RLock/Condition built outside "
+                   "dsin_tpu/utils/locks.py bypass the ranked-lock "
+                   "hierarchy checks and contention stats — use "
+                   "RankedLock/RankedCondition")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.module_stem in ctx.config.lock_modules:
+            return
+        bare: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "threading":
+                for alias in node.names:
+                    if alias.name in LOCK_FACTORIES:
+                        bare.add(alias.asname or alias.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            parts = dn.split(".")
+            raw = (len(parts) == 2 and parts[0] == "threading"
+                   and parts[1] in LOCK_FACTORIES) or dn in bare
+            if raw:
+                yield self.finding(
+                    ctx, node, f"raw `{dn}()` construction — route "
+                    f"through dsin_tpu/utils/locks (RankedLock/"
+                    f"RankedCondition) so the lock joins the repo "
+                    f"hierarchy and its contention is measured")
+
+
+class GuardedFieldAccess(Rule):
+    name = "guarded-field-access"
+    description = ("a field annotated `# guarded-by: <lock>` is "
+                   "read/written outside `with <lock>:` in its class — "
+                   "the documented lock contract is being broken")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # line -> lock expression, from the raw source (comments are
+        # invisible to the AST)
+        ann_by_line: Dict[int, str] = {}
+        for i, text in enumerate(ctx.source.splitlines(), start=1):
+            m = GUARDED_RE.search(text)
+            if m:
+                ann_by_line[i] = m.group(1).strip()
+        if not ann_by_line:
+            return
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls, ann_by_line)
+        yield from self._check_module_globals(ctx, ann_by_line)
+
+    def _check_module_globals(self, ctx, ann_by_line: Dict[int, str]
+                              ) -> Iterator[Finding]:
+        """Module-level `NAME = ...  # guarded-by: <lock>` declarations:
+        every function in the file must touch NAME inside
+        `with <lock>:`. Import-time module statements are exempt (they
+        run single-threaded, before the module is shared)."""
+        guarded: Dict[str, str] = {}
+        for node in ctx.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            lock = next((ann_by_line[ln]
+                         for ln in range(node.lineno, end + 1)
+                         if ln in ann_by_line), None)
+            if lock is None:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    guarded.setdefault(t.id, lock)
+        if not guarded:
+            return
+        # every def (incl. nested) is analyzed ONCE, as its own scope:
+        # ast.walk reaches nested defs directly, and name-mode _visit
+        # does not re-descend into them — a closure's accesses are
+        # checked against ITS OWN `global`/shadow analysis, with no
+        # locks assumed held (it may run after the enclosing `with`)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            # a function-local assignment WITHOUT a `global` declaration
+            # shadows the module name — those names are plain locals.
+            # Scan THIS scope only: a nested def's locals are its own.
+            declared_global: Set[str] = set()
+            assigned: Set[str] = {a.arg for a in (
+                fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs)}
+            for node in _own_scope_walk(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, (ast.Store, ast.Del)):
+                    assigned.add(node.id)
+            fields = {name: lock for name, lock in guarded.items()
+                      if name in declared_global or name not in assigned}
+            for stmt in (fn.body if fields else ()):
+                yield from self._visit(ctx, stmt, fields, frozenset(),
+                                       fn.name, kind="name")
+
+    def _check_class(self, ctx, cls: ast.ClassDef,
+                     ann_by_line: Dict[int, str]) -> Iterator[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # field -> (lock expr, declaring method name)
+        guarded: Dict[str, Tuple[str, str]] = {}
+        for meth in methods:
+            for node in ast.walk(meth):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                end = getattr(node, "end_lineno", node.lineno) \
+                    or node.lineno
+                lock = next((ann_by_line[ln]
+                             for ln in range(node.lineno, end + 1)
+                             if ln in ann_by_line), None)
+                if lock is None:
+                    continue
+                for t in targets:
+                    field = _is_self_attr(t)
+                    if field is not None:
+                        guarded.setdefault(field, (lock, meth.name))
+        if not guarded:
+            return
+        for meth in methods:
+            if meth.name.endswith("_locked"):
+                continue   # called-with-lock-held convention
+            fields = {f: lock for f, (lock, declared_in)
+                      in guarded.items() if declared_in != meth.name}
+            for stmt in (meth.body if fields else ()):
+                yield from self._visit(ctx, stmt, fields, frozenset(),
+                                       meth.name)
+
+    def _visit(self, ctx, node: ast.AST, fields: Dict[str, str],
+               held: frozenset, meth_name: str, kind: str = "attr"
+               ) -> Iterator[Finding]:
+        """Recursive walk tracking which locks are lexically held.
+        kind="attr" matches `self.<field>`; kind="name" matches bare
+        module-global names."""
+        if isinstance(node, ast.With):
+            # the context expressions evaluate BEFORE the lock is held
+            for item in node.items:
+                yield from self._visit(ctx, item.context_expr, fields,
+                                       held, meth_name, kind)
+            newly = {dotted_name(item.context_expr)
+                     for item in node.items}
+            inner = held | {n for n in newly if n}
+            for stmt in node.body:
+                yield from self._visit(ctx, stmt, fields, inner,
+                                       meth_name, kind)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if kind == "name":
+                return   # analyzed as its own scope by the module pass
+            # a closure may run on another thread after the enclosing
+            # `with` exited: check it with no locks held
+            for stmt in node.body:
+                yield from self._visit(ctx, stmt, fields, frozenset(),
+                                       meth_name, kind)
+            return
+        if isinstance(node, ast.Lambda):
+            if kind == "name":
+                return
+            yield from self._visit(ctx, node.body, fields, frozenset(),
+                                   meth_name, kind)
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        if kind == "attr":
+            field = _is_self_attr(node) \
+                if isinstance(node, ast.Attribute) else None
+            shown = f"self.{field}"
+        else:
+            field = node.id if isinstance(node, ast.Name) else None
+            shown = field
+        if field is not None and field in fields and \
+                fields[field] not in held:
+            lock = fields[field]
+            yield self.finding(
+                ctx, node, f"`{shown}` is guarded-by `{lock}` but "
+                f"`{meth_name}` touches it outside `with {lock}:` — "
+                f"wrap the access (or suffix the method `_locked` if "
+                f"callers hold the lock)")
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(ctx, child, fields, held, meth_name,
+                                   kind)
+
+
+class BlockingCallUnderLock(Rule):
+    name = "blocking-call-under-lock"
+    description = ("a blocking call (.result/.join/device transfer/"
+                   "sleep) inside a `with <lock>:` block convoys every "
+                   "thread needing that lock behind it")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            locks = []
+            for item in node.items:
+                dn = dotted_name(item.context_expr)
+                if dn and LOCKISH_RE.search(dn.split(".")[-1]):
+                    locks.append(dn)
+            if not locks:
+                continue
+            yield from self._scan_body(ctx, node.body, locks[0])
+
+    def _scan_body(self, ctx, body, lock: str) -> Iterator[Finding]:
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue   # deferred bodies do not run under the lock
+            if isinstance(node, ast.With) and any(
+                    (dn := dotted_name(i.context_expr)) and
+                    LOCKISH_RE.search(dn.split(".")[-1])
+                    for i in node.items):
+                continue   # the inner lock's own pass covers its body
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn in BLOCKING_DOTTED:
+                yield self.finding(
+                    ctx, node, f"`{dn}` called while holding `{lock}` "
+                    f"— move the blocking work outside the critical "
+                    f"section")
+            elif isinstance(node.func, ast.Attribute) and \
+                    not isinstance(node.func.value, ast.Constant) and \
+                    (node.func.attr in BLOCKING_METHODS
+                     or self._is_queue_get(node.func)):
+                yield self.finding(
+                    ctx, node, f"`.{node.func.attr}()` called while "
+                    f"holding `{lock}` — a blocked waiter convoys "
+                    f"every thread needing the lock; wait outside the "
+                    f"critical section")
+
+    @staticmethod
+    def _is_queue_get(func: ast.Attribute) -> bool:
+        """`.get()` on a queue-shaped receiver (`q`, `*_q`, `*queue`)
+        blocks; `.get()` on anything else is presumed a dict lookup."""
+        if func.attr != "get":
+            return False
+        dn = dotted_name(func.value)
+        return bool(dn and QUEUEISH_RE.search(dn.split(".")[-1]))
+
+
+class ThreadLocalEscape(Rule):
+    name = "thread-local-escape"
+    description = ("a value read from threading.local() stored into "
+                   "shared state — per-thread codec state must not "
+                   "outlive or leave its owning thread")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        module_tls: Set[str] = set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    self._is_local_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_tls.add(t.id)
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls, module_tls)
+        # module-level functions publishing a module tls read to a global
+        for fn in ctx.tree.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, fn, set(), module_tls)
+
+    @staticmethod
+    def _is_local_call(node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "threading.local", "local")
+
+    def _check_class(self, ctx, cls, module_tls: Set[str]
+                     ) -> Iterator[Finding]:
+        attr_tls: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and \
+                    self._is_local_call(node.value):
+                for t in node.targets:
+                    field = _is_self_attr(t)
+                    if field is not None:
+                        attr_tls.add(field)
+        if not (attr_tls or module_tls):
+            return
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, meth, attr_tls,
+                                          module_tls)
+
+    def _check_fn(self, ctx, fn, attr_tls: Set[str],
+                  module_tls: Set[str]) -> Iterator[Finding]:
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            tls_name = self._tls_read(node.value, attr_tls, module_tls)
+            if tls_name is None:
+                continue
+            for t in node.targets:
+                field = _is_self_attr(t)
+                if field is not None and field not in attr_tls:
+                    yield self.finding(
+                        ctx, node, f"value read from thread-local "
+                        f"`{tls_name}` stored into shared `self."
+                        f"{field}` — it escapes its owning thread")
+                elif isinstance(t, ast.Name) and \
+                        t.id in globals_declared:
+                    yield self.finding(
+                        ctx, node, f"value read from thread-local "
+                        f"`{tls_name}` stored into global `{t.id}` — "
+                        f"it escapes its owning thread")
+
+    @staticmethod
+    def _tls_read(expr: ast.AST, attr_tls: Set[str],
+                  module_tls: Set[str]) -> Optional[str]:
+        """Name of the tls whose slot `expr` reads, else None."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Attribute):
+                continue
+            base = node.value
+            field = _is_self_attr(base)
+            if field is not None and field in attr_tls:
+                return f"self.{field}"
+            if isinstance(base, ast.Name) and base.id in module_tls:
+                return base.id
+        return None
+
+
+CONCURRENCY_RULES = [RawLockConstruction(), GuardedFieldAccess(),
+                     BlockingCallUnderLock(), ThreadLocalEscape()]
+
+CONCURRENCY_RULE_NAMES = tuple(r.name for r in CONCURRENCY_RULES)

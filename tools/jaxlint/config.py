@@ -19,6 +19,11 @@ DEFAULT_EXCLUDE_DIRS = ("__pycache__", ".git", "fixtures", "artifacts",
 #: whose entire purpose is absorbing experimental-API moves
 DEFAULT_COMPAT_MODULES = ("jax_compat",)
 
+#: module stems allowed to construct raw threading primitives — the
+#: sanctioned ranked-lock wrapper module (dsin_tpu/utils/locks.py),
+#: which is the one place raw Lock/RLock/Condition may be built
+DEFAULT_LOCK_MODULES = ("locks",)
+
 
 @dataclass
 class LintConfig:
@@ -26,6 +31,7 @@ class LintConfig:
     ignore: Tuple[str, ...] = ()
     exclude_dirs: Tuple[str, ...] = DEFAULT_EXCLUDE_DIRS
     compat_modules: Tuple[str, ...] = DEFAULT_COMPAT_MODULES
+    lock_modules: Tuple[str, ...] = DEFAULT_LOCK_MODULES
 
     def enabled_rules(self) -> List[str]:
         from tools.jaxlint.rules import RULES_BY_NAME

@@ -33,3 +33,16 @@ def format_rules() -> str:
     width = max(len(r.name) for r in ALL_RULES)
     return "\n".join(f"{r.name:<{width}}  {r.description}"
                      for r in ALL_RULES)
+
+
+def format_suppressions(rows, stale_count: int) -> str:
+    """`--list-suppressions` audit output: one line per inline disable,
+    STALE-tagged when a named rule no longer exists."""
+    lines = []
+    for path, line, rules, reason, stale in rows:
+        tag = f"  STALE({','.join(stale)})" if stale else ""
+        lines.append(f"{path}:{line}: disable={','.join(rules)} "
+                     f"-- {reason or '(no justification)'}{tag}")
+    lines.append(f"jaxlint: {len(rows)} suppression(s), "
+                 f"{stale_count} stale")
+    return "\n".join(lines)

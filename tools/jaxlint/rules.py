@@ -1,4 +1,6 @@
-"""The eight JAX-specific rules.
+"""The eight JAX-specific rules (the threadlint concurrency family
+lives in tools/jaxlint/concurrency.py and registers into ALL_RULES /
+RULES_BY_NAME below).
 
 Each rule is syntactic and deliberately conservative: it catches the
 direct form of a failure mode (the form this repo's hot paths use) and
@@ -382,9 +384,12 @@ class PytreeArgMutation(Rule):
                         f"pytrees must stay immutable under tracing")
 
 
+from tools.jaxlint.concurrency import (CONCURRENCY_RULES,
+                                       CONCURRENCY_RULE_NAMES)
+
 ALL_RULES = [HostCallInJit(), TracedPythonBranch(), PrngKeyReuse(),
              HostSyncInLoop(), NonStaticJitCapture(),
              ShardMapMissingSpecs(), BareExperimentalImport(),
-             PytreeArgMutation()]
+             PytreeArgMutation()] + CONCURRENCY_RULES
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -30,6 +30,20 @@ lint)
     # a dirty tree aborts the whole queue — that is the point of the gate
     cat artifacts/jaxlint.log
     echo "TPU_SESSION_FAILED: lint (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
+threadlint)
+  # fail fast: the concurrency family alone (lock discipline, guarded
+  # fields, blocking calls under locks, thread-local escapes) over the
+  # threaded trees — same exit-code contract as the lint stage. The
+  # runtime half (ranked-lock inversion checks) is exercised by
+  # chaos-smoke right below.
+  python -m tools.jaxlint --concurrency dsin_tpu/ tools/ \
+    > artifacts/threadlint.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/threadlint.log
+    echo "TPU_SESSION_FAILED: threadlint (queue aborted before chip stages)"
     exit 1
   fi
   ;;
@@ -133,7 +147,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
