@@ -33,7 +33,18 @@ def make_mesh(num_devices: Optional[int] = None,
     if num_devices is not None:
         devices = devices[:num_devices]
     n = len(devices)
-    assert n % spatial == 0, (n, spatial)
+    # a typed error, not an assert: the serve placement layer now feeds
+    # this from user-supplied --devices values, and asserts vanish under
+    # `python -O`
+    if n < 1:
+        raise ValueError(
+            "cannot build a mesh over zero devices — num_devices/devices "
+            "selected an empty set")
+    if spatial < 1 or n % spatial != 0:
+        raise ValueError(
+            f"device count {n} is not divisible by spatial={spatial}: "
+            f"the (data, spatial) mesh needs n_devices to be a positive "
+            f"multiple of the spatial axis")
     arr = np.asarray(devices).reshape(n // spatial, spatial)
     return Mesh(arr, axis_names=(DATA_AXIS, SPATIAL_AXIS))
 

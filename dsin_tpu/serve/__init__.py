@@ -1,15 +1,19 @@
 """dsin_tpu.serve — long-lived micro-batching compression service.
 
 Layering (each module stands alone below the next):
-    buckets.py  — static shape buckets (fixed executable census)
-    batcher.py  — bounded queue, same-bucket coalescing, backpressure,
-                  deadlines, drain (pure stdlib threading)
-    metrics.py  — lock-guarded counters/gauges/histograms + http.server
-                  /healthz + /metrics endpoint
-    service.py  — worker threads over the batched jitted codec; model
-                  state loaded once via coding/loader.py
+    buckets.py   — static shape buckets (fixed executable census)
+    batcher.py   — bounded queue, same-bucket coalescing, backpressure,
+                   deadlines, drain (pure stdlib threading)
+    placement.py — bucket ladder -> device mesh assignment (replica
+                   policy + per-device shardings via parallel/mesh.py)
+    metrics.py   — lock-guarded counters/gauges/histograms + http.server
+                   /healthz + /metrics endpoint
+    service.py   — device-affine executor threads over the batched
+                   jitted codec; model state loaded once via
+                   coding/loader.py
 
-Driven by tools/serve_bench.py (open-loop load, SERVE_BENCH.json).
+Driven by tools/serve_bench.py (open-loop load + --devices scaling axis,
+SERVE_BENCH.json).
 """
 
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, MicroBatcher,
@@ -18,14 +22,18 @@ from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, MicroBatcher,
 from dsin_tpu.serve.buckets import (BucketPolicy, NoBucketFits,
                                     crop_from_bucket, pad_to_bucket)
 from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
+from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
+                                      PlacementPlan, plan_placement)
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
 from dsin_tpu.utils.integrity import IntegrityError
 
 __all__ = [
     "BucketPolicy", "CompressionService", "DeadlineExceeded",
-    "EncodeResult", "Future", "IntegrityError", "MetricsRegistry",
-    "MetricsServer", "MicroBatcher", "NoBucketFits", "Request",
-    "ServeError", "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
+    "DevicePlacement", "EncodeResult", "Future", "IntegrityError",
+    "MetricsRegistry", "MetricsServer", "MicroBatcher", "NoBucketFits",
+    "PlacementError", "PlacementPlan", "Request", "ServeError",
+    "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
     "ServiceUnavailable", "crop_from_bucket", "pad_to_bucket",
+    "plan_placement",
 ]

@@ -36,7 +36,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, Hashable, List, Optional
+from typing import (AbstractSet, Any, Dict, Hashable, List, Optional)
 
 from dsin_tpu.utils import locks as locks_lib
 
@@ -115,6 +115,14 @@ class MicroBatcher:
       close()            -> reject everything queued with ServiceDraining;
                             workers mid-batch are unaffected (in-flight
                             work completes — that is the drain guarantee)
+
+    Device-affine consumers (serve/placement.py): `next_batch(accept=…)`
+    takes an optional key SET — keys outside it are invisible to THIS
+    call, so a per-device executor only ever pops batches for buckets
+    placed on its device while other executors drain the rest. The
+    round-robin ring is shared across consumers (fairness is per-bucket,
+    not per-consumer); a consumer whose accepted keys are all empty
+    waits exactly like one facing an empty batcher.
     """
 
     def __init__(self, max_batch: int, max_wait_ms: float, max_queue: int,
@@ -202,13 +210,16 @@ class MicroBatcher:
         if expired and self.on_expired is not None:
             self.on_expired(expired)
 
-    def _next_key_locked(self) -> Optional[Hashable]:
+    def _next_key_locked(self, accept: Optional[AbstractSet[Hashable]] = None
+                         ) -> Optional[Hashable]:
         """Weighted-fair pop order: round-robin over the live keys in
         first-seen ring order, resuming after the last key served. Every
         live key is at most len(ring) pops from service, so a hot bucket
         with a continuously-refilling queue cannot starve the others
         (oldest-head selection could: its head is always the oldest
-        while a backlog of its own requests keeps arriving behind it)."""
+        while a backlog of its own requests keeps arriving behind it).
+        With `accept`, keys outside the set are skipped — they stay
+        queued for a consumer that does accept them."""
         n = len(self._order)
         if n == 0:
             return None
@@ -216,21 +227,27 @@ class MicroBatcher:
         for i in range(n):
             idx = (start + i) % n
             key = self._order[idx]
+            if accept is not None and key not in accept:
+                continue
             if self._queues.get(key):
                 self._rr = idx + 1
                 return key
         return None
 
-    def next_batch(self, timeout: Optional[float] = None
+    def next_batch(self, timeout: Optional[float] = None,
+                   accept: Optional[AbstractSet[Hashable]] = None
                    ) -> Optional[List[Request]]:
         """Block until a batch is ready. Returns [] when `timeout` elapses
         with nothing to do (so worker loops can poll a stop flag), None
-        once the batcher is closed and empty (worker should exit)."""
+        once the batcher is closed and empty (worker should exit).
+        `accept` restricts THIS call to a key set (device-affine
+        executors); pending keys outside it neither match nor wake it
+        beyond the shared condition's notify."""
         give_up = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while True:
                 self._expire_locked()
-                key = self._next_key_locked()
+                key = self._next_key_locked(accept)
                 if key is None:
                     if self._closed:
                         return None

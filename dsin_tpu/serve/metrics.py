@@ -125,6 +125,7 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}              # guarded-by: self._lock
         self._histograms: Dict[str, Histogram] = {}      # guarded-by: self._lock
         self._accumulators: Dict[str, Accumulator] = {}  # guarded-by: self._lock
+        self._info: Dict[str, object] = {}               # guarded-by: self._lock
 
     # construct only on miss (not setdefault's eager default): building
     # a metric builds its RankedLock, which registers a stats ledger —
@@ -159,13 +160,25 @@ class MetricsRegistry:
                 a = self._accumulators[name] = Accumulator()
             return a
 
+    def set_info(self, name: str, value) -> None:
+        """Publish a STRUCTURAL fact (JSON-able, e.g. the bucket->device
+        census `serve_device_assignments`) that a flat numeric metric
+        cannot carry. Rides the snapshot under "info" and renders as a
+        `# name json` comment line in the text format — structure for
+        humans/tests, no prometheus parser ever sees a non-numeric
+        sample."""
+        with self._lock:
+            self._info[name] = value
+
     def snapshot(self) -> dict:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
             accumulators = dict(self._accumulators)
+            info = dict(self._info)
         return {
+            "info": info,
             "counters": {k: c.value for k, c in sorted(counters.items())},
             "gauges": {k: g.value for k, g in sorted(gauges.items())},
             "histograms": {k: h.summary()
@@ -182,6 +195,8 @@ class MetricsRegistry:
     def render_text(self) -> str:
         snap = self.snapshot()
         lines = []
+        for k, v in snap["info"].items():
+            lines.append(f"# {k} {json.dumps(v, sort_keys=True)}")
         for k, v in snap["counters"].items():
             lines.append(f"{k}_total {v}")
         for k, v in snap["gauges"].items():

@@ -1,0 +1,260 @@
+"""Device placement for the serve bucket ladder (ISSUE 6 tentpole).
+
+Until now every jitted serve batch landed on whatever device jax
+defaulted to — device placement was a worker accident. This module makes
+it a first-class scheduler concern: the static shape-bucket ladder
+(serve/buckets.py) is mapped onto the device mesh the training stack
+already knows how to build (parallel/mesh.py), and micro-batches
+dispatch data-parallel WITHIN a bucket by running concurrently on the
+bucket's replica devices.
+
+Two layers:
+
+* **Policy** (`plan_placement` -> `PlacementPlan`): pure, deterministic
+  bucket -> replica-device-set assignment. Each bucket's replica count
+  is proportional to its traffic weight (the hot bucket gets replicas
+  across devices), replicas are packed onto the least-loaded devices
+  (cold buckets end up sharing a device), and two invariants always
+  hold: every bucket is served by >= 1 device and every device serves
+  >= 1 bucket — a device the plan leaves idle is paid-for silicon doing
+  nothing, so the planner refuses to produce one.
+
+* **Runtime** (`DevicePlacement`): owns one single-device sub-mesh per
+  serve device, built through `parallel/mesh.make_mesh` so batch/param
+  placement reuses the SAME `NamedSharding` specs as the training stack
+  (`batch_sharding` / `replicated`) instead of hand-rolled
+  `jax.device_put(x, device)` calls. The live plan swaps atomically
+  under the `serve.placement` rung (rank 15, utils/locks.py) so a
+  rebalance never tears the routing table under a running executor.
+
+Executable-census contract: a jitted call's cache entry is keyed by its
+input shardings, so each (bucket, device) pair in the plan is its own
+executable. The census is therefore `2 * sum(len(replicas))` — static,
+enumerable up front, and warmed per pair by `CompressionService.warmup`
+so `CompilationSentinel(budget=0)` holds at any device count. A
+rebalance may only ROUTE to pairs that have been warmed; the service
+warms any pair new to the incoming plan before swapping it live
+(serve/service.py `rebalance_placement`).
+
+Data parallelism here is at micro-batch granularity: two micro-batches
+of the hot bucket run on two replica devices simultaneously (each batch
+whole on one device), which keeps multi-device results bit-identical to
+the single-device path — the same executable program runs either way,
+there is just more than one of it. Intra-batch sharding (one batch
+split across devices) would add cross-device collective traffic on the
+fused paths for a 4-image batch; EQuARX (PAPERS.md, arXiv 2506.17615)
+is the reference if that route is ever profiled in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+from dsin_tpu.utils import locks as locks_lib
+
+Bucket = Tuple[int, int]
+
+
+class PlacementError(ValueError):
+    """A placement request the planner cannot honor (bad device count,
+    unknown bucket in the weight map, negative weight) — typed so the
+    serve door / CLI can answer it readably instead of asserting."""
+
+
+def _bucket_key(bucket: Bucket) -> str:
+    return f"{bucket[0]}x{bucket[1]}"
+
+
+@dataclass(frozen=True)
+class PlacementPlan:
+    """Immutable bucket -> replica-device-set assignment.
+
+    `assignments` maps each bucket to a sorted tuple of device INDICES
+    (positions in the serve device list, not jax ids — the runtime owns
+    the index -> device binding). `weights` records the traffic weights
+    the plan was computed from, so a rebalance diff is auditable.
+    """
+
+    num_devices: int
+    assignments: Mapping[Bucket, Tuple[int, ...]]
+    weights: Mapping[Bucket, float] = field(default_factory=dict)
+
+    def devices_for(self, bucket: Bucket) -> Tuple[int, ...]:
+        try:
+            return self.assignments[tuple(bucket)]
+        except KeyError:
+            raise PlacementError(
+                f"bucket {tuple(bucket)} is not in the placement plan "
+                f"(buckets: {sorted(self.assignments)})") from None
+
+    def buckets_for(self, device: int) -> Tuple[Bucket, ...]:
+        return tuple(b for b, devs in sorted(self.assignments.items())
+                     if device in devs)
+
+    def census(self) -> Tuple[Tuple[Bucket, int], ...]:
+        """Every (bucket, device) pair the plan can route to — the
+        executable census is exactly two jitted programs per pair."""
+        return tuple((b, d) for b, devs in sorted(self.assignments.items())
+                     for d in devs)
+
+    def as_dict(self) -> Dict[str, list]:
+        """JSON-able census for /metrics: {"128x256": [0, 1], ...}."""
+        return {_bucket_key(b): list(devs)
+                for b, devs in sorted(self.assignments.items())}
+
+
+def plan_placement(buckets: Sequence[Bucket], num_devices: int,
+                   weights: Optional[Mapping[Bucket, float]] = None
+                   ) -> PlacementPlan:
+    """Deterministic ladder -> mesh assignment.
+
+    Replica counts are proportional to weight share (at least 1, at most
+    `num_devices`); replicas then pack greedily onto the least-loaded
+    device not already hosting that bucket, heaviest bucket first, so
+    hot buckets spread across devices while cold buckets pile onto
+    whichever device has headroom. Devices the greedy pass left empty
+    adopt an extra replica of the bucket with the highest per-replica
+    load — every device always serves >= 1 bucket. Ties break by index,
+    so the same inputs always produce the same plan (the census must be
+    reproducible across service restarts for the compile cache to hit).
+    """
+    bl = [tuple(b) for b in buckets]
+    if not bl:
+        raise PlacementError("cannot place an empty bucket ladder")
+    if len(set(bl)) != len(bl):
+        raise PlacementError(f"duplicate buckets in ladder: {bl}")
+    if num_devices < 1:
+        raise PlacementError(
+            f"need at least one device, got num_devices={num_devices}")
+    if weights is None:
+        w = {b: 1.0 for b in bl}
+    else:
+        wmap = {tuple(k): float(v) for k, v in weights.items()}
+        unknown = sorted(set(wmap) - set(bl))
+        if unknown:
+            raise PlacementError(
+                f"weights name buckets outside the ladder: {unknown}")
+        if any(v < 0 for v in wmap.values()):
+            raise PlacementError(f"negative bucket weight in {wmap}")
+        w = {b: wmap.get(b, 1.0) for b in bl}
+    total = sum(w.values())
+    if total <= 0:          # all-zero weights degrade to uniform
+        w = {b: 1.0 for b in bl}
+        total = float(len(bl))
+
+    reps = {b: min(num_devices,
+                   max(1, round(num_devices * w[b] / total)))
+            for b in bl}
+    load = [0.0] * num_devices
+    assign: Dict[Bucket, list] = {b: [] for b in bl}
+    for b in sorted(bl, key=lambda bb: (-w[bb], bb)):
+        share = w[b] / reps[b]
+        for _ in range(reps[b]):
+            d = min((d for d in range(num_devices) if d not in assign[b]),
+                    key=lambda dd: (load[dd], dd))
+            assign[b].append(d)
+            load[d] += share
+    for d in range(num_devices):
+        if any(d in devs for devs in assign.values()):
+            continue
+        b = max((bb for bb in bl if d not in assign[bb]),
+                key=lambda bb: (w[bb] / len(assign[bb]), bb))
+        assign[b].append(d)
+        load[d] += w[b] / len(assign[b])
+    return PlacementPlan(
+        num_devices=num_devices,
+        assignments={b: tuple(sorted(devs)) for b, devs in assign.items()},
+        weights=dict(w))
+
+
+class DevicePlacement:
+    """The live routing table plus the per-device sharding machinery.
+
+    Built once at service start: one single-device sub-mesh per serve
+    device (through `parallel/mesh.make_mesh`, the same constructor the
+    training stack uses), with `batch_sharding`/`replicated` specs from
+    the same module — dispatching a micro-batch to device d is
+    `put_batch(d, x)`, a device_put under mesh.py's batch spec, not a
+    hand-rolled per-device transfer. Plan reads/swaps go through the
+    `serve.placement` lock so executors always see a complete table;
+    callers get immutable snapshots and never hold the lock across
+    device work.
+    """
+
+    def __init__(self, buckets: Sequence[Bucket],
+                 num_devices: Optional[int] = None,
+                 weights: Optional[Mapping[Bucket, float]] = None,
+                 devices: Optional[Sequence] = None):
+        import jax
+
+        from dsin_tpu.parallel import mesh as mesh_lib
+        if devices is None:
+            devices = jax.devices()
+        n = 1 if num_devices is None else int(num_devices)
+        if n < 1:
+            raise PlacementError(f"num_devices must be >= 1, got {n}")
+        if n > len(devices):
+            raise PlacementError(
+                f"requested {n} serve devices but only {len(devices)} "
+                f"are visible — on CPU hosts force more with "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
+        self.devices = tuple(devices[:n])
+        self.num_devices = n
+        # one (1, 1) sub-mesh per serve device: placement reuses the
+        # training stack's mesh/sharding constructors end to end
+        self._meshes = tuple(mesh_lib.make_mesh(devices=[d])
+                             for d in self.devices)
+        self._mesh_lib = mesh_lib
+        self._lock = locks_lib.RankedLock("serve.placement")
+        self._plan = plan_placement(buckets, n, weights)  # guarded-by: self._lock
+
+    # -- plan access ---------------------------------------------------------
+
+    @property
+    def plan(self) -> PlacementPlan:
+        with self._lock:
+            return self._plan
+
+    def devices_for(self, bucket: Bucket) -> Tuple[int, ...]:
+        with self._lock:
+            return self._plan.devices_for(bucket)
+
+    def buckets_for(self, device: int) -> Tuple[Bucket, ...]:
+        with self._lock:
+            return self._plan.buckets_for(device)
+
+    def set_plan(self, plan: PlacementPlan) -> bool:
+        """Swap the live routing table; returns whether it changed.
+        Callers (service rebalance) must have warmed every pair new to
+        `plan` BEFORE swapping, or the next routed batch compiles in
+        steady state."""
+        if plan.num_devices != self.num_devices:
+            raise PlacementError(
+                f"plan spans {plan.num_devices} devices; this placement "
+                f"runs {self.num_devices}")
+        with self._lock:
+            if set(plan.assignments) != set(self._plan.assignments):
+                raise PlacementError(
+                    "plan bucket set does not match the serve ladder")
+            changed = plan.assignments != self._plan.assignments
+            self._plan = plan
+        return changed
+
+    # -- device-side placement ----------------------------------------------
+
+    def put_batch(self, device: int, array):
+        """Host batch -> device `device` under mesh.py's batch sharding
+        (leading axis over 'data'; a 1-device axis = whole batch on that
+        device). Async like any device_put — the caller's jit dispatch
+        overlaps the transfer."""
+        return self._mesh_lib.shard_batch(self._meshes[device], array)
+
+    def replicate(self, device: int, tree):
+        """Pytree (params/batch_stats) -> fully-replicated residence on
+        device `device`, via mesh.py's replicated spec."""
+        return self._mesh_lib.replicate_state(self._meshes[device], tree)
+
+    def __repr__(self) -> str:
+        return (f"DevicePlacement(num_devices={self.num_devices}, "
+                f"plan={self.plan.as_dict()})")

@@ -74,6 +74,25 @@ empty pool fail fast with ServiceUnavailable instead of queueing work
 nobody will drain. Injection sites: `serve.worker.batch` (batch
 processing) and `serve.rans` (decode payload bytes) — no-ops unless a
 fault plan is installed (utils/faults.py).
+
+Multi-device dataplane (ISSUE 6): with `devices=N` the bucket ladder is
+mapped onto N devices by serve/placement.py (hot buckets get replicas
+across devices, cold buckets share one; every device serves >= 1
+bucket) and workers become DEVICE-AFFINE executors: slot s is pinned to
+device `s % N` for its whole life (restarts included), holds that
+device's replicated params (`placement.replicate`, a mesh.py sharding
+spec — not a hand-rolled device_put), and pops only batches for buckets
+placed on its device (`MicroBatcher.next_batch(accept=…)`). A hot
+bucket's replica executors drain one shared queue concurrently — data
+parallelism at micro-batch granularity, which keeps results bit-
+identical to the single-device path because each batch still runs whole
+through one (identical) executable. Warmup compiles per (bucket,
+device) census pair, so `CompilationSentinel(budget=0)` holds at any N;
+`rebalance_placement()` re-plans routing from observed per-bucket
+traffic, warming any pair new to the plan BEFORE the atomic table swap.
+Per-device observability: `serve_devices`, `serve_device_batches_d<i>`,
+`serve_device_busy_ms_d<i>`, `serve_placement_rebalances`, and the
+`serve_device_assignments` census in the /metrics info section.
 """
 
 from __future__ import annotations
@@ -84,7 +103,7 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +111,7 @@ import numpy as np
 
 from dsin_tpu.serve import buckets as buckets_lib
 from dsin_tpu.serve import metrics as metrics_lib
+from dsin_tpu.serve import placement as placement_lib
 from dsin_tpu.serve.batcher import (Future, MicroBatcher, Request,
                                     ServiceDraining, ServiceUnavailable)
 from dsin_tpu.utils import faults, recompile
@@ -118,7 +138,16 @@ class ServiceConfig:
     max_batch: int = 4
     max_wait_ms: float = 5.0
     max_queue: int = 64
+    #: executor threads PER DEVICE (total pool = workers * devices)
     workers: int = 1
+    #: devices to spread the bucket ladder over (serve/placement.py);
+    #: None = 1, the single-device dataplane every earlier PR ran. On
+    #: CPU hosts, force virtual devices with
+    #: XLA_FLAGS=--xla_force_host_platform_device_count=N first.
+    devices: Optional[int] = None
+    #: bucket -> traffic weight for the initial placement plan (None =
+    #: uniform); `rebalance_placement()` re-plans from observed traffic
+    placement_weights: Optional[Mapping[Tuple[int, int], float]] = None
     #: rANS pool size per service; 0 = serialized legacy path (entropy
     #: runs inline on the worker thread after/before the device call);
     #: None = auto: min(4, cores - 1), at least 1 — the entropy stage is
@@ -257,14 +286,15 @@ class _Inflight:
     finishing it (wait for entropy tasks; decode's device stage) and the
     per-batch ledger the stage metrics come from."""
 
-    __slots__ = ("kind", "batch", "bucket", "t0", "tasks", "handle",
-                 "sym", "per_item_exc", "crash")
+    __slots__ = ("kind", "batch", "bucket", "t0", "device", "tasks",
+                 "handle", "sym", "per_item_exc", "crash")
 
-    def __init__(self, kind, batch, bucket, t0):
+    def __init__(self, kind, batch, bucket, t0, device):
         self.kind = kind
         self.batch = batch
         self.bucket = bucket
         self.t0 = t0
+        self.device = device   # executor's device index (placement)
         self.tasks = []
         self.handle: Optional[_DeviceBatch] = None   # encode
         self.sym: Optional[np.ndarray] = None        # decode gather
@@ -308,6 +338,12 @@ class CompressionService:
         self._entropy_hook = None  # test/diagnostic: called per pool task
         self._entropy_pool: Optional[ThreadPoolExecutor] = None
         self._codec_local = threading.local()
+        self.placement: Optional[placement_lib.DevicePlacement] = None
+        self._num_devices = 1
+        self._total_workers = 0
+        # (bucket, device) pairs whose two executables exist — mutated
+        # only by warmup()/rebalance_placement() on the caller's thread
+        self._warmed_pairs = set()
         self.model = None
         self.state = None
         self.codec = None
@@ -328,6 +364,21 @@ class CompressionService:
         self.codec = make_codec(self.model, self.state)
         self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
         self._bn_channels = int(self.model.ae_config.num_chan_bn)
+        # ladder -> mesh: the routing table executors read, plus one
+        # committed replica of (params, batch_stats) per serve device so
+        # a dispatch never drags parameters across devices at call time
+        # None means 1; an explicit 0 (or negative) is a config bug and
+        # must raise DevicePlacement's typed PlacementError, not be
+        # silently reinterpreted as single-device
+        self._num_devices = (1 if self.config.devices is None
+                             else int(self.config.devices))
+        self.placement = placement_lib.DevicePlacement(
+            self.policy.buckets, num_devices=self._num_devices,
+            weights=self.config.placement_weights)
+        self._device_state = [
+            self.placement.replicate(
+                d, (self.state.params, self.state.batch_stats))
+            for d in range(self._num_devices)]
         recompile.install()
         ew = self.config.entropy_workers
         if ew is None:
@@ -337,12 +388,15 @@ class CompressionService:
         if ew > 0:
             self._entropy_pool = ThreadPoolExecutor(
                 max_workers=ew, thread_name_prefix="serve-entropy")
+        self._total_workers = self.config.workers * self._num_devices
         with self._workers_lock:
-            for i in range(self.config.workers):
+            for i in range(self._total_workers):
                 self._workers.append(self._spawn_worker(i))
                 self._restarts.append(0)
                 self._restart_at.append(None)
-        self.metrics.gauge("serve_workers_live").set(self.config.workers)
+        self.metrics.gauge("serve_workers_live").set(self._total_workers)
+        self.metrics.gauge("serve_devices").set(self._num_devices)
+        self._publish_placement()
         self._supervisor = threading.Thread(target=self._supervise_loop,
                                             name="serve-supervisor",
                                             daemon=True)
@@ -355,31 +409,29 @@ class CompressionService:
         return self
 
     def warmup(self) -> dict:
-        """Compile every (bucket, direction) executable, prime the numpy
-        entropy engine's schedules, and spin up the entropy pool threads
-        (each builds its codec clone), so the first real request pays
-        nothing. Returns {"compiles": n, "cache_hits": h, "seconds": s}
-        — with the persistent compilation cache on, a restarted service
-        reports compiles == cache_hits: every executable was loaded from
-        disk, none rebuilt (utils/recompile.py counts a cache load in
-        BOTH numbers)."""
+        """Compile every (bucket, device, direction) executable in the
+        placement plan's census, prime the numpy entropy engine's
+        schedules, and spin up the entropy pool threads (each builds its
+        codec clone), so the first real request pays nothing. Returns
+        {"compiles": n, "cache_hits": h, "seconds": s} — with the
+        persistent compilation cache on, a restarted service reports
+        compiles == cache_hits: every executable was loaded from disk,
+        none rebuilt (utils/recompile.py counts a cache load in BOTH
+        numbers)."""
         assert self._started, "start() before warmup()"
         t0 = time.monotonic()
         before = recompile.compilation_count()
         before_hits = recompile.cache_hit_count()
-        params, bs = self.state.params, self.state.batch_stats
+        plan = self.placement.plan
         for bh, bw in self.policy.buckets:
-            x = jnp.zeros((self.config.max_batch, bh, bw, 3), jnp.float32)
-            symbols = np.asarray(self._encode_fn(params, bs, x))
+            symbols = None
+            for d in plan.devices_for((bh, bw)):
+                symbols = self._warm_pair((bh, bw), d)
             # one per-image entropy roundtrip primes the incremental
             # engine's schedule path for this bucket's volume geometry
+            # (device-independent: once per bucket, not per pair)
             stream = self.codec.encode(np.transpose(symbols[0], (2, 0, 1)))
             self.codec.decode(stream)
-            sym_batch = jnp.zeros(
-                (self.config.max_batch, bh // buckets_lib.SUBSAMPLING,
-                 bw // buckets_lib.SUBSAMPLING, self._bn_channels),
-                jnp.int32)
-            np.asarray(self._decode_fn(params, bs, sym_batch))
         if self._entropy_pool is not None:
             # force every pool thread into existence and build its codec
             # clone now (the barrier keeps the tasks on distinct
@@ -397,9 +449,67 @@ class CompressionService:
         cache_hits = recompile.cache_hit_count() - before_hits
         self.metrics.gauge("serve_warmup_compiles").set(compiles)
         self.metrics.gauge("serve_buckets").set(len(self.policy.buckets))
+        self.metrics.gauge("serve_executable_census").set(
+            2 * len(self._warmed_pairs))
         return {"compiles": compiles,
                 "cache_hits": cache_hits,
                 "seconds": time.monotonic() - t0}
+
+    def _warm_pair(self, bucket: Tuple[int, int], device: int) -> np.ndarray:
+        """Compile/prime BOTH executables of one (bucket, device) census
+        pair — the input shardings commit the jit cache entries to that
+        device. Returns the encode symbols so warmup can prime the
+        bucket's entropy schedules."""
+        bh, bw = bucket
+        params, bs = self._device_state[device]
+        x = self.placement.put_batch(
+            device, np.zeros((self.config.max_batch, bh, bw, 3),
+                             np.float32))
+        symbols = np.asarray(self._encode_fn(params, bs, x))
+        sym = self.placement.put_batch(
+            device, np.zeros(
+                (self.config.max_batch, bh // buckets_lib.SUBSAMPLING,
+                 bw // buckets_lib.SUBSAMPLING, self._bn_channels),
+                np.int32))
+        np.asarray(self._decode_fn(params, bs, sym))
+        self._warmed_pairs.add((bucket, device))
+        return symbols
+
+    def _publish_placement(self) -> None:
+        """Export the live bucket->device census (the
+        `serve_device_assignments` info entry every scrape carries)."""
+        self.metrics.set_info("serve_device_assignments",
+                              self.placement.plan.as_dict())
+
+    def rebalance_placement(self, weights=None) -> dict:
+        """Re-plan bucket->device routing. `weights` defaults to the
+        OBSERVED per-bucket request counts (+1 smoothing, so an idle
+        bucket keeps a replica) — the operator hook for 'the hot bucket
+        moved'. Any (bucket, device) pair new to the incoming plan is
+        warmed BEFORE the atomic table swap, so the executable census
+        only ever grows by warmed pairs and the zero-steady-compile pin
+        keeps holding once this returns. Executors read the new table at
+        their next batch pop; in-flight batches finish on their old
+        (still-warmed) device."""
+        assert self._started, "start() + warmup() before rebalance"
+        if weights is None:
+            weights = {
+                (bh, bw): 1.0 + self.metrics.counter(
+                    f"serve_bucket_requests_{bh}x{bw}").value
+                for bh, bw in self.policy.buckets}
+        plan = placement_lib.plan_placement(
+            self.policy.buckets, self._num_devices, weights)
+        new_pairs = [pair for pair in plan.census()
+                     if pair not in self._warmed_pairs]
+        for bucket, device in new_pairs:
+            self._warm_pair(bucket, device)
+        changed = self.placement.set_plan(plan)
+        self.metrics.counter("serve_placement_rebalances").inc()
+        self.metrics.gauge("serve_executable_census").set(
+            2 * len(self._warmed_pairs))
+        self._publish_placement()
+        return {"changed": changed, "warmed_pairs": len(new_pairs),
+                "assignments": plan.as_dict()}
 
     @property
     def draining(self) -> bool:
@@ -472,7 +582,7 @@ class CompressionService:
 
     def health(self) -> dict:
         live = self.live_workers
-        configured = self.config.workers if self._started else 0
+        configured = self._total_workers if self._started else 0
         if self.draining:
             status = "draining"
         elif live == 0:
@@ -484,6 +594,9 @@ class CompressionService:
         return {"status": status,
                 "queue_depth": self._batcher.depth,
                 "buckets": [list(b) for b in self.policy.buckets],
+                "devices": self._num_devices,
+                "assignments": (self.placement.plan.as_dict()
+                                if self.placement is not None else {}),
                 "workers_live": live,
                 "workers_configured": configured,
                 "worker_restarts":
@@ -573,26 +686,45 @@ class CompressionService:
 
     def _worker_main(self, slot: int) -> None:
         """Thread target: run the loop; record a fatal exit for the
-        supervisor instead of spewing the default thread traceback."""
+        supervisor instead of spewing the default thread traceback.
+        Device affinity is a function of the SLOT (`slot % devices`), so
+        a supervisor restart lands the replacement executor on the same
+        device — the census and the per-device queues never move."""
         try:
-            self._worker_loop()
+            self._worker_loop(slot % self._num_devices)
         except BaseException as e:  # noqa: BLE001 — supervisor's evidence
             with self._workers_lock:
                 self._worker_exits[slot] = e
             self.metrics.counter("serve_worker_crashes").inc()
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, device: int) -> None:
         inflight: deque = deque()
         depth = max(1, int(self.config.pipeline_depth)) \
             if self._entropy_pool is not None else 1
         gauge = self.metrics.gauge("serve_pipeline_inflight")
+        # the accept set — both directions of every bucket the live plan
+        # places on this device — is rebuilt only when the plan object
+        # changes (a rebalance): next_batch is the executor's hottest
+        # call (a 0-timeout busy-poll while batches are in flight), so
+        # per-pop it pays one plan-snapshot read, not a frozenset build.
+        # None (no filter) on a single device — the pre-placement path.
+        accept = None
+        accept_plan = None
         try:
             while True:
+                if self._num_devices > 1:
+                    plan = self.placement.plan
+                    if plan is not accept_plan:
+                        accept_plan = plan
+                        accept = frozenset(
+                            (kind, bucket)
+                            for kind in (ENCODE, DECODE)
+                            for bucket in plan.buckets_for(device))
                 # with work in flight, poll instead of blocking: an empty
                 # queue means it is time to finish the oldest batch, not
                 # to sit on it for the poll interval
                 batch = self._batcher.next_batch(
-                    timeout=0.0 if inflight else 0.25)
+                    timeout=0.0 if inflight else 0.25, accept=accept)
                 if batch is None:
                     return        # closed and empty: finally flushes
                 if not batch:
@@ -601,7 +733,7 @@ class CompressionService:
                     continue
                 t_start = time.monotonic()
                 try:
-                    rec = self._start_batch(batch)
+                    rec = self._start_batch(batch, device)
                 except BaseException as e:  # noqa: BLE001 — answer callers
                     for r in batch:
                         if not r.future.done():
@@ -614,7 +746,9 @@ class CompressionService:
                         raise
                     continue
                 if rec is not None:
-                    self._busy_ms.add((time.monotonic() - t_start) * 1e3)
+                    dt = (time.monotonic() - t_start) * 1e3
+                    self._busy_ms.add(dt)
+                    self._device_busy(device).add(dt)
                     inflight.append(rec)
                     gauge.set(len(inflight))
                 while len(inflight) >= depth:
@@ -679,6 +813,12 @@ class CompressionService:
         serve_overlap_ratio."""
         return self.metrics.accumulator("serve_busy_ms_total")
 
+    def _device_busy(self, device: int) -> metrics_lib.Accumulator:
+        """Per-device slice of the busy time — with per-device batch
+        counts, the occupancy evidence serve_bench's --devices axis
+        records (an idle device shows up as a flat line here)."""
+        return self.metrics.accumulator(f"serve_device_busy_ms_d{device}")
+
     def _thread_codec(self):
         """Entropy-stage codec for the CURRENT thread: pool threads each
         own a BottleneckCodec clone (per-pass rANS/buffer state stays
@@ -692,12 +832,14 @@ class CompressionService:
             self._codec_local.codec = codec
         return codec
 
-    def _start_batch(self, batch) -> Optional[_Inflight]:
+    def _start_batch(self, batch, device: int) -> Optional[_Inflight]:
         """Stage 1, on the worker thread. Serialized mode
         (entropy_workers=0) runs the whole batch here and returns None;
         pipelined mode dispatches the device stage / fans the entropy
         work out to the pool and returns the in-flight record for
-        _finish_batch."""
+        _finish_batch. `device` is the executor's placement index: the
+        batch is placed there (mesh.py batch sharding) and computed
+        against that device's replicated params."""
         faults.inject("serve.worker.batch")
         if self._batch_hook is not None:
             self._batch_hook(batch)
@@ -708,26 +850,30 @@ class CompressionService:
             len(batch) / self.config.max_batch)
         if self._entropy_pool is None:
             if kind == ENCODE:
-                device_ms, entropy_ms = self._run_encode(batch, bucket)
+                device_ms, entropy_ms = self._run_encode(
+                    batch, bucket, device)
             else:
-                device_ms, entropy_ms = self._run_decode(batch, bucket)
-            self._busy_ms.add((time.monotonic() - t0) * 1e3)
-            self._note_batch_done(batch, t0, device_ms, entropy_ms,
+                device_ms, entropy_ms = self._run_decode(
+                    batch, bucket, device)
+            dt = (time.monotonic() - t0) * 1e3
+            self._busy_ms.add(dt)
+            self._device_busy(device).add(dt)
+            self._note_batch_done(batch, t0, device_ms, entropy_ms, device,
                                   observe_latency=True)
             return None
-        rec = _Inflight(kind, batch, bucket, t0)
+        rec = _Inflight(kind, batch, bucket, t0, device)
         if kind == ENCODE:
             bh, bw = bucket
             x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
             for i, r in enumerate(batch):
                 x[i] = r.payload[0]
+            params, bs = self._device_state[device]
             # async dispatch: the jit call returns before the device
             # finishes; the transfer happens in whichever pool task
             # first calls rec.handle.host() — the worker never blocks
             # here, so batch N+1's device call can follow immediately
             rec.handle = _DeviceBatch(self._encode_fn(
-                self.state.params, self.state.batch_stats,
-                jnp.asarray(x)))
+                params, bs, self.placement.put_batch(device, x)))
         else:
             bh, bw = bucket
             sub = buckets_lib.SUBSAMPLING
@@ -802,9 +948,9 @@ class CompressionService:
             self.metrics.counter("serve_device_skipped_batches").inc()
         else:
             t_dev = time.monotonic()
+            params, bs = self._device_state[rec.device]
             imgs = np.asarray(self._decode_fn(
-                self.state.params, self.state.batch_stats,
-                jnp.asarray(rec.sym)))
+                params, bs, self.placement.put_batch(rec.device, rec.sym)))
             device_ms = (time.monotonic() - t_dev) * 1e3
             for i, r in enumerate(rec.batch):
                 if i in rec.per_item_exc:
@@ -818,8 +964,11 @@ class CompressionService:
         ends = [s[1] for s in spans if s[1] is not None]
         entropy_ms = (max(ends) - min(starts)) * 1e3 \
             if starts and ends else 0.0
-        self._busy_ms.add((time.monotonic() - tf0) * 1e3)
-        self._note_batch_done(rec.batch, rec.t0, device_ms, entropy_ms)
+        dt = (time.monotonic() - tf0) * 1e3
+        self._busy_ms.add(dt)
+        self._device_busy(rec.device).add(dt)
+        self._note_batch_done(rec.batch, rec.t0, device_ms, entropy_ms,
+                              rec.device)
         if rec.crash is not None:
             raise rec.crash
 
@@ -831,13 +980,19 @@ class CompressionService:
             (time.monotonic() - req.arrival) * 1e3)
 
     def _note_batch_done(self, batch, t0, device_ms, entropy_ms,
-                         observe_latency: bool = False) -> None:
+                         device: int, observe_latency: bool = False) -> None:
         now = time.monotonic()
         if observe_latency:
             # serialized path: futures resolved moments ago in _run_*,
             # so note-time latency is resolution-time latency
             for r in batch:
                 self._observe_latency(r)
+        _, bucket = batch[0].key
+        # per-bucket traffic census: rebalance_placement()'s default
+        # weights, and the evidence a placement decision is read against
+        self.metrics.counter(
+            f"serve_bucket_requests_{bucket[0]}x{bucket[1]}").inc(len(batch))
+        self.metrics.counter(f"serve_device_batches_d{device}").inc()
         self.metrics.counter("serve_batches").inc()
         self.metrics.counter("serve_completed").inc(len(batch))
         self.metrics.histogram("serve_batch_ms").observe((now - t0) * 1e3)
@@ -862,16 +1017,17 @@ class CompressionService:
             self.metrics.gauge("serve_overlap_ratio").set(
                 max(0.0, 1.0 - busy / (dev + ent)))
 
-    def _run_encode(self, batch, bucket) -> Tuple[float, float]:
+    def _run_encode(self, batch, bucket, device: int) -> Tuple[float, float]:
         """Serialized encode (entropy_workers=0): device then entropy,
         inline on the worker thread. Returns (device_ms, entropy_ms)."""
         bh, bw = bucket
         x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
         for i, r in enumerate(batch):
             x[i] = r.payload[0]
+        params, bs = self._device_state[device]
         t_dev = time.monotonic()
         symbols = np.asarray(self._encode_fn(
-            self.state.params, self.state.batch_stats, jnp.asarray(x)))
+            params, bs, self.placement.put_batch(device, x)))
         t_ent = time.monotonic()
         for i, r in enumerate(batch):
             h, w = r.payload[1]
@@ -883,7 +1039,7 @@ class CompressionService:
                 shape=(h, w), bucket=bucket))
         return ((t_ent - t_dev) * 1e3, (time.monotonic() - t_ent) * 1e3)
 
-    def _run_decode(self, batch, bucket) -> Tuple[float, float]:
+    def _run_decode(self, batch, bucket, device: int) -> Tuple[float, float]:
         """Serialized decode (entropy_workers=0): entropy then device,
         inline on the worker thread. Returns (device_ms, entropy_ms)."""
         bh, bw = bucket
@@ -915,9 +1071,10 @@ class CompressionService:
                 r.future.set_exception(per_item_exc[i])
             self.metrics.counter("serve_device_skipped_batches").inc()
             return (0.0, entropy_ms)
+        params, bs = self._device_state[device]
         t_dev = time.monotonic()
         imgs = np.asarray(self._decode_fn(
-            self.state.params, self.state.batch_stats, jnp.asarray(sym)))
+            params, bs, self.placement.put_batch(device, sym)))
         device_ms = (time.monotonic() - t_dev) * 1e3
         for i, r in enumerate(batch):
             if i in per_item_exc:

@@ -28,6 +28,7 @@ a thread holding rank r may only acquire ranks > r):
 
     rank  name                where
       10  serve.batcher       MicroBatcher's condition (serve/batcher.py)
+      15  serve.placement     bucket->device routing table (serve/placement.py)
       20  serve.workers       worker-pool bookkeeping (serve/service.py)
       30  codec.engine        lazy incremental-engine slot (coding/codec.py)
       35  codec.schedules     per-shape schedule cache (coding/incremental.py)
@@ -63,6 +64,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 #: for the rationale per rung.
 HIERARCHY: Dict[str, int] = {
     "serve.batcher": 10,
+    "serve.placement": 15,
     "serve.workers": 20,
     "codec.engine": 30,
     "codec.schedules": 35,

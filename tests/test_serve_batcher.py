@@ -146,6 +146,75 @@ def test_next_batch_timeout_returns_empty_list():
     assert time.monotonic() - t0 < 1.0
 
 
+# -- accept filter: device-affine consumers (ISSUE 6) -------------------------
+
+def test_accept_filter_pops_only_eligible_keys():
+    """A consumer restricted to key "a" never sees "b" — and "b" stays
+    queued, untouched, for a consumer that does accept it."""
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=16)
+    ra, rb = _req(key="a"), _req(key="b")
+    b.submit(rb)               # "b" is first in ring order
+    b.submit(ra)
+    got = b.next_batch(timeout=1, accept=frozenset(["a"]))
+    assert got == [ra]
+    assert b.next_batch(timeout=0.05, accept=frozenset(["a"])) == []
+    assert b.depth == 1        # "b" still queued
+    assert b.next_batch(timeout=1, accept=frozenset(["b"])) == [rb]
+
+
+def test_accept_filter_times_out_like_an_empty_batcher():
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=16)
+    b.submit(_req(key="b"))
+    t0 = time.monotonic()
+    assert b.next_batch(timeout=0.05, accept=frozenset(["a"])) == []
+    assert time.monotonic() - t0 < 1.0
+    assert b.depth == 1
+
+
+def test_disjoint_consumers_drain_their_own_keys_concurrently():
+    """Two device-affine consumers with disjoint accept sets fully
+    partition the stream: every request lands with exactly the consumer
+    that accepts its key, FIFO within key."""
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=64)
+    reqs = {k: [_req(key=k) for _ in range(6)] for k in ("a", "b")}
+    for ra, rb in zip(reqs["a"], reqs["b"]):
+        b.submit(ra)
+        b.submit(rb)
+    got = {"a": [], "b": []}
+
+    def consume(key):
+        while True:
+            batch = b.next_batch(timeout=0.2, accept=frozenset([key]))
+            if not batch:
+                return
+            assert all(r.key == key for r in batch)
+            got[key].extend(batch)
+
+    ts = [threading.Thread(target=consume, args=(k,)) for k in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert got["a"] == reqs["a"] and got["b"] == reqs["b"]
+    assert b.depth == 0
+
+
+def test_accept_none_keeps_legacy_any_key_behavior():
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=16)
+    b.submit(_req(key="a"))
+    b.submit(_req(key="b"))
+    assert b.next_batch(timeout=1, accept=None)
+    assert b.next_batch(timeout=1)
+    assert b.depth == 0
+
+
+def test_closed_batcher_returns_none_to_filtered_consumer():
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=16)
+    b.submit(_req(key="b"))
+    b.close()
+    assert b.next_batch(timeout=0.2, accept=frozenset(["a"])) is None
+
+
 # -- deadline expiry racing drain (forced interleavings) ----------------------
 #
 # Both orderings of the previously-untested race: a queued request whose

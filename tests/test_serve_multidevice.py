@@ -1,0 +1,229 @@
+"""Multi-device serve dataplane (ISSUE 6 tentpole): routing correctness.
+
+The acceptance contracts, on FORCED host devices (conftest pins 8):
+
+  * bit-identity — every request's result through an N-device service is
+    byte-for-byte (encode) / element-for-element (decode) identical to
+    the single-device path: placement only ADDS copies of the same
+    executable, it never changes what any one batch computes;
+  * the (bucket, device) executable census is static — a mixed-shape
+    stream at N=8 runs under `CompilationSentinel(budget=0)` after
+    warmup;
+  * killing an executor on ONE device leaves the other devices' queues
+    undisturbed (their buckets keep serving during the backoff window)
+    and the supervisor heals the dead slot back onto the SAME device
+    with zero new compiles;
+  * `rebalance_placement` warms pairs new to the incoming plan BEFORE
+    the swap, updates the census info + rebalance counter, and steady
+    state stays compile-free afterwards.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import CompressionService, EncodeResult, ServiceConfig
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.recompile import CompilationSentinel
+
+BUCKETS = ((16, 24), (32, 48))
+SHAPES = [(16, 24), (10, 17), (32, 48), (24, 40), (9, 33)]
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("multidevice_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def _service(tiny_cfg_files, **over):
+    ae_p, pc_p = tiny_cfg_files
+    kw = dict(ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS,
+              max_batch=2, max_wait_ms=1.0, max_queue=64, workers=1,
+              restart_backoff_s=0.05, restart_backoff_max_s=0.2)
+    kw.update(over)
+    svc = CompressionService(ServiceConfig(**kw)).start()
+    svc.warmup()
+    return svc
+
+
+def _imgs(seed, n=10):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            for h, w in (SHAPES * n)[:n]]
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return pred()
+
+
+def test_multidevice_results_bit_identical_to_single_device(tiny_cfg_files):
+    """Same model seed, same request stream, N=1 vs N=4: encode frames
+    byte-equal, decodes element-equal — and the N=4 steady state never
+    compiles. Data parallelism at micro-batch granularity means the
+    same executable program runs either way; this pins it."""
+    svc1 = _service(tiny_cfg_files, devices=1)
+    svc4 = _service(tiny_cfg_files, devices=4)
+    try:
+        imgs = _imgs(0, n=10)
+        with CompilationSentinel(budget=0, label="N=4 steady state"):
+            enc1 = [svc1.encode(im, timeout=60) for im in imgs]
+            enc4 = [svc4.encode(im, timeout=60) for im in imgs]
+            for a, b in zip(enc1, enc4):
+                assert isinstance(b, EncodeResult)
+                assert a.stream == b.stream
+                assert a.bpp == b.bpp
+            for res in enc1:
+                d1 = svc1.decode(res.stream, timeout=60)
+                d4 = svc4.decode(res.stream, timeout=60)
+                np.testing.assert_array_equal(d1, d4)
+        # the plan spread the ladder: more than one device saw batches
+        snap = svc4.metrics.snapshot()
+        served = [d for d in range(4) if snap["counters"].get(
+            f"serve_device_batches_d{d}", 0) > 0]
+        assert len(served) >= 2, snap["counters"]
+        assert snap["gauges"]["serve_devices"] == 4
+        assert snap["info"]["serve_device_assignments"]
+    finally:
+        svc1.drain()
+        svc4.drain()
+
+
+def test_mixed_shape_steady_state_compiles_zero_at_8_devices(tiny_cfg_files):
+    """The budget-0 pin at full fan-out: 3 buckets mapped over 8 forced
+    host devices, mixed shapes both directions, zero XLA compiles after
+    the per-(bucket, device) warmup."""
+    svc = _service(tiny_cfg_files, devices=8,
+                   buckets=((16, 24), (32, 48), (48, 64)))
+    try:
+        plan = svc.placement.plan
+        assert {d for devs in plan.assignments.values()
+                for d in devs} == set(range(8))
+        with CompilationSentinel(budget=0, label="N=8 steady state"):
+            streams = [svc.encode(im, timeout=60).stream
+                       for im in _imgs(1, n=12)]
+            for s in streams:
+                assert svc.decode(s, timeout=60).ndim == 3
+        assert svc.metrics.gauge("serve_executable_census").value \
+            == 2 * len(plan.census())
+    finally:
+        svc.drain()
+
+
+@pytest.mark.chaos
+def test_kill_worker_on_one_device_other_devices_undisturbed(
+        tiny_cfg_files):
+    """Crash the executor pinned to device 1 (bucket (32, 48)); while
+    its slot sits in restart backoff, device 0's bucket keeps serving.
+    The supervisor then heals slot -> SAME device and the revived bucket
+    serves again — all under a budget-0 sentinel."""
+    svc = _service(tiny_cfg_files, devices=2, restart_backoff_s=0.3)
+    crashed = []
+    try:
+        # uniform weights over 2 buckets x 2 devices: one bucket each
+        assert svc.placement.plan.as_dict() == {"16x24": [0],
+                                                "32x48": [1]}
+
+        def hook(batch):  # noqa: ARG001 — kill device 1's executor once
+            name = threading.current_thread().name
+            slot = int(name.rsplit("-", 1)[1])
+            if slot % 2 == 1 and not crashed:
+                crashed.append(slot)
+                raise faults.InjectedCrash("die on device 1")
+
+        svc._batch_hook = hook
+        rng = np.random.default_rng(2)
+        img_d0 = rng.integers(0, 255, (16, 24, 3), dtype=np.uint8)
+        img_d1 = rng.integers(0, 255, (32, 48, 3), dtype=np.uint8)
+        restarts = svc.metrics.counter("serve_worker_restarts")
+        with CompilationSentinel(budget=0, label="one-device crash"):
+            fb = svc.submit_encode(img_d1)
+            assert isinstance(fb.exception(timeout=30),
+                              faults.InjectedCrash)
+            # device 1's slot is dead (backoff window); device 0 serves
+            assert _wait(lambda: svc.live_workers == 1), svc.live_workers
+            for _ in range(3):
+                assert isinstance(svc.encode(img_d0, timeout=30),
+                                  EncodeResult)
+            assert svc.metrics.counter("serve_worker_crashes").value == 1
+            # heal: same slot, same device, same executables
+            assert _wait(lambda: restarts.value >= 1
+                         and svc.live_workers == 2)
+            assert isinstance(svc.encode(img_d1, timeout=30),
+                              EncodeResult)
+        # the future resolves in the entropy stage; the per-device batch
+        # counter publishes at pipeline finish, a beat later
+        d1 = svc.metrics.counter("serve_device_batches_d1")
+        assert _wait(lambda: d1.value >= 1)
+        assert svc.metrics.counter(
+            "serve_device_batches_d0").value >= 3
+    finally:
+        svc._batch_hook = None
+        svc.drain()
+
+
+def test_rebalance_warms_new_pairs_then_swaps(tiny_cfg_files):
+    """Operator shifts the weights: the hot bucket gains a replica on a
+    device it was never warmed on. The rebalance must warm that pair
+    BEFORE swapping (compiles land inside the rebalance call), bump the
+    counter + census info, and leave steady state compile-free."""
+    svc = _service(tiny_cfg_files, devices=2)
+    try:
+        before = dict(svc.placement.plan.as_dict())
+        out = svc.rebalance_placement(
+            weights={(16, 24): 10.0, (32, 48): 1.0})
+        assert out["changed"], (before, out)
+        assert out["warmed_pairs"] >= 1
+        assert set(out["assignments"]["16x24"]) == {0, 1}
+        assert svc.metrics.counter(
+            "serve_placement_rebalances").value == 1
+        snap = svc.metrics.snapshot()
+        assert snap["info"]["serve_device_assignments"] \
+            == out["assignments"]
+        rng = np.random.default_rng(3)
+        with CompilationSentinel(budget=0, label="post-rebalance"):
+            for _ in range(4):
+                res = svc.encode(rng.integers(0, 255, (16, 24, 3),
+                                              dtype=np.uint8), timeout=30)
+                assert svc.decode(res.stream, timeout=30).shape \
+                    == (16, 24, 3)
+                res = svc.encode(rng.integers(0, 255, (32, 48, 3),
+                                              dtype=np.uint8), timeout=30)
+    finally:
+        svc.drain()
+
+
+def test_observed_traffic_rebalance_uses_bucket_counters(tiny_cfg_files):
+    """No explicit weights: the default plan input is the per-bucket
+    request census — drive traffic at one bucket and the rebalanced
+    plan gives it at least as many replicas as the idle one."""
+    svc = _service(tiny_cfg_files, devices=2)
+    try:
+        rng = np.random.default_rng(4)
+        for _ in range(6):
+            svc.encode(rng.integers(0, 255, (16, 24, 3), dtype=np.uint8),
+                       timeout=30)
+        out = svc.rebalance_placement()
+        hot = out["assignments"]["16x24"]
+        cold = out["assignments"]["32x48"]
+        assert len(hot) >= len(cold), out
+        # rebalance is idempotent on unchanged traffic
+        again = svc.rebalance_placement()
+        assert again["assignments"] == out["assignments"]
+        assert not again["changed"]
+        assert svc.metrics.counter(
+            "serve_placement_rebalances").value == 2
+    finally:
+        svc.drain()

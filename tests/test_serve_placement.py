@@ -1,0 +1,158 @@
+"""Placement policy unit tests (serve/placement.py, ISSUE 6 tentpole).
+
+The plan is pure data — these tests pin the policy invariants the
+service and bench rely on WITHOUT any jax/device machinery:
+
+  * every bucket is served by >= 1 device and every device serves
+    >= 1 bucket (an unreachable bucket 503s forever; an unassigned
+    device is idle paid-for silicon);
+  * replica counts follow the traffic weights: the hot bucket spreads
+    across devices, cold buckets end up sharing one;
+  * determinism — the census must reproduce across restarts or the
+    persistent compile cache can never hit;
+  * typed PlacementError for every malformed request (the serve door
+    answers these readably; `python -O` must not change behavior).
+"""
+
+import pytest
+
+from dsin_tpu.serve import PlacementError, plan_placement
+from dsin_tpu.serve.placement import PlacementPlan
+
+LADDER = ((16, 24), (32, 48), (64, 96))
+
+
+def _devices_used(plan: PlacementPlan):
+    return {d for devs in plan.assignments.values() for d in devs}
+
+
+@pytest.mark.parametrize("num_devices", [1, 2, 3, 4, 8])
+def test_every_bucket_served_and_every_device_used(num_devices):
+    plan = plan_placement(LADDER, num_devices)
+    assert set(plan.assignments) == set(LADDER)
+    assert all(len(devs) >= 1 for devs in plan.assignments.values())
+    assert _devices_used(plan) == set(range(num_devices))
+    for d in range(num_devices):
+        assert plan.buckets_for(d), f"device {d} serves nothing"
+
+
+def test_single_device_degenerates_to_legacy_layout():
+    plan = plan_placement(LADDER, 1)
+    assert all(devs == (0,) for devs in plan.assignments.values())
+    assert plan.census() == tuple((b, 0) for b in sorted(LADDER))
+
+
+def test_hot_bucket_gets_replicas_cold_buckets_share():
+    hot, cold1, cold2 = LADDER
+    plan = plan_placement(LADDER, 4,
+                          weights={hot: 10.0, cold1: 1.0, cold2: 1.0})
+    assert len(plan.devices_for(hot)) >= 2, plan.as_dict()
+    # the two cold buckets fit beside each other, not beside the hot one
+    cold_devs = set(plan.devices_for(cold1)) | set(plan.devices_for(cold2))
+    assert len(cold_devs) < 4, plan.as_dict()
+    assert _devices_used(plan) == set(range(4))
+
+
+def test_uniform_weights_spread_single_bucket_over_all_devices():
+    plan = plan_placement([(16, 24)], 8)
+    assert plan.devices_for((16, 24)) == tuple(range(8))
+
+
+def test_plan_is_deterministic():
+    a = plan_placement(LADDER, 8, weights={b: w for b, w in
+                                           zip(LADDER, (3.0, 1.0, 2.0))})
+    b = plan_placement(LADDER, 8, weights={b: w for b, w in
+                                           zip(LADDER, (3.0, 1.0, 2.0))})
+    assert a.assignments == b.assignments
+    assert a.census() == b.census()
+
+
+def test_census_counts_every_pair_once():
+    plan = plan_placement(LADDER, 4)
+    census = plan.census()
+    assert len(census) == len(set(census))
+    assert len(census) == sum(len(v) for v in plan.assignments.values())
+    # as_dict round-trips the same pairs in JSON-able form
+    assert sum(len(v) for v in plan.as_dict().values()) == len(census)
+
+
+def test_zero_weights_degrade_to_uniform_not_crash():
+    plan = plan_placement(LADDER, 4, weights={b: 0.0 for b in LADDER})
+    assert _devices_used(plan) == set(range(4))
+
+
+@pytest.mark.parametrize("bad", [
+    dict(buckets=[], num_devices=2),
+    dict(buckets=LADDER, num_devices=0),
+    dict(buckets=LADDER, num_devices=-1),
+    dict(buckets=[(16, 24), (16, 24)], num_devices=2),
+    dict(buckets=LADDER, num_devices=2,
+         weights={(999, 999): 1.0}),
+    dict(buckets=LADDER, num_devices=2,
+         weights={(16, 24): -1.0}),
+])
+def test_malformed_requests_raise_typed(bad):
+    with pytest.raises(PlacementError):
+        plan_placement(**bad)
+
+
+def test_placement_error_is_a_value_error():
+    """The serve door catches ValueError for request-shaped problems;
+    placement failures must ride the same path."""
+    assert issubclass(PlacementError, ValueError)
+
+
+def test_plan_devices_for_unknown_bucket_raises_typed():
+    plan = plan_placement(LADDER, 2)
+    with pytest.raises(PlacementError):
+        plan.devices_for((640, 960))
+
+
+# -- DevicePlacement runtime (needs the conftest's 8 forced host devices) ----
+
+def test_put_batch_lands_on_the_assigned_device():
+    import numpy as np
+
+    from dsin_tpu.serve import DevicePlacement
+    dp = DevicePlacement([(16, 24), (32, 48)], num_devices=2)
+    x = np.zeros((4, 16, 24, 3), np.float32)
+    for d in range(2):
+        arr = dp.put_batch(d, x)
+        assert arr.devices() == {dp.devices[d]}, (d, arr.devices())
+    tree = dp.replicate(1, {"w": np.ones((3,), np.float32)})
+    assert tree["w"].devices() == {dp.devices[1]}
+
+
+def test_requesting_more_devices_than_visible_raises_typed():
+    from dsin_tpu.serve import DevicePlacement, PlacementError
+    with pytest.raises(PlacementError, match="force more"):
+        DevicePlacement(LADDER, num_devices=512)
+
+
+def test_set_plan_swaps_atomically_and_validates():
+    from dsin_tpu.serve import DevicePlacement, PlacementError
+    dp = DevicePlacement(LADDER, num_devices=2)
+    old = dp.plan
+    new = plan_placement(LADDER, 2,
+                         weights={b: w for b, w in
+                                  zip(LADDER, (10.0, 1.0, 1.0))})
+    changed = dp.set_plan(new)
+    assert changed == (new.assignments != old.assignments)
+    assert dp.plan.assignments == new.assignments
+    with pytest.raises(PlacementError):
+        dp.set_plan(plan_placement(LADDER, 4))          # wrong width
+    with pytest.raises(PlacementError):
+        dp.set_plan(plan_placement([(16, 24)], 2))      # wrong ladder
+
+
+def test_make_mesh_rejects_bad_spatial_with_typed_error():
+    """ISSUE 6 satellite: parallel/mesh.make_mesh used a bare assert for
+    the divisibility check — gone under `python -O`, and serve now feeds
+    it user-supplied --devices values. Must be a readable ValueError."""
+    from dsin_tpu.parallel import mesh as mesh_lib
+    with pytest.raises(ValueError, match="not divisible"):
+        mesh_lib.make_mesh(num_devices=3, spatial=2)
+    with pytest.raises(ValueError, match="zero devices"):
+        mesh_lib.make_mesh(devices=[])
+    with pytest.raises(ValueError, match="spatial"):
+        mesh_lib.make_mesh(num_devices=4, spatial=0)

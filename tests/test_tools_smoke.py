@@ -179,6 +179,23 @@ def test_serve_bench_smoke_json_contract(tmp_path):
         "serialized baseline claims overlap — busy accounting broke")
     assert ser["stages"]["entropy_ms"]["count"] > 0
     assert report["stages"]["device_ms"]["count"] > 0
+    # ISSUE 6: the device-scaling axis rides the smoke run (N=1,2 on
+    # forced host devices) — census static at every N, no idle device
+    # at N>1, per-device occupancy recorded (the bench itself exits 1
+    # on violation; re-pin the artifact shape here)
+    dev = report["devices"]
+    assert dev["axis"] == report["config"]["devices_axis"]
+    assert "1" in dev["runs"] and len(dev["runs"]) == len(dev["axis"])
+    for n, entry in dev["runs"].items():
+        assert entry["steady_compiles"] == 0, (n, entry)
+        assert entry["all_devices_served"], (n, entry)
+        assert len(entry["per_device"]) == int(n)
+        assert entry["census"], "bucket->device census missing"
+        for stats in entry["per_device"].values():
+            assert 0.0 <= stats["occupancy"] <= 1.5
+        if int(n) > 1:
+            assert all(v["batches"] > 0
+                       for v in entry["per_device"].values())
 
 
 @pytest.mark.chaos

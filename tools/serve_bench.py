@@ -24,10 +24,22 @@ this host's spare core comes and goes (per-pair `_effective_cores`
 probes ride in the report), so parity is evidenced by the committed
 artifact rather than re-demanded of every CI window.
 
+Device-scaling axis (ISSUE 6): `--devices "1 2 4 8"` additionally runs
+the same stream through one warm service per device count, with the
+bucket ladder mapped onto the devices by serve/placement.py (forced
+host devices on CPU — the axis is a CORRECTNESS and observability
+measurement on CI hosts, not a speedup claim: N virtual devices share
+the same cores). Each run records throughput, the bucket->device
+census, per-device batch counts / busy-ms / occupancy, and the
+steady-state compile count. In --smoke mode the bench FAILS if any N
+compiles in steady state or any device at N>1 served zero batches.
+`--devices_only` skips the serialized-vs-pipelined comparison (the
+fail-fast `serve-multidevice` tpu_session.sh stage).
+
 Emits a SERVE_BENCH.json trajectory artifact: totals (throughput,
 rejections by cause), latency quantiles, batch occupancy, compile
-counts, per-stage times, and a sampled time series of queue depth /
-completion progress.
+counts, per-stage times, the device-scaling section, and a sampled time
+series of queue depth / completion progress.
 
 Usage:
     python tools/serve_bench.py                      # committed artifact
@@ -37,6 +49,7 @@ Usage:
 import argparse
 import json
 import os
+import re
 import statistics
 import sys
 import threading
@@ -112,7 +125,7 @@ def _write_smoke_cfgs(tmpdir):
     return ae_p, pc_p
 
 
-def _build_service(args, entropy_workers: int):
+def _build_service(args, entropy_workers: int, devices=None):
     from dsin_tpu.serve import CompressionService, ServiceConfig
 
     buckets = _parse_shapes(args.buckets)
@@ -121,7 +134,7 @@ def _build_service(args, entropy_workers: int):
         seed=args.seed, buckets=buckets, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         workers=args.workers, entropy_workers=entropy_workers,
-        pipeline_depth=args.pipeline_depth)
+        pipeline_depth=args.pipeline_depth, devices=devices)
     service = CompressionService(cfg).start()
     return service, service.warmup()
 
@@ -283,6 +296,112 @@ def _effective_cores(reps: int = 30) -> float:
     return rate(2) / r1 if r1 > 0 else 0.0
 
 
+def _run_device_axis(args, axis) -> dict:
+    """Device-scaling leg: the same open-loop stream through one warm
+    pipelined service per device count N. Reported per N: throughput,
+    the bucket->device census the placement planner produced, per-device
+    batch counts / busy-ms / occupancy (busy over wall — an idle device
+    is a flat 0 here), and the steady-state compile count. On a CPU CI
+    host the devices are FORCED host devices sharing the same cores, so
+    `scaling_vs_1` documents overhead/parity, not a speedup claim — the
+    correctness contracts (census static, all devices served, results
+    identical to N=1: tests/test_serve_multidevice.py) are what the axis
+    gates. Axis entries beyond the backend's visible device count are
+    SKIPPED and recorded (the host-device forcing only multiplies CPU
+    devices — on a 1-chip TPU host the default axis must degrade to a
+    noted partial curve, not crash away the whole report)."""
+    import jax
+    avail = len(jax.devices())
+    runnable = [n for n in axis if n <= avail]
+    skipped = {str(n): f"only {avail} device(s) visible on the "
+                       f"{jax.default_backend()} backend"
+               for n in axis if n > avail}
+    for n, why in skipped.items():
+        print(f"SERVE_BENCH_NOTE: skipping devices={n}: {why}",
+              file=sys.stderr)
+    out = {"axis": list(axis), "skipped": skipped, "runs": {}}
+    for n in runnable:
+        svc, warm = _build_service(args, args.entropy_workers, devices=n)
+        t_wall = time.monotonic()
+        run = _run_stream(svc, args)
+        # drain BEFORE reading the per-device ledgers: pipelined
+        # executors publish a batch's busy-ms/count at pipeline finish,
+        # after its futures resolve, so up to pipeline_depth batches per
+        # executor are still unaccounted when the stream returns
+        svc.drain()
+        # occupancy denominator is the FULL pass wall (stream + decode
+        # leg + drain flush) — busy lands during all three, and a
+        # device's executor can never be busier than the wall it ran
+        # under
+        wall_ms = (time.monotonic() - t_wall) * 1e3
+        snap = svc.metrics.snapshot()
+        per_device = {}
+        for d in range(n):
+            batches = snap["counters"].get(f"serve_device_batches_d{d}", 0)
+            busy = snap["accumulators"].get(
+                f"serve_device_busy_ms_d{d}", 0.0)
+            per_device[str(d)] = {
+                "batches": batches,
+                "busy_ms": round(busy, 3),
+                "occupancy": round(busy / wall_ms, 4) if wall_ms > 0
+                else 0.0,
+            }
+        entry = {
+            "throughput_rps": run["throughput_rps"],
+            "completed": run["completed"],
+            "failed": run["failed"],
+            "decode_roundtrips": run["decode_roundtrips"],
+            "steady_compiles": run["steady_compiles"],
+            "warmup": {k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in warm.items()},
+            "census": snap["info"].get("serve_device_assignments", {}),
+            "executable_census": snap["gauges"].get(
+                "serve_executable_census", 0),
+            "per_device": per_device,
+            "all_devices_served": all(v["batches"] > 0
+                                      for v in per_device.values()),
+        }
+        out["runs"][str(n)] = entry
+    # the scaling baseline is the N=1 run specifically, not whatever
+    # happens to lead the axis — computed after all runs so axis order
+    # cannot matter; without an N=1 run (or at 0 rps) the ratio is
+    # honestly unavailable (null), never mislabeled
+    base_rps = out["runs"].get("1", {}).get("throughput_rps") or None
+    for entry in out["runs"].values():
+        entry["scaling_vs_1"] = (round(entry["throughput_rps"]
+                                       / base_rps, 3)
+                                 if base_rps else None)
+    return out
+
+
+def _gate_device_axis(devices_section) -> list:
+    """--smoke violations for the scaling axis: a compile in steady
+    state at ANY N (the census leaked), a device that served nothing
+    at N>1 (the placement left silicon idle), or a skipped N (under
+    --smoke the forced host devices must cover the whole axis — a skip
+    means the gate silently went vacuous)."""
+    violations = []
+    for n, why in devices_section.get("skipped", {}).items():
+        violations.append(f"devices={n} was skipped ({why}) — the smoke "
+                          f"axis must actually run")
+    for n, entry in sorted(devices_section["runs"].items(),
+                           key=lambda kv: int(kv[0])):
+        if entry["steady_compiles"] != 0:
+            violations.append(
+                f"devices={n}: {entry['steady_compiles']} steady-state "
+                f"compiles — the (bucket, device) census is not static")
+        if int(n) > 1 and not entry["all_devices_served"]:
+            idle = [d for d, v in entry["per_device"].items()
+                    if v["batches"] == 0]
+            violations.append(
+                f"devices={n}: devices {idle} served zero batches "
+                f"(census {entry['census']})")
+        if entry["failed"]:
+            violations.append(
+                f"devices={n}: {entry['failed']} requests failed")
+    return violations
+
+
 def run_bench(args) -> dict:
     """Serialized-vs-pipelined comparison with an interleaved-repeats
     methodology: both services are built and warmed once, then the same
@@ -411,6 +530,15 @@ def main(argv=None) -> int:
                         "ratio (robust to host-speed drift)")
     p.add_argument("--decode_samples", type=int, default=4)
     p.add_argument("--sample_every_ms", type=float, default=100.0)
+    p.add_argument("--devices", default=None,
+                   help="space-separated device counts for the scaling "
+                        "axis, e.g. '1 2 4 8' (CPU hosts get forced host "
+                        "devices); '' disables the axis. Default: "
+                        "'1 2 4 8', or '1 2' under --smoke")
+    p.add_argument("--devices_only", action="store_true",
+                   help="run ONLY the device-scaling axis (skip the "
+                        "serialized-vs-pipelined comparison) — the "
+                        "serve-multidevice tpu_session.sh stage")
     p.add_argument("--out", default="SERVE_BENCH.json")
     p.add_argument("--smoke_model", action="store_true",
                    help="use the built-in tiny model configs but keep "
@@ -445,14 +573,66 @@ def main(argv=None) -> int:
         args.repeats = 5       # median of 5 pairs: one noisy host
         args.sample_every_ms = 20.0    # window cannot flip the verdict
 
-    report = run_bench(args)
+    if args.devices is None:
+        # smoke keeps the axis short (CI seconds); the committed
+        # artifact run records the full curve
+        args.devices = "1 2" if args.smoke else "1 2 4 8"
+    axis = [int(v) for v in args.devices.split()]
+    if any(n < 1 for n in axis):
+        print(f"SERVE_BENCH_FAILED: bad --devices axis {axis}",
+              file=sys.stderr)
+        return 2
+    if axis and max(axis) > 1:
+        # must land before jax initializes a backend (nothing in this
+        # process has touched jax yet — imports are function-local)
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={max(axis)}").strip()
+        elif int(m.group(1)) < max(axis):
+            # fail FAST: the pre-set count would let the small-N runs
+            # burn minutes before devices=max(axis) hits PlacementError
+            print(f"SERVE_BENCH_FAILED: XLA_FLAGS already forces "
+                  f"{m.group(1)} host devices but the --devices axis "
+                  f"needs {max(axis)} — unset it or raise it",
+                  file=sys.stderr)
+            return 2
+
+    if args.devices_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "rate_rps": args.rate, "requests": args.requests,
+                "smoke": args.smoke, "devices_axis": axis,
+            },
+            "devices": _run_device_axis(args, axis),
+        }
+    else:
+        report = run_bench(args)
+        if axis:
+            report["config"]["devices_axis"] = axis
+            report["devices"] = _run_device_axis(args, axis)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
-    print(json.dumps({k: report[k] for k in
-                      ("load", "latency_ms", "batch_occupancy",
-                       "steady_compiles", "pipeline")}, indent=1))
+    summary_keys = ("load", "latency_ms", "batch_occupancy",
+                    "steady_compiles", "pipeline", "devices")
+    print(json.dumps({k: report[k] for k in summary_keys if k in report},
+                     indent=1))
+    if args.smoke and args.devices_only:
+        violations = _gate_device_axis(report["devices"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
     if args.smoke:
         # tier-1 contract (ISSUE 4): the pipelined dataplane must emit
         # its overlap ratio, must demonstrably overlap the stages, and
@@ -497,6 +677,8 @@ def main(argv=None) -> int:
                   f"{pipe.get('pair_effective_cores')}) — within host "
                   "noise, above the broken-pipeline floor",
                   file=sys.stderr)
+        if "devices" in report:
+            violations.extend(_gate_device_axis(report["devices"]))
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

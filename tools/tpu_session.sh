@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke serve-smoke serve-multidevice bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke serve-smoke serve-multidevice bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -73,6 +73,22 @@ serve-smoke)
   if [ "$rc" -ne 0 ]; then
     cat artifacts/serve_smoke.log
     echo "TPU_SESSION_FAILED: serve-smoke (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
+serve-multidevice)
+  # multi-device placement smoke on FORCED host devices, before chip
+  # time: the ladder->mesh dataplane (ISSUE 6) must keep the (bucket,
+  # device) executable census static (zero steady-state compiles at
+  # every N) and leave no device idle (every device serves >= 1 batch
+  # at N>1) — serve_bench exits 1 otherwise. Routing bit-identity vs
+  # the single-device path is pinned by tests/test_serve_multidevice.py.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --devices_only \
+    --devices "1 2 4 8" --out artifacts/serve_multidevice.json \
+    > artifacts/serve_multidevice.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/serve_multidevice.log
+    echo "TPU_SESSION_FAILED: serve-multidevice (queue aborted before chip stages)"
     exit 1
   fi
   ;;
@@ -147,7 +163,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke serve-multidevice bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
