@@ -204,6 +204,12 @@ class IncrementalResShallow:
                 sch = self._schedules.setdefault(shape, sch)
         return sch
 
+    def cached_shapes(self) -> List[Tuple[int, int, int]]:
+        """Shapes whose schedules are already built (warmup evidence —
+        the serve process-backend worker-residence probe reads this)."""
+        with self._sched_lock:
+            return sorted(self._schedules)
+
     def begin(self, shape) -> "_VolumePass":
         return _VolumePass(self, self.schedule(shape))
 

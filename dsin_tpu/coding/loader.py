@@ -14,10 +14,14 @@ geometry and run every other bucket through the same parameters.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def load_model_state(ae_config_path: str, pc_config_path: str,
@@ -66,3 +70,150 @@ def make_codec(model, state):
     """The one BottleneckCodec construction every call site shares."""
     from dsin_tpu.coding.codec import BottleneckCodec
     return BottleneckCodec.for_model(model, state.params)
+
+
+# -- worker-resident codecs (the serve process entropy backend) ---------------
+#
+# A live BottleneckCodec cannot cross a process boundary: its params are
+# backend arrays and its jit wrappers / incremental engine hold
+# process-local state. The process entropy backend therefore ships a
+# small picklable SPEC instead, and each pool worker rebuilds its codec
+# ONCE at initializer time (and warms the per-shape schedule cache for
+# the shapes it will serve) — worker-resident state, zero per-task
+# construction. The parent's `make_codec_spec` and the worker's
+# `codec_from_spec` live side by side here so the two constructions
+# cannot drift from `make_codec` above.
+
+@dataclass
+class CodecSpec:
+    """Everything needed to rebuild a bit-identical BottleneckCodec in
+    another process: numpy context-model params, quantizer centers, the
+    pc config as its canonical text snapshot (config.py round-trips it),
+    the precomputed pad value (so the worker never touches the device
+    path during init), and the coder's scale_bits."""
+    pc_params: Any
+    centers: np.ndarray
+    pc_config_text: str
+    pad_value: float
+    scale_bits: int
+
+
+def make_codec_spec(codec) -> CodecSpec:
+    """Picklable spec from a live BottleneckCodec (the parent side)."""
+    return CodecSpec(
+        pc_params=jax.tree_util.tree_map(np.asarray, codec.pc_params),
+        centers=np.asarray(codec.centers),
+        pc_config_text=str(codec.pc_config),
+        pad_value=float(codec.pad_value),
+        scale_bits=int(codec.scale_bits))
+
+
+def codec_from_spec(spec: CodecSpec):
+    """Rebuild the codec a spec describes. Streams it produces/consumes
+    are bit-identical to the origin codec's: same numpy params, same
+    config, same quantized-PMF path (the incremental engine is pure
+    numpy, so no cross-process float drift on one host)."""
+    from dsin_tpu.coding.codec import BottleneckCodec
+    from dsin_tpu.config import parse_config
+    from dsin_tpu.models import probclass as pc_lib
+    pc_cfg = parse_config(spec.pc_config_text, name="codec_spec")
+    # dispatch through the arch registry, exactly like models/dsin.py —
+    # a hardcoded class here would silently rebuild the wrong network
+    # for any future second arch
+    model = pc_lib.get_network_cls(pc_cfg)(
+        pc_cfg, num_centers=len(spec.centers))
+    return BottleneckCodec(model, spec.pc_params, spec.centers, pc_cfg,
+                           scale_bits=spec.scale_bits,
+                           pad_value=spec.pad_value)
+
+
+# one codec per POOL WORKER PROCESS, set exactly once by the pool
+# initializer before any task runs — single-threaded within the worker,
+# so no lock guards it (ProcessPoolExecutor workers run tasks serially)
+_worker_codec = None
+
+
+def init_worker_codec(spec: CodecSpec,
+                      warm_shapes: Sequence[Tuple[int, int, int]] = ()
+                      ) -> None:
+    """ProcessPoolExecutor initializer: rebuild the codec once for this
+    worker's lifetime and warm its schedule cache for every (D, H, W)
+    volume geometry the service's buckets map to — after this, tasks pay
+    coding work only."""
+    global _worker_codec
+    _worker_codec = codec_from_spec(spec)
+    eng = _worker_codec._incremental_engine()
+    for shape in warm_shapes:
+        eng.schedule(tuple(int(s) for s in shape))
+
+
+def _resident_codec():
+    if _worker_codec is None:
+        raise RuntimeError("entropy worker used before init_worker_codec "
+                           "ran (ProcessPoolExecutor initializer missing)")
+    return _worker_codec
+
+
+def worker_ping(settle_s: float = 0.05) -> dict:
+    """Worker-residence probe (and warmup vehicle): reports this
+    worker's pid, its resident codec's identity, and the schedule-cache
+    shapes the initializer warmed. The short sleep keeps concurrent
+    warmup pings from all landing on one eager worker."""
+    time.sleep(settle_s)
+    codec = _resident_codec()
+    return {"pid": os.getpid(), "codec_id": id(codec),
+            "schedules": codec._incremental_engine().cached_shapes()}
+
+
+def encode_batch_isolated(codec, volumes) -> list:
+    """Encode N (D, H, W) symbol volumes -> [(payload, None) |
+    (None, exception)] per lane, via the one-native-call batch path,
+    retrying lane by lane ONLY if the batch call refuses the set (rare:
+    a pathological lane exhausting its capacity doublings, a scratch
+    allocation failure) — the encode half of the per-lane
+    fault-isolation contract, mirroring decode_batch_isolated: one
+    lane's coding error must fail only ITS request, never its
+    batchmates."""
+    try:
+        return [(p, None) for p in codec.encode_batch(list(volumes))]
+    except Exception:
+        out = []
+        for vol in volumes:
+            try:
+                out.append((codec.encode(vol), None))
+            except Exception as exc:  # noqa: BLE001 — per-lane isolation
+                out.append((None, exc))
+        return out
+
+
+def worker_encode_batch(volumes) -> list:
+    """Process-pool task: encode N (D, H, W) symbol volumes with the
+    resident codec — one native rANS call for the whole micro-batch,
+    per-lane isolation on refusal (encode_batch_isolated's
+    [(payload, None) | (None, exception)] contract)."""
+    return encode_batch_isolated(_resident_codec(), volumes)
+
+
+def decode_batch_isolated(codec, payloads) -> list:
+    """Decode N DTPC payloads -> [(volume, None) | (None, exception)]
+    per lane, via the lockstep batch path, retrying lane by lane ONLY
+    if the batch refuses the set (rare header/structure errors) — the
+    per-lane fault-isolation contract both serve entropy backends
+    share (service.py thread path, worker_decode_batch process path)."""
+    try:
+        return [(vol, None) for vol in codec.decode_batch(list(payloads))]
+    except Exception:
+        out = []
+        for blob in payloads:
+            try:
+                out.append((codec.decode(blob), None))
+            except Exception as exc:  # noqa: BLE001 — per-lane isolation
+                out.append((None, exc))
+        return out
+
+
+def worker_decode_batch(payloads) -> list:
+    """Process-pool task: decode N payloads with the resident codec.
+    Payloads arrive CRC-verified (the parent-side bridge keeps the
+    per-request verify + fault-site semantics)."""
+    return decode_batch_isolated(_resident_codec(), payloads)

@@ -37,14 +37,12 @@ struct Decoder {
 
 extern "C" {
 
-// Encode n symbols given per-symbol (start, freq) in FORWARD order.
-// Returns the number of bytes written to out, or -1 if cap is too small.
-// Layout: out[0..3] = final state (LE), then renorm bytes.
-long rans_encode(const uint32_t* starts, const uint32_t* freqs, long n,
-                 int scale_bits, uint8_t* out, long cap) {
-  // Emit into a scratch buffer forward, then reverse into `out`.
-  uint8_t* scratch = static_cast<uint8_t*>(malloc(cap > 0 ? cap : 1));
-  if (!scratch) return -1;
+// Shared encode core: one independent symbol lane into `out`, renorm
+// bytes staged in the caller-provided `scratch` (>= cap bytes). Returns
+// bytes written or -1 if cap is too small.
+static long encode_lane(const uint32_t* starts, const uint32_t* freqs,
+                        long n, int scale_bits, uint8_t* out, long cap,
+                        uint8_t* scratch) {
   long sp = 0;
   uint64_t x = kRansL;
   for (long i = n - 1; i >= 0; --i) {
@@ -53,21 +51,74 @@ long rans_encode(const uint32_t* starts, const uint32_t* freqs, long n,
     uint64_t x_max =
         (static_cast<uint64_t>(kRansL >> scale_bits) << 8) * freq;
     while (x >= x_max) {
-      if (sp >= cap) { free(scratch); return -1; }
+      if (sp >= cap) return -1;
       scratch[sp++] = static_cast<uint8_t>(x & 0xff);
       x >>= 8;
     }
     x = ((x / freq) << scale_bits) + (x % freq) + starts[i];
   }
   long total = sp + 4;
-  if (total > cap) { free(scratch); return -1; }
+  if (total > cap) return -1;
   out[0] = static_cast<uint8_t>(x & 0xff);
   out[1] = static_cast<uint8_t>((x >> 8) & 0xff);
   out[2] = static_cast<uint8_t>((x >> 16) & 0xff);
   out[3] = static_cast<uint8_t>((x >> 24) & 0xff);
   for (long i = 0; i < sp; ++i) out[4 + i] = scratch[sp - 1 - i];
+  return total;
+}
+
+// Encode n symbols given per-symbol (start, freq) in FORWARD order.
+// Returns the number of bytes written to out, -1 if cap is too small
+// (the Python side retries with a doubled cap), or -2 if the scratch
+// allocation failed (a retry would only make the OOM worse — the
+// Python side raises, coding/rans.py).
+// Layout: out[0..3] = final state (LE), then renorm bytes.
+long rans_encode(const uint32_t* starts, const uint32_t* freqs, long n,
+                 int scale_bits, uint8_t* out, long cap) {
+  // Emit into a scratch buffer forward, then reverse into `out`.
+  uint8_t* scratch = static_cast<uint8_t*>(malloc(cap > 0 ? cap : 1));
+  if (!scratch) return -2;
+  long total = encode_lane(starts, freqs, n, scale_bits, out, cap, scratch);
   free(scratch);
   return total;
+}
+
+// Batch encode: n_lanes INDEPENDENT symbol lanes packed into one flat
+// (starts, freqs) pair; lane i spans [lane_offsets[i], lane_offsets[i+1])
+// of the packed arrays and its stream lands at out + out_offsets[i]
+// (per-lane capacity out_offsets[i+1] - out_offsets[i] — sized by each
+// lane's own length, not the longest lane's) with its byte count in
+// out_sizes[i]. One call per micro-batch: the whole loop runs in C with
+// the GIL dropped (ctypes releases it for the call), so an entropy-pool
+// thread coding a batch no longer serializes the other pool threads'
+// Python framing. Streams are byte-identical to n_lanes separate
+// rans_encode calls (each lane is a self-contained coder run).
+// Returns 0 on success, -(i+1) if lane i overflowed its capacity (the
+// Python side retries that lane with a doubled cap, coding/rans.py),
+// or -(n_lanes+1) if the scratch allocation failed (OOM: never
+// retried with MORE memory).
+long rans_encode_batch(const uint32_t* starts, const uint32_t* freqs,
+                       const long* lane_offsets, long n_lanes,
+                       int scale_bits, uint8_t* out,
+                       const long* out_offsets, long* out_sizes) {
+  long max_cap = 1;
+  for (long i = 0; i < n_lanes; ++i) {
+    long cap = out_offsets[i + 1] - out_offsets[i];
+    if (cap > max_cap) max_cap = cap;
+  }
+  uint8_t* scratch = static_cast<uint8_t*>(malloc(max_cap));
+  if (!scratch) return -(n_lanes + 1);
+  for (long i = 0; i < n_lanes; ++i) {
+    long off = lane_offsets[i];
+    long n = lane_offsets[i + 1] - off;
+    long cap = out_offsets[i + 1] - out_offsets[i];
+    long total = encode_lane(starts + off, freqs + off, n, scale_bits,
+                             out + out_offsets[i], cap, scratch);
+    if (total < 0) { free(scratch); return -(i + 1); }
+    out_sizes[i] = total;
+  }
+  free(scratch);
+  return 0;
 }
 
 void* rans_decoder_new(const uint8_t* data, long size) {
@@ -149,6 +200,27 @@ void rans_decode_front(void* handle, const uint32_t* cums, long n,
                        int num_syms, int scale_bits, int32_t* out) {
   decode_n(static_cast<Decoder*>(handle), cums, num_syms + 1, num_syms, n,
            scale_bits, out);
+}
+
+// Batch front decode across n_lanes INDEPENDENT streams: lane i's
+// decoder advances k_i = lane_offsets[i+1] - lane_offsets[i] symbols,
+// the j-th resolved against its own adaptive cumulative table (cums
+// rows packed in lane order, num_syms+1 values per row; out shares the
+// lane_offsets layout). One call replaces n_lanes rans_decode_front
+// round trips per wavefront — the serve entropy stage's decode loop
+// over a micro-batch stays in C with the GIL dropped. Per-lane results
+// are identical to separate rans_decode_front calls (lanes share no
+// state). Empty lanes (k_i = 0) are legal and advance nothing.
+void rans_decode_batch(void** handles, const uint32_t* cums,
+                       const long* lane_offsets, long n_lanes,
+                       int num_syms, int scale_bits, int32_t* out) {
+  for (long i = 0; i < n_lanes; ++i) {
+    long off = lane_offsets[i];
+    long k = lane_offsets[i + 1] - off;
+    decode_n(static_cast<Decoder*>(handles[i]),
+             cums + off * (num_syms + 1), num_syms + 1, num_syms, k,
+             scale_bits, out + off);
+  }
 }
 
 }  // extern "C"

@@ -18,7 +18,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -36,9 +36,41 @@ _lib_lock = locks_lib.RankedLock("rans.native")
 _lib: Optional[ctypes.CDLL] = None    # guarded-by: _lib_lock (module)
 _lib_tried = False                    # guarded-by: _lib_lock (module)
 
+# per-entry-point native invocation counts — the test probe behind the
+# "one native call per micro-batch" contract (tests/test_rans_batch.py
+# and the serve entropy-stage tests read these)
+_counts_lock = locks_lib.RankedLock("rans.counters")
+_native_calls: Dict[str, int] = {}    # guarded-by: _counts_lock (module)
+
+
+def _count(name: str) -> None:
+    with _counts_lock:
+        _native_calls[name] = _native_calls.get(name, 0) + 1
+
+
+def native_call_counts() -> Dict[str, int]:
+    """{entry point: native invocations since the last reset} — counts
+    only calls that actually crossed into the C library (the pure-Python
+    fallback does not bump them)."""
+    with _counts_lock:
+        return dict(_native_calls)
+
+
+def reset_native_call_counts() -> None:
+    with _counts_lock:
+        _native_calls.clear()
+
 
 class _NativeLoadError(RuntimeError):
     """Internal: one compile-or-bind attempt failed (retriable)."""
+
+
+class RansCapacityError(RuntimeError):
+    """The native encoder overflowed its output buffer even after the
+    doubled-cap retries — the stream expanded past every offered
+    capacity. Never silently falls back to the Python path: the caller
+    must see the condition (a silent re-run would hide a native-layer
+    bug behind a ~100x slowdown)."""
 
 
 def _compile_native() -> Optional[str]:
@@ -122,6 +154,15 @@ def _load_and_bind() -> Optional[ctypes.CDLL]:
         lib.rans_decode_front.argtypes = [
             ctypes.c_void_p, u32p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int32)]
+        i64p = ctypes.POINTER(ctypes.c_long)
+        lib.rans_encode_batch.restype = ctypes.c_long
+        lib.rans_encode_batch.argtypes = [
+            u32p, u32p, i64p, ctypes.c_long, ctypes.c_int, u8p,
+            i64p, i64p]
+        lib.rans_decode_batch.restype = None
+        lib.rans_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), u32p, i64p, ctypes.c_long,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
         return lib
     except (OSError, AttributeError):
         # OSError: dlopen failure; AttributeError: the .so predates a
@@ -153,12 +194,21 @@ def _encode_py(starts: np.ndarray, freqs: np.ndarray,
     return head + bytes(reversed(out))
 
 
-def encode(starts: Sequence[int], freqs: Sequence[int],
-           scale_bits: int = DEFAULT_SCALE_BITS) -> bytes:
-    """Encode n symbols given per-symbol cumulative start and frequency
-    (forward order). freq must be >= 1 and start+freq <= 1<<scale_bits."""
-    starts = np.ascontiguousarray(starts, dtype=np.uint32)
-    freqs = np.ascontiguousarray(freqs, dtype=np.uint32)
+#: capacity-retry policy for the native encoder: start from
+#: `_encode_cap(n)` and double up to this many times before raising the
+#: typed RansCapacityError. The initial cap (8 bytes/symbol + flush) is
+#: already ~4x the true worst case (renorm emits <= scale_bits bits per
+#: symbol, 2 bytes at scale_bits=16), so a real stream never retries —
+#: tests shrink `_encode_cap` to exercise the path deterministically.
+_CAP_DOUBLINGS = 4
+
+
+def _encode_cap(n: int) -> int:
+    """Initial output capacity for an n-symbol lane (bytes)."""
+    return 8 * n + 64
+
+
+def _validate_lane(starts: np.ndarray, freqs: np.ndarray) -> None:
     if starts.shape != freqs.shape or starts.ndim != 1:
         raise ValueError(f"starts/freqs mismatch: {starts.shape} vs "
                          f"{freqs.shape}")
@@ -166,20 +216,112 @@ def encode(starts: Sequence[int], freqs: Sequence[int],
         # freq=0 would be an unencodable symbol (and integer div-by-zero
         # in the native coder)
         raise ValueError("all frequencies must be >= 1")
+
+
+def encode(starts: Sequence[int], freqs: Sequence[int],
+           scale_bits: int = DEFAULT_SCALE_BITS) -> bytes:
+    """Encode n symbols given per-symbol cumulative start and frequency
+    (forward order). freq must be >= 1 and start+freq <= 1<<scale_bits."""
+    starts = np.ascontiguousarray(starts, dtype=np.uint32)
+    freqs = np.ascontiguousarray(freqs, dtype=np.uint32)
+    _validate_lane(starts, freqs)
     lib = _load_native()
     if lib is None:
         return _encode_py(starts, freqs, scale_bits)
-    # worst case ~4 bytes/symbol at scale_bits<=16, plus state flush
-    cap = 8 * len(starts) + 64
-    out = np.empty(cap, dtype=np.uint8)
-    n = lib.rans_encode(
-        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        freqs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        len(starts), scale_bits,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
-    if n < 0:
-        raise RuntimeError("rans_encode: buffer overflow")
-    return out[:n].tobytes()
+    cap = _encode_cap(len(starts))
+    for _ in range(_CAP_DOUBLINGS + 1):
+        out = np.empty(cap, dtype=np.uint8)
+        _count("encode")
+        n = lib.rans_encode(
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            freqs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(starts), scale_bits,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
+        if n >= 0:
+            return out[:n].tobytes()
+        if n == -2:
+            # scratch malloc failed: retrying with a DOUBLED buffer
+            # would only deepen the OOM — surface it as what it is
+            raise MemoryError("rans_encode: native scratch allocation "
+                              "failed")
+        # cap too small (-1): retry with double the room — the output is
+        # re-encoded from scratch, so the retried stream is bit-identical
+        # to what a large-enough first cap would have produced
+        cap *= 2
+    raise RansCapacityError(
+        f"rans_encode overflowed a {cap // 2}-byte buffer for "
+        f"{len(starts)} symbols after {_CAP_DOUBLINGS} doublings")
+
+
+def encode_batch(starts_list: Sequence[np.ndarray],
+                 freqs_list: Sequence[np.ndarray],
+                 scale_bits: int = DEFAULT_SCALE_BITS) -> List[bytes]:
+    """Encode N independent symbol lanes in ONE native call.
+
+    Lane i is `(starts_list[i], freqs_list[i])` in forward order; lanes
+    may be ragged (different lengths, empty lanes are legal). Streams
+    are bit-identical to N separate `encode` calls — each lane is a
+    self-contained coder run; batching only moves the per-lane loop into
+    C so a micro-batch costs one GIL-dropping ctypes call instead of N
+    (dsin_tpu/serve's entropy stage). Falls back to the per-lane Python
+    coder when the native library is unavailable."""
+    if len(starts_list) != len(freqs_list):
+        raise ValueError(f"{len(starts_list)} starts lanes vs "
+                         f"{len(freqs_list)} freqs lanes")
+    lanes = [(np.ascontiguousarray(s, dtype=np.uint32),
+              np.ascontiguousarray(f, dtype=np.uint32))
+             for s, f in zip(starts_list, freqs_list)]
+    for s, f in lanes:
+        _validate_lane(s, f)
+    if not lanes:
+        return []
+    lib = _load_native()
+    if lib is None:
+        return [_encode_py(s, f, scale_bits) for s, f in lanes]
+    offsets = np.zeros(len(lanes) + 1, dtype=np.int64)
+    np.cumsum([len(s) for s, _ in lanes], out=offsets[1:])
+    starts = (np.concatenate([s for s, _ in lanes])
+              if offsets[-1] else np.zeros(0, np.uint32))
+    freqs = (np.concatenate([f for _, f in lanes])
+             if offsets[-1] else np.zeros(0, np.uint32))
+    i64p = ctypes.POINTER(ctypes.c_long)
+    # per-lane output capacity (sized by each lane's own length — a
+    # ragged batch with one huge lane must not allocate huge slots for
+    # every small lane); on overflow only the GUILTY lane's cap doubles
+    caps = np.array([_encode_cap(len(s)) for s, _ in lanes],
+                    dtype=np.int64)
+    doublings = np.zeros(len(lanes), dtype=np.int64)
+    while True:
+        out_offsets = np.zeros(len(lanes) + 1, dtype=np.int64)
+        np.cumsum(caps, out=out_offsets[1:])
+        out = np.empty(int(out_offsets[-1]), dtype=np.uint8)
+        sizes = np.zeros(len(lanes), dtype=np.int64)
+        _count("encode_batch")
+        rc = lib.rans_encode_batch(
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            freqs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            offsets.ctypes.data_as(i64p), len(lanes), scale_bits,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out_offsets.ctypes.data_as(i64p),
+            sizes.ctypes.data_as(i64p))
+        if rc == 0:
+            return [out[out_offsets[i]:out_offsets[i] + int(sizes[i])]
+                    .tobytes() for i in range(len(lanes))]
+        if rc == -(len(lanes) + 1):
+            raise MemoryError("rans_encode_batch: native scratch "
+                              "allocation failed")
+        # -(i+1): lane i overflowed its cap — double THAT lane and
+        # re-run the batch (lanes are deterministic, so the retried
+        # streams are bit-identical; the overflow is pathological, see
+        # _CAP_DOUBLINGS)
+        guilty = -int(rc) - 1
+        if doublings[guilty] >= _CAP_DOUBLINGS:
+            raise RansCapacityError(
+                f"rans_encode_batch overflowed a {int(caps[guilty])}-"
+                f"byte lane buffer (lane {guilty} of {len(lanes)}) "
+                f"after {_CAP_DOUBLINGS} doublings")
+        caps[guilty] *= 2
+        doublings[guilty] += 1
 
 
 # -- decode -------------------------------------------------------------------
@@ -245,6 +387,7 @@ class Decoder:
         n = cums.shape[0]
         if self._lib is not None:
             out = np.empty(n, dtype=np.int32)
+            _count("decode_front")
             self._lib.rans_decode_front(
                 self._handle,
                 cums.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
@@ -259,6 +402,7 @@ class Decoder:
         cum = np.ascontiguousarray(cum, dtype=np.uint32)
         if self._lib is not None:
             out = np.empty(n, dtype=np.int32)
+            _count("decode_static")
             self._lib.rans_decode_static(
                 self._handle,
                 cum.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
@@ -284,6 +428,50 @@ class Decoder:
             self.close()
         except Exception:
             pass
+
+
+def decode_front_batch(decoders: Sequence[Decoder],
+                       cums_list: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Advance N independent decoders one wavefront each in ONE native
+    call. `cums_list[i]` is decoder i's (k_i, L+1) adaptive cumulative
+    tables (its next k_i symbols); lanes may be ragged and k_i = 0 is
+    legal (that decoder advances nothing). Per-lane results are
+    identical to N separate `decode_front` calls — lanes share no coder
+    state; batching only moves the lane loop into C so a micro-batch's
+    front costs one GIL-dropping ctypes call instead of N. Falls back to
+    the per-decoder path when the native library is unavailable."""
+    if len(decoders) != len(cums_list):
+        raise ValueError(f"{len(decoders)} decoders vs {len(cums_list)} "
+                         f"cum-table lanes")
+    if not decoders:
+        return []
+    cums = [np.ascontiguousarray(c, dtype=np.uint32) for c in cums_list]
+    widths = {c.shape[1] for c in cums if len(c)}
+    if len(widths) > 1:
+        raise ValueError(f"lanes disagree on table width: {sorted(widths)}")
+    scale_bits = decoders[0].scale_bits
+    if any(d.scale_bits != scale_bits for d in decoders):
+        raise ValueError("decoders disagree on scale_bits")
+    if any(d._lib is None for d in decoders) or not widths:
+        return [d.decode_front(c) for d, c in zip(decoders, cums)]
+    lib = decoders[0]._lib
+    num_syms = next(iter(widths)) - 1
+    offsets = np.zeros(len(cums) + 1, dtype=np.int64)
+    np.cumsum([len(c) for c in cums], out=offsets[1:])
+    packed = np.concatenate(
+        [c for c in cums if len(c)], axis=0) if offsets[-1] else \
+        np.zeros((0, num_syms + 1), np.uint32)
+    packed = np.ascontiguousarray(packed)
+    handles = (ctypes.c_void_p * len(decoders))(
+        *[d._handle for d in decoders])
+    out = np.empty(int(offsets[-1]), dtype=np.int32)
+    i64p = ctypes.POINTER(ctypes.c_long)
+    _count("decode_batch")
+    lib.rans_decode_batch(
+        handles, packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        offsets.ctypes.data_as(i64p), len(decoders), num_syms, scale_bits,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return [out[offsets[i]:offsets[i + 1]] for i in range(len(decoders))]
 
 
 # -- pmf quantization ---------------------------------------------------------

@@ -29,25 +29,47 @@ classic learned-codec serving bottleneck, PAPERS.md 2207.14524 /
 two-stage pipeline:
 
   encode:  [worker] assemble + dispatch jitted batch (async)
-           [pool]   one task per image: single shared device->host
-                    transfer, then rANS encode + frame + resolve future
-  decode:  [pool]   one task per image: CRC re-verify + rANS decode
+           [pool]   ONE task per micro-batch: single shared device->host
+                    transfer, then one batch-native rANS call for every
+                    image's lane, frame + resolve futures
+  decode:  [pool]   ONE task per micro-batch: per-request CRC re-verify,
+                    then the lockstep batch decode (one native call per
+                    wavefront for the whole batch)
            [worker] jitted batch decode over the gathered symbols,
                     crop + resolve futures
 
 The worker dispatches batch N+1's device stage while batch N's entropy
-tasks run on the pool (`pipeline_depth` bounds how many batches may be
+task runs on the pool (`pipeline_depth` bounds how many batches may be
 in flight), so device and host stages genuinely overlap: nothing blocks
 on a device->host transfer before the next device call is dispatched —
 the transfer happens in the pool task that first needs the values.
 Every pool thread owns a private codec clone (BottleneckCodec
 .thread_clone) sharing the warmed, lock-guarded schedule cache. Fault
-isolation is preserved inside pool tasks: the `serve.rans` site and the
-payload-CRC re-verify run per task, and an IntegrityError lands on that
-request's future only. A worker that dies mid-pipeline (crash between
-device dispatch and entropy completion) flushes its in-flight records
-on the way out — completed or failed, never hung — and the supervisor
-restarts it. Per-stage observability: `serve_device_ms`,
+isolation is preserved inside the batch task: the `serve.rans` site and
+the payload-CRC re-verify run per request, and an IntegrityError lands
+on that request's future only. A worker that dies mid-pipeline (crash
+between device dispatch and entropy completion) flushes its in-flight
+records on the way out — completed or failed, never hung — and the
+supervisor restarts it.
+
+Batch-native entropy backend (ISSUE 7): PR 4's fan-out ran each image's
+rANS pass as its own Python loop under the GIL, capping the overlap
+ratio at ~0.45 (entropy_ms ~= device_ms in SERVE_BENCH.json). The
+entropy stage now submits ONE task per micro-batch and codes it
+batch-native — `coding/rans.py` `encode_batch` packs every image's
+symbol lanes into one ctypes call whose C loop runs with the GIL
+dropped, and decode advances all lanes per wavefront in one
+`rans.decode_front_batch` call (streams stay bit-identical to the
+per-image path; tests pin all three coders against each other). For
+hosts where even that leaves the Python-side framing GIL-bound,
+`ServiceConfig.entropy_backend = "process"` swaps the coding work onto
+a spawn-context ProcessPoolExecutor of WORKER-RESIDENT codecs: a
+picklable CodecSpec (coding/loader.py) is rebuilt once per worker
+process with its schedule cache warmed there, and the entropy pool
+threads become thin bridges (transfer, per-request CRC/fault
+semantics, framing, future resolution). `serve_entropy_batch_ms`
+times the batch coding span; the `serve_entropy_backend` info entry
+records the active backend in /metrics. Per-stage observability: `serve_device_ms`,
 `serve_entropy_ms` histograms, `serve_pipeline_inflight`, and
 `serve_overlap_ratio` = 1 - busy/(device+entropy) where busy is the
 wall time workers actually spent on batches (serialized mode pins it to
@@ -154,6 +176,28 @@ class ServiceConfig:
     #: GIL-heavy numpy, so a pool wider than the spare cores actively
     #: hurts (measured 0.5x per-encode at 2 threads on a 2-core host)
     entropy_workers: Optional[int] = None
+    #: where the entropy stage's coding work runs (ISSUE 7):
+    #: "thread"  — the entropy pool threads code in-process (batch-native
+    #:             rANS drops the GIL inside the C loop; numpy/BLAS PMF
+    #:             work drops it too, so this is usually enough);
+    #: "process" — a spawn-context ProcessPoolExecutor of worker-resident
+    #:             codecs (coding/loader.py CodecSpec: rebuilt once per
+    #:             worker, schedule cache warmed there) for hosts where
+    #:             even batch-native work leaves the Python-side framing
+    #:             GIL-bound. The entropy pool threads become thin
+    #:             bridges: device->host transfer, per-request CRC/fault
+    #:             semantics, framing, future resolution. Requires
+    #:             entropy_workers > 0.
+    entropy_backend: str = "thread"
+    #: process backend only: ceiling on one micro-batch's coding task in
+    #: a pool child. Child DEATH breaks the pool and is healed by a
+    #: rebuild, but a child that HANGS (swap-thrash, stuck page-in)
+    #: would otherwise block the bridge thread — and every future in
+    #: its batch — forever. On expiry the batch fails typed and the
+    #: pool is swapped for a fresh one. The bound covers the whole
+    #: future — after a rebuild that includes the fresh pool's spawn +
+    #: codec re-warm — so keep it generous.
+    entropy_proc_timeout_s: float = 120.0
     #: max batches a worker may hold in flight (device dispatched,
     #: entropy pending) before finishing the oldest; >= 2 overlaps
     #: batch N's entropy with batch N+1's device stage
@@ -337,6 +381,13 @@ class CompressionService:
         self._batch_hook = None   # test/diagnostic: called with each batch
         self._entropy_hook = None  # test/diagnostic: called per pool task
         self._entropy_pool: Optional[ThreadPoolExecutor] = None
+        # "process"-backend ProcessPoolExecutor. A child segfault/OOM-kill
+        # marks the whole executor broken forever, so bridge threads swap
+        # in a fresh pool on that signal (_proc_call) — hence the lock.
+        self._proc_lock = locks_lib.RankedLock("serve.entropy_proc")
+        self._entropy_proc = None   # guarded-by: self._proc_lock
+        self._proc_initargs = None  # written once in start(), then read-only
+        self._proc_warm = []        # warmup's worker-residence pings
         self._codec_local = threading.local()
         self.placement: Optional[placement_lib.DevicePlacement] = None
         self._num_devices = 1
@@ -353,6 +404,21 @@ class CompressionService:
     def start(self) -> "CompressionService":
         if self._started:
             return self
+        # validate the entropy-backend knobs BEFORE the multi-second
+        # model build: a config typo should cost milliseconds
+        backend = self.config.entropy_backend
+        if backend not in ("thread", "process"):
+            raise ValueError(f"entropy_backend must be 'thread' or "
+                             f"'process', got {backend!r}")
+        ew_cfg = self.config.entropy_workers
+        if backend == "process" and ew_cfg is not None and ew_cfg <= 0:
+            # None is fine: the auto policy below always resolves >= 1
+            raise ValueError("entropy_backend='process' needs "
+                             "entropy_workers > 0 (the process pool IS "
+                             "the entropy stage)")
+        if self.config.entropy_proc_timeout_s <= 0:
+            raise ValueError(f"entropy_proc_timeout_s must be > 0, got "
+                             f"{self.config.entropy_proc_timeout_s}")
         from dsin_tpu.coding.loader import load_model_state, make_codec
         # init at the largest bucket; params are shape-independent (the
         # modules are fully convolutional) so every bucket shares them
@@ -384,10 +450,26 @@ class CompressionService:
         if ew is None:
             import os
             ew = max(1, min(4, (os.cpu_count() or 2) - 1))
+        backend = self.config.entropy_backend   # validated at start() top
         self._entropy_workers = ew
         if ew > 0:
             self._entropy_pool = ThreadPoolExecutor(
                 max_workers=ew, thread_name_prefix="serve-entropy")
+        if backend == "process":
+            from dsin_tpu.coding import loader as loader_lib
+            sub = buckets_lib.SUBSAMPLING
+            warm_shapes = [(self._bn_channels, bh // sub, bw // sub)
+                           for bh, bw in self.policy.buckets]
+            # the spec is built ONCE (numpy pulls happen here, on the
+            # caller's thread, never under _proc_lock) and reused by
+            # child-death rebuilds
+            self._proc_initargs = (loader_lib.make_codec_spec(self.codec),
+                                   warm_shapes)
+            with self._proc_lock:
+                self._entropy_proc = self._make_entropy_proc()
+        self.metrics.set_info("serve_entropy_backend", {
+            "backend": backend, "entropy_workers": ew,
+            "pipeline_depth": self.config.pipeline_depth})
         self._total_workers = self.config.workers * self._num_devices
         with self._workers_lock:
             for i in range(self._total_workers):
@@ -445,6 +527,17 @@ class CompressionService:
 
             for f in [self._entropy_pool.submit(_prime) for _ in range(n)]:
                 f.result(timeout=120)
+        with self._proc_lock:
+            proc = self._entropy_proc
+        if proc is not None:
+            # spin every pool process up now (spawn + codec rebuild +
+            # schedule warm happen in the initializer) so the first real
+            # batch pays coding work only; the pings also double as the
+            # worker-residence evidence (pid + schedule census)
+            from dsin_tpu.coding import loader as loader_lib
+            pings = [proc.submit(loader_lib.worker_ping)
+                     for _ in range(self._entropy_workers)]
+            self._proc_warm = [f.result(timeout=300) for f in pings]
         compiles = recompile.compilation_count() - before
         cache_hits = recompile.cache_hit_count() - before_hits
         self.metrics.gauge("serve_warmup_compiles").set(compiles)
@@ -552,6 +645,10 @@ class CompressionService:
                 # workers flushed their pipelines before exiting, so the
                 # pool is idle; shutdown is immediate (and idempotent)
                 self._entropy_pool.shutdown(wait=True)
+            with self._proc_lock:
+                proc = self._entropy_proc
+            if proc is not None:
+                proc.shutdown(wait=True)
             if self._metrics_server is not None:
                 self._metrics_server.stop()
                 self._metrics_server = None
@@ -879,57 +976,237 @@ class CompressionService:
             sub = buckets_lib.SUBSAMPLING
             rec.sym = np.zeros((self.config.max_batch, bh // sub,
                                 bw // sub, self._bn_channels), np.int32)
-        rec.tasks = [self._entropy_pool.submit(self._entropy_task,
-                                               rec, i, r)
-                     for i, r in enumerate(batch)]
+        # ONE pool task per micro-batch (ISSUE 7): the coding work runs
+        # batch-native (one ctypes call per batch for encode, one per
+        # wavefront for decode) so the C loop holds no GIL; per-request
+        # isolation lives INSIDE the task, not in the fan-out
+        rec.tasks = [self._entropy_pool.submit(self._entropy_batch_task,
+                                               rec)]
         return rec
 
-    def _entropy_task(self, rec: _Inflight, i: int, req) -> tuple:
-        """Stage 2, on an entropy-pool thread: per-image rANS work.
-        Resolves THIS request's future (result for encode; exception on
-        any per-item failure — the serve.rans fault site and the
-        payload-CRC re-verify both live here, so an IntegrityError is
-        isolated to one request). Never raises: a non-`Exception`
+    def _item_failed(self, rec: _Inflight, i: int, req,
+                     e: BaseException) -> None:
+        """Record + answer one request's entropy-stage failure (the
+        per-request isolation contract: an IntegrityError lands on that
+        request's future only; a non-`Exception` crash is recorded for
+        _finish_batch to re-raise on the worker thread)."""
+        rec.per_item_exc[i] = e
+        if not req.future.done():
+            req.future.set_exception(e)
+            self._observe_latency(req)
+        if isinstance(e, IntegrityError):
+            self.metrics.counter("serve_integrity_errors").inc()
+        if not isinstance(e, Exception):
+            rec.crash = e
+
+    def _make_entropy_proc(self):
+        """A fresh "process"-backend pool. spawn (not fork): forking a
+        process whose jax backend has live threads is a deadlock
+        lottery. Workers rebuild the codec from the picklable spec ONCE
+        (initializer) and warm every bucket's schedule there —
+        worker-resident state, nothing re-pickled per task
+        (coding/loader.py). Called from start() and from _proc_call's
+        child-death rebuild."""
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor
+        from dsin_tpu.coding import loader as loader_lib
+        return ProcessPoolExecutor(
+            max_workers=self._entropy_workers,
+            mp_context=multiprocessing.get_context("spawn"),
+            initializer=loader_lib.init_worker_codec,
+            initargs=self._proc_initargs)
+
+    def _proc_call(self, fn, *args):
+        """One coding task on the process backend, surviving child
+        death: a pool worker that segfaults or is OOM-killed marks the
+        whole ProcessPoolExecutor broken — every later submit raises
+        BrokenProcessPool forever — so on that signal the first bridge
+        thread here swaps in a fresh pool (the spawn initializer
+        re-warms the worker-resident codecs) and every caller retries
+        on it. A second break propagates and fails this batch's
+        requests typed, but the NEXT batch again finds a fresh pool.
+        A child that HANGS without dying (swap-thrash, stuck page-in
+        while unpickling) never breaks the pool, so the .result() is
+        bounded by entropy_proc_timeout_s: on expiry the wedged pool is
+        swapped out the same way and the batch fails typed instead of
+        hanging its futures — no retry, the task already burned the
+        whole budget. A submit can also lose the swap race itself —
+        another bridge thread replaced and shut down the pool between
+        our read and the submit, which raises a bare RuntimeError, not
+        BrokenProcessPool — equally retryable: nothing ran in a child.
+        The bridge thread blocks GIL-free on the child doing the
+        coding work — this .result() is the whole point of the process
+        backend, and no lock is held across it."""
+        from concurrent.futures import TimeoutError as FutTimeout
+        from concurrent.futures.process import BrokenProcessPool
+        timeout = self.config.entropy_proc_timeout_s
+        last_exc = None
+        for attempt in (0, 1):
+            with self._proc_lock:
+                proc = self._entropy_proc
+            try:
+                fut = proc.submit(fn, *args)
+            except RuntimeError as e:
+                # either the pool is broken (BrokenProcessPool IS a
+                # RuntimeError) or our `proc` read raced a concurrent
+                # bridge thread's swap and submit found it shut down —
+                # both are retryable on a fresh pool (nothing ran in
+                # the child). Any other RuntimeError is not ours.
+                if (not isinstance(e, BrokenProcessPool) and
+                        "cannot schedule new futures" not in str(e)):
+                    raise
+                self._swap_entropy_proc(proc)
+                last_exc = e
+                continue
+            try:
+                return fut.result(timeout)
+            except BrokenProcessPool as e:
+                self._swap_entropy_proc(proc)
+                last_exc = e
+                continue
+            except FutTimeout:
+                self._swap_entropy_proc(proc)
+                raise TimeoutError(
+                    f"entropy process backend task exceeded {timeout}s "
+                    f"(child alive but stuck); pool replaced") from None
+        raise last_exc
+
+    def _swap_entropy_proc(self, seen) -> None:
+        """Replace a broken/wedged pool with a fresh one (first bridge
+        thread to report `seen` swaps; the rest find it already done)
+        and abandon the old one without waiting on its children."""
+        with self._proc_lock:
+            if self._entropy_proc is seen:
+                self._entropy_proc = self._make_entropy_proc()
+                self.metrics.counter(
+                    "serve_entropy_proc_rebuilds").inc()
+        seen.shutdown(wait=False)                # idempotent
+
+    def _encode_vols(self, vols) -> list:
+        """N (D, H, W) symbol volumes -> [(payload, None) |
+        (None, exc)] per lane (loader.encode_batch_isolated's
+        contract on both backends), one batch call on the configured
+        backend."""
+        from dsin_tpu.coding import loader as loader_lib
+        with self._proc_lock:
+            has_proc = self._entropy_proc is not None
+        if has_proc:
+            return self._proc_call(loader_lib.worker_encode_batch, vols)
+        return loader_lib.encode_batch_isolated(self._thread_codec(),
+                                                vols)
+
+    @staticmethod
+    def _decode_with(codec, payloads) -> list:
+        """[(volume, None) | (None, exc)] per payload — the shared
+        lockstep-batch-with-per-lane-fallback contract lives in
+        loader.decode_batch_isolated (one copy for both backends)."""
+        from dsin_tpu.coding import loader as loader_lib
+        return loader_lib.decode_batch_isolated(codec, payloads)
+
+    def _decode_payloads(self, payloads) -> list:
+        with self._proc_lock:
+            has_proc = self._entropy_proc is not None
+        if has_proc:
+            from dsin_tpu.coding import loader as loader_lib
+            return self._proc_call(loader_lib.worker_decode_batch,
+                                   payloads)
+        return self._decode_with(self._thread_codec(), payloads)
+
+    def _decode_batch_lanes(self, batch, sym, decode, fail) -> None:
+        """One micro-batch's decode-side entropy work under the
+        per-request fault contract, shared by the pipelined task and the
+        serialized path: the `serve.rans` fault site + payload-CRC
+        re-verify run per lane, the batch decode isolates structural
+        errors per lane (loader.decode_batch_isolated), and the sym
+        write itself is guarded per lane — a CRC-valid stream whose
+        DTPC header lies about the bucket geometry passes the door, so
+        it must fail only ITS request, never its batchmates. `decode`
+        maps payloads -> [(vol, exc)]; `fail(i, req, exc)` records one
+        lane's failure."""
+        good, payloads = [], []
+        for i, req in enumerate(batch):
+            try:
+                data = faults.corrupt("serve.rans", req.payload[0])
+                # re-verify right before the entropy decode: corruption
+                # past the door (buffer damage, injected faults) must
+                # raise typed, never decode to a plausible wrong image
+                verify_crc(req.payload[2], "DSRV payload (worker)", data)
+            except BaseException as e:  # noqa: BLE001 — isolate lanes
+                fail(i, req, e)
+            else:
+                good.append(i)
+                payloads.append(data)
+        if not good:
+            return
+        for i, (vol, exc) in zip(good, decode(payloads)):
+            if exc is None:
+                # EXPLICIT shape check, not assignment-raises: numpy
+                # BROADCASTS a compatible wrong geometry (a liar header
+                # claiming (1, 1, 1) would constant-fill the slot and
+                # resolve as a plausible wrong image instead of raising)
+                h, w, c = sym[i].shape          # want vol = (C, h, w)
+                if tuple(vol.shape) == (c, h, w):
+                    sym[i] = np.transpose(vol, (1, 2, 0))
+                    continue
+                exc = ValueError(
+                    f"decoded volume {tuple(vol.shape)} does not fit "
+                    f"the bucket slot {sym[i].shape}")
+            fail(i, batch[i], exc)
+
+    def _entropy_batch_task(self, rec: _Inflight) -> tuple:
+        """Stage 2, ONE entropy-pool task per micro-batch: batch-native
+        rANS work (thread backend: in-process via the thread's codec
+        clone; process backend: shipped to a worker-resident codec in
+        the pool, this thread just bridges). Per-request semantics are
+        preserved inside the task — the serve.rans fault site and the
+        payload-CRC re-verify run per request, an IntegrityError lands
+        on that request's future only, and encode futures resolve here
+        the moment their frame is built. Never raises: a non-`Exception`
         (InjectedCrash class) is recorded on the record and re-raised by
         _finish_batch on the worker thread, where it kills the worker
         the supervisor owns. Returns the (start, end) entropy span."""
         te0 = te1 = None
         try:
             if self._entropy_hook is not None:
-                self._entropy_hook(rec, i, req)
-            codec = self._thread_codec()
+                for i, req in enumerate(rec.batch):
+                    self._entropy_hook(rec, i, req)
             if rec.kind == ENCODE:
                 symbols = rec.handle.host()   # shared one-time transfer
                 te0 = time.monotonic()
-                h, w = req.payload[1]
-                payload = codec.encode(
-                    np.transpose(symbols[i], (2, 0, 1)))
+                vols = [np.transpose(symbols[i], (2, 0, 1))
+                        for i in range(len(rec.batch))]
+                payloads = self._encode_vols(vols)
                 te1 = time.monotonic()
-                req.future.set_result(EncodeResult(
-                    stream=frame_stream(payload, (h, w), rec.bucket),
-                    payload_bytes=len(payload),
-                    bpp=len(payload) * 8.0 / (h * w),
-                    shape=(h, w), bucket=rec.bucket))
-                self._observe_latency(req)
+                for i, req in enumerate(rec.batch):
+                    payload, exc = payloads[i]
+                    if exc is not None:
+                        # per-request isolation, encode half: one
+                        # lane's coding error (capacity exhaustion on
+                        # a pathological stream) fails only ITS request
+                        self._item_failed(rec, i, req, exc)
+                        continue
+                    h, w = req.payload[1]
+                    req.future.set_result(EncodeResult(
+                        stream=frame_stream(payload, (h, w), rec.bucket),
+                        payload_bytes=len(payload),
+                        bpp=len(payload) * 8.0 / (h * w),
+                        shape=(h, w), bucket=rec.bucket))
+                    self._observe_latency(req)
             else:
                 te0 = time.monotonic()
-                data = faults.corrupt("serve.rans", req.payload[0])
-                # re-verify right before the entropy decode: corruption
-                # past the door (buffer damage, injected faults) must
-                # raise typed, never decode to a plausible wrong image
-                verify_crc(req.payload[2], "DSRV payload (worker)", data)
-                vol = codec.decode(data)            # (C, bh/8, bw/8)
-                rec.sym[i] = np.transpose(vol, (1, 2, 0))
+                self._decode_batch_lanes(
+                    rec.batch, rec.sym, self._decode_payloads,
+                    lambda i, req, e: self._item_failed(rec, i, req, e))
                 te1 = time.monotonic()
-        except BaseException as e:  # noqa: BLE001 — isolate bad streams
-            rec.per_item_exc[i] = e
-            if not req.future.done():
-                req.future.set_exception(e)
-                self._observe_latency(req)
-            if isinstance(e, IntegrityError):
-                self.metrics.counter("serve_integrity_errors").inc()
+        except BaseException as e:  # noqa: BLE001 — answer every caller
+            for i, req in enumerate(rec.batch):
+                if i not in rec.per_item_exc and not req.future.done():
+                    self._item_failed(rec, i, req, e)
             if not isinstance(e, Exception):
                 rec.crash = e
+        if te0 is not None and te1 is not None:
+            self.metrics.histogram("serve_entropy_batch_ms").observe(
+                (te1 - te0) * 1e3)
         return (te0, te1)
 
     def _finish_batch(self, rec: _Inflight) -> None:
@@ -1029,9 +1306,19 @@ class CompressionService:
         symbols = np.asarray(self._encode_fn(
             params, bs, self.placement.put_batch(device, x)))
         t_ent = time.monotonic()
+        from dsin_tpu.coding import loader as loader_lib
+        payloads = loader_lib.encode_batch_isolated(
+            self.codec,
+            [np.transpose(symbols[i], (2, 0, 1))
+             for i in range(len(batch))])
         for i, r in enumerate(batch):
+            payload, exc = payloads[i]
+            if exc is not None:
+                # same per-request isolation contract as the pipelined
+                # encode task: the lane's error stays on its future
+                r.future.set_exception(exc)
+                continue
             h, w = r.payload[1]
-            payload = self.codec.encode(np.transpose(symbols[i], (2, 0, 1)))
             r.future.set_result(EncodeResult(
                 stream=frame_stream(payload, (h, w), bucket),
                 payload_bytes=len(payload),
@@ -1048,20 +1335,17 @@ class CompressionService:
                         self._bn_channels), np.int32)
         per_item_exc = {}
         t_ent = time.monotonic()
-        for i, r in enumerate(batch):
-            try:
-                data = faults.corrupt("serve.rans", r.payload[0])
-                # re-verify right before the entropy decode: corruption
-                # past the door (buffer damage, injected faults) must
-                # raise typed, never decode to a plausible wrong image.
-                # IntegrityError lands on this request's future only.
-                verify_crc(r.payload[2], "DSRV payload (worker)", data)
-                vol = self.codec.decode(data)           # (C, bh/8, bw/8)
-                sym[i] = np.transpose(vol, (1, 2, 0))
-            except Exception as e:  # noqa: BLE001 — isolate bad streams
-                per_item_exc[i] = e
-                if isinstance(e, IntegrityError):
-                    self.metrics.counter("serve_integrity_errors").inc()
+
+        def _fail(i, r, e):
+            if not isinstance(e, Exception):
+                raise e   # worker-killing injected crash, as before
+            per_item_exc[i] = e
+            if isinstance(e, IntegrityError):
+                self.metrics.counter("serve_integrity_errors").inc()
+
+        self._decode_batch_lanes(
+            batch, sym, lambda p: self._decode_with(self.codec, p),
+            _fail)
         entropy_ms = (time.monotonic() - t_ent) * 1e3
         if len(per_item_exc) == len(batch):
             # whole batch failed before the device stage: decoding a

@@ -30,9 +30,11 @@ a thread holding rank r may only acquire ranks > r):
       10  serve.batcher       MicroBatcher's condition (serve/batcher.py)
       15  serve.placement     bucket->device routing table (serve/placement.py)
       20  serve.workers       worker-pool bookkeeping (serve/service.py)
+      25  serve.entropy_proc  process-pool slot / child-death rebuild (serve/service.py)
       30  codec.engine        lazy incremental-engine slot (coding/codec.py)
       35  codec.schedules     per-shape schedule cache (coding/incremental.py)
       40  rans.native         native-library load (coding/rans.py)
+      45  rans.counters       native-call count probe (coding/rans.py)
       50  serve.device_batch  shared device->host transfer (serve/service.py)
       60  faults.plan         fault-plan bookkeeping (utils/faults.py)
       70  recompile.counter   XLA compile listener (utils/recompile.py)
@@ -66,9 +68,11 @@ HIERARCHY: Dict[str, int] = {
     "serve.batcher": 10,
     "serve.placement": 15,
     "serve.workers": 20,
+    "serve.entropy_proc": 25,
     "codec.engine": 30,
     "codec.schedules": 35,
     "rans.native": 40,
+    "rans.counters": 45,
     "serve.device_batch": 50,
     "faults.plan": 60,
     "recompile.counter": 70,

@@ -62,6 +62,7 @@ THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
     ("dsin_tpu", "coding", "codec.py"),
     ("dsin_tpu", "coding", "incremental.py"),
     ("dsin_tpu", "coding", "rans.py"),
+    ("dsin_tpu", "coding", "loader.py"),
     ("dsin_tpu", "utils", "recompile.py"),
     ("dsin_tpu", "utils", "faults.py"),
     ("dsin_tpu", "utils", "locks.py"),

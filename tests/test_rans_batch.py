@@ -1,0 +1,347 @@
+"""Batch-native rANS backend (ISSUE 7): the batch entry points must be
+BIT-IDENTICAL to the per-image paths — a serve micro-batch coded through
+`rans.encode_batch` / `rans.decode_front_batch` (one GIL-dropping ctypes
+call per batch) produces exactly the streams/symbols that N separate
+calls would. Also the typed capacity contract: a native `-1` (cap too
+small) retries with a doubled cap and the SAME bytes, and exhausting the
+doublings raises `RansCapacityError`, never a silent Python re-run."""
+
+import numpy as np
+import pytest
+
+from dsin_tpu.coding import codec as codec_lib
+from dsin_tpu.coding import rans
+
+pytestmark = pytest.mark.skipif(
+    not rans.native_available(),
+    reason="native range coder unavailable (no toolchain)")
+
+
+def _random_lane(rng, n, num_syms=6, scale_bits=16):
+    """One lane's (starts, freqs, symbols, cum tables) from n random
+    adaptive PMFs (a fresh table per symbol, the codec's real shape)."""
+    starts = np.empty(n, dtype=np.uint32)
+    freqs = np.empty(n, dtype=np.uint32)
+    symbols = rng.integers(0, num_syms, n)
+    cums = np.empty((n, num_syms + 1), dtype=np.uint32)
+    for i in range(n):
+        f = rans.quantize_pmf(rng.dirichlet(np.ones(num_syms) * 0.5),
+                              scale_bits)
+        cums[i] = rans.cum_from_freqs(f)
+        starts[i] = cums[i][symbols[i]]
+        freqs[i] = f[symbols[i]]
+    return starts, freqs, symbols, cums
+
+
+# -- encode: three paths, one byte stream -------------------------------------
+
+@pytest.mark.parametrize("lane_lens", [
+    [0, 1, 17, 256],          # ragged + empty
+    [1],                      # N=1
+    [0, 0],                   # all-empty batch
+    [64, 64, 64, 64],         # uniform (the common bucket case)
+])
+def test_encode_batch_bit_identical_all_three_paths(lane_lens, monkeypatch):
+    """Python loop, native per-image, and native batch must emit the
+    same bytes lane for lane."""
+    rng = np.random.default_rng(11)
+    lanes = [_random_lane(rng, n) for n in lane_lens]
+    starts = [ln[0] for ln in lanes]
+    freqs = [ln[1] for ln in lanes]
+
+    native_single = [rans.encode(s, f) for s, f in zip(starts, freqs)]
+    native_batch = rans.encode_batch(starts, freqs)
+    python_loop = [rans._encode_py(s, f, rans.DEFAULT_SCALE_BITS)
+                   for s, f in zip(starts, freqs)]
+    assert native_batch == native_single
+    assert native_batch == python_loop
+
+    # the no-native fallback inside encode_batch is the same Python path
+    monkeypatch.setattr(rans, "_load_native", lambda: None)
+    assert rans.encode_batch(starts, freqs) == python_loop
+
+
+def test_encode_batch_fuzz_many_shapes():
+    """Randomized lane-set fuzz: every draw must keep the three paths
+    byte-identical (regression net for the packed-offset arithmetic)."""
+    rng = np.random.default_rng(12)
+    for round_i in range(10):
+        sb = int(rng.integers(10, 17))
+        lane_lens = rng.integers(0, 80, rng.integers(1, 9)).tolist()
+        lanes = [_random_lane(rng, n, num_syms=int(rng.integers(2, 9)),
+                              scale_bits=sb)
+                 for n in lane_lens]
+        starts = [ln[0] for ln in lanes]
+        freqs = [ln[1] for ln in lanes]
+        batch = rans.encode_batch(starts, freqs, sb)
+        singles = [rans.encode(s, f, sb) for s, f in zip(starts, freqs)]
+        pys = [rans._encode_py(s, f, sb) for s, f in zip(starts, freqs)]
+        assert batch == singles == pys, f"fuzz round {round_i} diverged"
+
+
+def test_encode_batch_empty_and_mismatch():
+    assert rans.encode_batch([], []) == []
+    with pytest.raises(ValueError, match="lanes"):
+        rans.encode_batch([np.zeros(1, np.uint32)], [])
+    with pytest.raises(ValueError, match="frequencies"):
+        rans.encode_batch([np.zeros(2, np.uint32)],
+                          [np.zeros(2, np.uint32)])
+
+
+def test_encode_batch_is_one_native_call():
+    """The whole point: N lanes cross the ctypes boundary ONCE."""
+    rng = np.random.default_rng(13)
+    lanes = [_random_lane(rng, 32) for _ in range(6)]
+    rans.reset_native_call_counts()
+    rans.encode_batch([ln[0] for ln in lanes], [ln[1] for ln in lanes])
+    counts = rans.native_call_counts()
+    assert counts.get("encode_batch") == 1
+    assert counts.get("encode", 0) == 0
+
+
+# -- decode: batched wavefront ------------------------------------------------
+
+@pytest.mark.parametrize("front_lens", [
+    [5, 0, 17, 1],            # ragged + an empty lane
+    [12],                     # N=1
+    [8, 8, 8],                # uniform
+])
+def test_decode_front_batch_matches_per_decoder(front_lens):
+    """One batched call must advance every decoder exactly as its own
+    decode_front would — and the coder states must stay aligned, so a
+    SECOND front after the batched one still matches."""
+    rng = np.random.default_rng(21)
+    streams, fronts1, fronts2, syms = [], [], [], []
+    for k in front_lens:
+        s1, f1, sy1, c1 = _random_lane(rng, k)
+        s2, f2, sy2, c2 = _random_lane(rng, 7)
+        streams.append(rans.encode(np.concatenate([s1, s2]),
+                                   np.concatenate([f1, f2])))
+        fronts1.append(c1)
+        fronts2.append(c2)
+        syms.append((sy1, sy2))
+
+    batch_out, solo_out = [], []
+    decs = [rans.Decoder(b) for b in streams]
+    try:
+        batch_out = rans.decode_front_batch(decs, fronts1)
+        batch_out2 = rans.decode_front_batch(decs, fronts2)
+    finally:
+        for d in decs:
+            d.close()
+    decs = [rans.Decoder(b) for b in streams]
+    try:
+        solo_out = [d.decode_front(c) for d, c in zip(decs, fronts1)]
+        solo_out2 = [d.decode_front(c) for d, c in zip(decs, fronts2)]
+    finally:
+        for d in decs:
+            d.close()
+    for got, want, (sy1, _) in zip(batch_out, solo_out, syms):
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got, sy1)
+    for got, want, (_, sy2) in zip(batch_out2, solo_out2, syms):
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got, sy2)
+
+
+def test_decode_front_batch_validation_and_empty():
+    assert rans.decode_front_batch([], []) == []
+    with pytest.raises(ValueError, match="decoders"):
+        rans.decode_front_batch([], [np.zeros((1, 7), np.uint32)])
+    rng = np.random.default_rng(22)
+    s, f, _, c = _random_lane(rng, 4)
+    stream = rans.encode(s, f)
+    with rans.Decoder(stream) as d1, rans.Decoder(stream) as d2:
+        with pytest.raises(ValueError, match="width"):
+            rans.decode_front_batch(
+                [d1, d2], [c, np.zeros((2, 3), np.uint32)])
+        with pytest.raises(ValueError, match="scale_bits"):
+            rans.decode_front_batch(
+                [d1, rans.Decoder(stream, scale_bits=12)], [c, c])
+
+
+def test_decode_front_batch_is_one_native_call():
+    rng = np.random.default_rng(23)
+    lanes = [_random_lane(rng, 16) for _ in range(5)]
+    streams = [rans.encode(s, f) for s, f, _, _ in lanes]
+    decs = [rans.Decoder(b) for b in streams]
+    try:
+        rans.reset_native_call_counts()
+        rans.decode_front_batch(decs, [c for _, _, _, c in lanes])
+        counts = rans.native_call_counts()
+        assert counts.get("decode_batch") == 1
+        assert counts.get("decode_front", 0) == 0
+    finally:
+        for d in decs:
+            d.close()
+
+
+# -- capacity contract (satellite: typed error / doubled-cap retry) -----------
+
+def _incompressible_lane(n, scale_bits=16):
+    """Worst-case stream: every symbol has the minimum legal frequency,
+    so each costs the full scale_bits — the stream EXPANDS to ~2
+    bytes/symbol at scale_bits=16, the regime the old fixed cap feared."""
+    starts = np.arange(n, dtype=np.uint32) % ((1 << scale_bits) - 1)
+    freqs = np.ones(n, dtype=np.uint32)
+    return starts, freqs
+
+
+def test_encode_capacity_retry_is_bit_identical(monkeypatch):
+    """A too-small first cap must re-encode at double the room and return
+    the SAME bytes a large-enough first cap produces — and never silently
+    detour through the Python coder."""
+    starts, freqs = _incompressible_lane(64)
+    want = rans.encode(starts, freqs)
+
+    calls = []
+    real_cap = rans._encode_cap
+    monkeypatch.setattr(rans, "_encode_cap", lambda n: 16)
+    monkeypatch.setattr(rans, "_encode_py",
+                        lambda *a, **k: calls.append("py"))
+    rans.reset_native_call_counts()
+    got = rans.encode(starts, freqs)
+    assert got == want
+    assert calls == [], "capacity retry fell back to the Python coder"
+    # 16 -> 32 -> ... : several native attempts, each counted
+    assert rans.native_call_counts()["encode"] > 1
+    assert rans._encode_cap is not real_cap  # monkeypatch sanity
+
+
+def test_encode_capacity_exhaustion_raises_typed(monkeypatch):
+    starts, freqs = _incompressible_lane(4096)
+    monkeypatch.setattr(rans, "_encode_cap", lambda n: 8)
+    monkeypatch.setattr(rans, "_CAP_DOUBLINGS", 2)
+    with pytest.raises(rans.RansCapacityError, match="doubling"):
+        rans.encode(starts, freqs)
+
+
+def test_encode_batch_capacity_retry_and_exhaustion(monkeypatch):
+    """The batch path shares the contract: lane overflow -> doubled
+    lane_cap, same bytes; exhaustion -> RansCapacityError naming the
+    guilty lane."""
+    rng = np.random.default_rng(31)
+    small = _random_lane(rng, 8)
+    big = _incompressible_lane(150)
+    starts = [small[0], big[0]]
+    freqs = [small[1], big[1]]
+    want = [rans.encode(s, f) for s, f in zip(starts, freqs)]
+
+    monkeypatch.setattr(rans, "_encode_cap", lambda n: 32)
+    assert rans.encode_batch(starts, freqs) == want
+
+    monkeypatch.setattr(rans, "_CAP_DOUBLINGS", 1)
+    monkeypatch.setattr(rans, "_encode_cap", lambda n: 8)
+    with pytest.raises(rans.RansCapacityError, match="lane 1"):
+        rans.encode_batch(starts, freqs)
+
+
+def test_incompressible_roundtrip_survives_expansion():
+    """Regression for the satellite's worst case: an incompressible
+    stream (uniform minimum-frequency symbols) must encode (with
+    whatever retries it needs) and decode back exactly."""
+    n, sb = 512, 16
+    rng = np.random.default_rng(32)
+    L = 1 << 8
+    freq_table = np.full(L, (1 << sb) // L, dtype=np.uint32)
+    cum = rans.cum_from_freqs(freq_table)
+    syms = rng.integers(0, L, n)
+    stream = rans.encode(cum[syms].astype(np.uint32),
+                         freq_table[syms].astype(np.uint32), sb)
+    with rans.Decoder(stream, sb) as dec:
+        out = dec.decode_static(cum, n)
+    np.testing.assert_array_equal(out, syms)
+
+
+# -- codec-level batch paths --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_codec():
+    import jax
+    import jax.numpy as jnp
+    from dsin_tpu.config import parse_config
+    from dsin_tpu.models import probclass as pc_lib
+    pc_cfg = parse_config(
+        """
+        arch = res_shallow
+        kernel_size = 3
+        arch_param__k = 4
+        use_centers_for_padding = True
+        """)
+    num_centers = 6
+    model = pc_lib.ResShallow(pc_cfg, num_centers=num_centers)
+    centers = np.linspace(-2.0, 2.0, num_centers).astype(np.float32)
+    vol = pc_lib.pad_volume(jnp.zeros((1, 4, 6, 8, 1)), 3, 0.0)
+    variables = model.init(jax.random.PRNGKey(0), vol)
+    return codec_lib.BottleneckCodec(model, variables["params"], centers,
+                                     pc_cfg)
+
+
+def test_codec_encode_batch_bit_identical(tiny_codec):
+    rng = np.random.default_rng(41)
+    vols = [rng.integers(0, tiny_codec.num_centers, (4, 6, 8))
+            for _ in range(4)]
+    singles = [tiny_codec.encode(v) for v in vols]
+    assert tiny_codec.encode_batch(vols) == singles
+
+
+def test_codec_encode_rejects_empty_volume(tiny_codec):
+    """_parse_header rejects d*h*w == 0, so encode must refuse empty
+    volumes up front instead of emitting a stream decode can't read."""
+    with pytest.raises(ValueError, match="empty symbol volume"):
+        tiny_codec.encode(np.zeros((4, 0, 8), np.int32))
+    with pytest.raises(ValueError, match="empty symbol volume"):
+        tiny_codec.encode_batch([np.zeros((2, 3, 4), np.int32),
+                                 np.zeros((0, 0, 0), np.int32)])
+
+
+def test_codec_encode_batch_ragged_shapes(tiny_codec):
+    rng = np.random.default_rng(42)
+    vols = [rng.integers(0, tiny_codec.num_centers, s)
+            for s in [(4, 6, 8), (4, 4, 4), (4, 6, 8)]]
+    singles = [tiny_codec.encode(v) for v in vols]
+    assert tiny_codec.encode_batch(vols) == singles
+
+
+def test_codec_decode_batch_lockstep_matches_per_stream(tiny_codec):
+    """Same-shape wavefront_np streams take the lockstep path (one
+    native call per front) and must reproduce every volume exactly."""
+    rng = np.random.default_rng(43)
+    vols = [rng.integers(0, tiny_codec.num_centers, (4, 6, 8))
+            for _ in range(3)]
+    streams = tiny_codec.encode_batch(vols)
+    rans.reset_native_call_counts()
+    outs = tiny_codec.decode_batch(streams)
+    counts = rans.native_call_counts()
+    assert counts.get("decode_batch", 0) > 0, "lockstep path not taken"
+    assert counts.get("decode_front", 0) == 0
+    for got, want in zip(outs, vols):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_codec_decode_batch_mixed_shapes_falls_back(tiny_codec):
+    rng = np.random.default_rng(44)
+    vols = [rng.integers(0, tiny_codec.num_centers, s)
+            for s in [(4, 6, 8), (4, 4, 4)]]
+    streams = tiny_codec.encode_batch(vols)
+    for got, want in zip(tiny_codec.decode_batch(streams), vols):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_codec_decode_batch_degenerate(tiny_codec):
+    assert tiny_codec.decode_batch([]) == []
+    rng = np.random.default_rng(45)
+    vol = rng.integers(0, tiny_codec.num_centers, (4, 6, 8))
+    [out] = tiny_codec.decode_batch([tiny_codec.encode(vol)])
+    np.testing.assert_array_equal(out, vol)
+
+
+def test_codec_batch_helpers_nhwc_roundtrip(tiny_codec):
+    rng = np.random.default_rng(46)
+    batch = rng.integers(0, tiny_codec.num_centers, (3, 6, 8, 4))
+    streams = codec_lib.encode_batch(tiny_codec, batch)
+    singles = [tiny_codec.encode(np.transpose(s, (2, 0, 1)))
+               for s in batch]
+    assert streams == singles
+    np.testing.assert_array_equal(
+        codec_lib.decode_batch(tiny_codec, streams), batch)

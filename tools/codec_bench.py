@@ -69,11 +69,124 @@ def bench_shape(codec, shape, L, warm: bool) -> dict:
     return entry
 
 
+def bench_entropy_batch(codec, batch_n: int, shape, repeats: int = 3) -> dict:
+    """Serve-micro-batch coding comparison (ISSUE 7): the same N-volume
+    batch through the three entropy paths —
+
+      per_image     N codec.encode/.decode calls (the PR 4-6 serve path)
+      batch_native  codec.encode_batch/.decode_batch: ONE ctypes call per
+                    batch (encode) / per wavefront (decode), C loop with
+                    the GIL dropped
+      process_pool  loader.py worker-resident codec behind a 1-worker
+                    spawn ProcessPoolExecutor (includes volume/stream
+                    pickling — the serve "process" backend's per-task
+                    cost, minus its thread-bridge overlap)
+
+    All three must produce byte-identical streams (asserted). Times are
+    best-of-`repeats` single-threaded wall — the GIL-release benefit of
+    the batch path only shows under CONCURRENT load (serve_bench's
+    entropy_backends axis measures that); this section isolates the
+    per-call overhead delta."""
+    import multiprocessing
+    from concurrent.futures import ProcessPoolExecutor
+
+    from dsin_tpu.coding import loader as loader_lib
+    from dsin_tpu.coding import rans
+
+    rng = np.random.default_rng(0)
+    vols = [rng.integers(0, codec.num_centers, shape)
+            for _ in range(batch_n)]
+    codec.encode(vols[0])   # warm: schedule build + first BLAS touch
+
+    def best(fn):
+        b, out = float("inf"), None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = fn()
+            b = min(b, time.perf_counter() - t0)
+        return b, out
+
+    rans.reset_native_call_counts()
+    enc_single_s, streams = best(lambda: [codec.encode(v) for v in vols])
+    calls_per_image = rans.native_call_counts().get("encode", 0) // repeats
+    rans.reset_native_call_counts()
+    enc_batch_s, streams_b = best(lambda: codec.encode_batch(vols))
+    calls_batch = rans.native_call_counts().get("encode_batch", 0) // repeats
+    assert streams_b == streams, "batch-native streams diverged"
+    dec_single_s, outs = best(lambda: [codec.decode(s) for s in streams])
+    dec_batch_s, outs_b = best(lambda: codec.decode_batch(streams))
+    for a, b in zip(outs, outs_b):
+        assert (a == b).all(), "batch decode diverged"
+
+    spec = loader_lib.make_codec_spec(codec)
+    with ProcessPoolExecutor(
+            max_workers=1,
+            mp_context=multiprocessing.get_context("spawn"),
+            initializer=loader_lib.init_worker_codec,
+            initargs=(spec, [tuple(shape)])) as pool:
+        # spin-up + codec rebuild happen here, OUTSIDE the timed region
+        pool.submit(loader_lib.worker_ping).result(timeout=300)
+        enc_proc_s, enc_p = best(
+            lambda: pool.submit(loader_lib.worker_encode_batch,
+                                vols).result())
+        dec_proc_s, dec_p = best(
+            lambda: pool.submit(loader_lib.worker_decode_batch,
+                                streams).result())
+    assert all(exc is None for _, exc in enc_p), \
+        "process-pool encode failed a lane"
+    streams_p = [p for p, _ in enc_p]
+    assert streams_p == streams, "process-pool streams diverged"
+    # the decode direction of the process path must be verified too —
+    # bit_identical below claims ALL THREE paths, both directions
+    for (vol, exc), a in zip(dec_p, outs):
+        assert exc is None, f"process-pool decode failed a lane: {exc}"
+        assert (vol == a).all(), "process-pool decode diverged"
+
+    total_bytes = sum(len(s) for s in streams)
+    total_mb = total_bytes / 1e6
+
+    def path(enc_s, dec_s):
+        return {
+            "encode_s": round(enc_s, 4), "decode_s": round(dec_s, 4),
+            "encode_images_per_s": round(batch_n / enc_s, 2),
+            "decode_images_per_s": round(batch_n / dec_s, 2),
+            "encode_mb_per_s": round(total_mb / enc_s, 3),
+            "decode_mb_per_s": round(total_mb / dec_s, 3),
+        }
+
+    return {
+        "shape": list(shape), "batch_n": batch_n, "repeats": repeats,
+        "stream_bytes_total": total_bytes,
+        "per_image": path(enc_single_s, dec_single_s),
+        "batch_native": path(enc_batch_s, dec_batch_s),
+        "process_pool": path(enc_proc_s, dec_proc_s),
+        "native_encode_calls": {"per_image": calls_per_image,
+                                "batch_native": calls_batch},
+        "bit_identical": True,   # asserted above, all three paths
+        "note": ("best-of-N single-threaded wall on the shared 2-core CI "
+                 "host (ROADMAP caveat): the scan/PMF half dominates and "
+                 "is identical across paths, so the deltas here isolate "
+                 "per-call overhead only — the batch path's real win "
+                 "(the C loop runs with the GIL dropped, so entropy-pool "
+                 "threads stop serializing each other) shows under "
+                 "concurrent load, measured by serve_bench's "
+                 "entropy_backends axis. process_pool includes "
+                 "volume/stream pickling per task."),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--shapes", nargs="+",
                    default=["32,40,120", "32,128,256"],
                    help="D,H,W bottleneck volumes to roundtrip")
+    p.add_argument("--entropy_batch_n", type=int, default=8,
+                   help="micro-batch size for the entropy_batch section "
+                        "(0 disables the section)")
+    p.add_argument("--entropy_batch_shape", default="32,8,24",
+                   help="D,H,W volume for the entropy_batch section — "
+                        "small on purpose: the section isolates per-call "
+                        "coding overhead, not scan throughput")
     p.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "CODEC_BENCH.json"))
@@ -116,6 +229,14 @@ def main(argv=None) -> int:
         print(f"[codec_bench] {spec}: {entry}", file=sys.stderr, flush=True)
         entries.append(entry)
 
+    entropy_batch = None
+    if args.entropy_batch_n > 0:
+        eb_shape = tuple(int(v) for v in args.entropy_batch_shape.split(","))
+        entropy_batch = bench_entropy_batch(codec, args.entropy_batch_n,
+                                            eb_shape)
+        print(f"[codec_bench] entropy_batch: {entropy_batch}",
+              file=sys.stderr, flush=True)
+
     out = {
         "engine": "wavefront_np (incremental cached activations)",
         "native_rans": rans.native_available(),
@@ -130,6 +251,7 @@ def main(argv=None) -> int:
                  "per-image, single-worker costs. Previous jit wavefront "
                  "engine: 44.8s enc / 44.5s dec at (32,40,120)."),
         "entries": entries,
+        "entropy_batch": entropy_batch,
     }
     path = args.out
     tmp = path + ".tmp"

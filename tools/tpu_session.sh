@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke serve-smoke serve-multidevice bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke serve-smoke serve-multidevice bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -92,6 +92,24 @@ serve-multidevice)
     exit 1
   fi
   ;;
+entropy-bench)
+  # entropy-backend smoke before chip time (ISSUE 7): the same stream
+  # through the thread (batch-native rANS) and process (worker-resident
+  # codec pool) backends — serve_bench exits 1 unless the two emit
+  # BYTE-IDENTICAL streams for the same probe images, nobody compiles
+  # in steady state, and the thread backend holds the PR-4 overlap
+  # floor (> 0.25). --backends_only skips the serialized-vs-pipelined
+  # pair bench (serve-smoke owns it) and the device axis
+  # (serve-multidevice owns it) so the stage stays seconds-fast.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --backends_only \
+    --out artifacts/entropy_bench.json \
+    > artifacts/entropy_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/entropy_bench.log
+    echo "TPU_SESSION_FAILED: entropy-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -163,7 +181,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke serve-multidevice bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
