@@ -72,6 +72,26 @@ def make_codec(model, state):
     return BottleneckCodec.for_model(model, state.params)
 
 
+def params_digest(tree) -> str:
+    """Order-stable digest of a parameter pytree (structure + dtypes +
+    shapes + bytes). The multi-replica front door (serve/router.py)
+    compares every replica's digest at the ready handshake: shared-
+    nothing replicas must have built the SAME model from the same
+    config/seed/checkpoint, or two replicas would answer one request
+    with different bytes — a mismatch is refused at start, not
+    discovered as flaky bit-identity in production."""
+    import hashlib
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
 # -- worker-resident codecs (the serve process entropy backend) ---------------
 #
 # A live BottleneckCodec cannot cross a process boundary: its params are

@@ -2,8 +2,12 @@
 
 Layering (each module stands alone below the next):
     buckets.py   — static shape buckets (fixed executable census)
-    batcher.py   — bounded queue, same-bucket coalescing, backpressure,
-                   deadlines, drain (pure stdlib threading)
+    batcher.py   — bounded queues, same-bucket coalescing, priority
+                   classes (per-class deadlines + shed order),
+                   backpressure, drain (pure stdlib threading)
+    router.py    — front door: per-class admission control + the
+                   shared-nothing multi-replica router (spawned
+                   service processes, /healthz-fed eviction)
     placement.py — bucket ladder -> device mesh assignment (replica
                    policy + per-device shardings via parallel/mesh.py)
     metrics.py   — lock-guarded counters/gauges/histograms + http.server
@@ -16,24 +20,30 @@ Driven by tools/serve_bench.py (open-loop load + --devices scaling axis,
 SERVE_BENCH.json).
 """
 
-from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, MicroBatcher,
+from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, DeadlineExceeded,
+                                    Future, MicroBatcher, PriorityClass,
                                     Request, ServeError, ServiceDraining,
-                                    ServiceOverloaded, ServiceUnavailable)
+                                    ServiceOverloaded, ServiceUnavailable,
+                                    default_priority_classes)
 from dsin_tpu.serve.buckets import (BucketPolicy, NoBucketFits,
                                     crop_from_bucket, pad_to_bucket)
 from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
 from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
-                                      PlacementPlan, plan_placement)
+                                      PlacementPlan, RebalanceTrigger,
+                                      plan_placement)
+from dsin_tpu.serve.router import AdmissionController, FrontDoorRouter
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
 from dsin_tpu.utils.integrity import IntegrityError
 
 __all__ = [
-    "BucketPolicy", "CompressionService", "DeadlineExceeded",
-    "DevicePlacement", "EncodeResult", "Future", "IntegrityError",
-    "MetricsRegistry", "MetricsServer", "MicroBatcher", "NoBucketFits",
-    "PlacementError", "PlacementPlan", "Request", "ServeError",
-    "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
-    "ServiceUnavailable", "crop_from_bucket", "pad_to_bucket",
-    "plan_placement",
+    "BULK", "INTERACTIVE",
+    "AdmissionController", "BucketPolicy", "CompressionService",
+    "DeadlineExceeded", "DevicePlacement", "EncodeResult",
+    "FrontDoorRouter", "Future", "IntegrityError", "MetricsRegistry",
+    "MetricsServer", "MicroBatcher", "NoBucketFits", "PlacementError",
+    "PlacementPlan", "PriorityClass", "RebalanceTrigger", "Request",
+    "ServeError", "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
+    "ServiceUnavailable", "crop_from_bucket",
+    "default_priority_classes", "pad_to_bucket", "plan_placement",
 ]

@@ -1,4 +1,5 @@
-"""Dynamic micro-batcher: bounded queue + same-bucket coalescing.
+"""Dynamic micro-batcher: bounded queues + same-bucket coalescing,
+priority-class aware.
 
 The throughput/latency trade every batched service makes, with explicit
 failure semantics instead of the two silent ones:
@@ -12,22 +13,46 @@ failure semantics instead of the two silent ones:
   queued is completed with `DeadlineExceeded` and never batched —
   serving an answer nobody is waiting for still costs a batch slot.
 
+Priority classes (ISSUE 8): millions of users means tiered traffic, not
+one FIFO — a bulk encode burst must not blow the p99 of a
+latency-sensitive decode. The batcher therefore takes an ordered tuple
+of `PriorityClass`es (first = most latency-sensitive; default: one
+"default" class, the pre-priority behavior). Each class carries
+
+* its own BOUNDED queue (`max_queue` per class, on top of the shared
+  total bound) — a bulk flood can only ever occupy bulk's slots;
+* a per-class DEFAULT DEADLINE (`default_deadline_ms`, applied at
+  submit when the request carries none) — bulk work queued past its
+  usefulness expires typed instead of rotting;
+* a defined SHED ORDER under overload: when the shared total bound is
+  hit, a higher-class submit evicts the NEWEST queued request of the
+  lowest non-empty class below it (`interactive` admits while `bulk`
+  sheds; the victim's future resolves with a typed per-class
+  `ServiceOverloaded`). A submit with no lower-class victim sheds
+  itself. Every shed/expiry error names its class and the depth at the
+  moment of the decision, so shed decisions are debuggable from logs
+  alone.
+
 Coalescing: requests carry an opaque hashable `key` ((kind, bucket) in
-the service); a batch only ever contains one key, because one key maps
-to one XLA executable. A worker picks keys ROUND-ROBIN across the live
-(non-empty) key queues — the probe resumes after the last key served,
-so a hot small bucket whose queue never drains cannot monopolize the
-workers: every live key is at most #live-keys pops from service
-(weighted-fair across buckets; FIFO within a key). The worker then
-waits up to `max_wait_ms` for the chosen key's queue to fill to
+the service); a batch only ever contains one (class, key), because one
+key maps to one XLA executable. Popping is CLASS-THEN-BUCKET aware: a
+worker serves the highest-priority class with work first, and within a
+class picks keys ROUND-ROBIN across the live (non-empty) key queues —
+the probe resumes after the last key served, so a hot small bucket
+whose queue never drains cannot monopolize the workers: every live key
+is at most #live-keys pops from service within its class
+(weighted-fair across buckets; FIFO within a (class, key)). Strict
+priority across classes is deliberate: bulk's starvation mode under
+sustained interactive load is bounded by its own deadline/shed
+contract, not by stealing interactive's latency budget. The worker
+then waits up to `max_wait_ms` for the chosen queue to fill to
 `max_batch` — the head request's age bounds added latency, late
 same-bucket arrivals ride along free.
 
 All batcher state lives under ONE condition — the named
-`serve.batcher` rung (rank 10, the hierarchy's outermost: the
-`on_expired` callback runs under it and reports into the metrics leaf
-locks, utils/locks.py) — so tier-1 exercises all of it on CPU with no
-jax in sight.
+`serve.batcher` rung (rank 10; the `on_expired`/`on_shed` callbacks
+run under it and report into the metrics leaf locks, utils/locks.py)
+— so tier-1 exercises all of it on CPU with no jax in sight.
 """
 
 from __future__ import annotations
@@ -36,9 +61,15 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import (AbstractSet, Any, Dict, Hashable, List, Optional)
+from typing import (AbstractSet, Any, Callable, Dict, Hashable, List,
+                    Optional, Sequence, Tuple)
 
 from dsin_tpu.utils import locks as locks_lib
+
+#: the two traffic classes the serve stack ships with (serve/router.py
+#: routes by them; ServiceConfig.priority_classes enables them)
+INTERACTIVE = "interactive"
+BULK = "bulk"
 
 
 class ServeError(RuntimeError):
@@ -46,7 +77,18 @@ class ServeError(RuntimeError):
 
 
 class ServiceOverloaded(ServeError):
-    """Queue full — shed load now; retry against another replica/later."""
+    """Queue full — shed load now; retry against another replica/later.
+
+    Typed per class: `priority` names the class whose bound (or shed
+    decision) produced this, `depth` the class/queue depth at that
+    moment — both also spelled out in the message so a log line alone
+    identifies the guilty queue (ISSUE 8 satellite)."""
+
+    def __init__(self, msg: str, priority: Optional[str] = None,
+                 depth: Optional[int] = None):
+        super().__init__(msg)
+        self.priority = priority
+        self.depth = depth
 
 
 class ServiceDraining(ServeError):
@@ -60,24 +102,94 @@ class ServiceUnavailable(ServeError):
 
 
 class DeadlineExceeded(ServeError):
-    """Deadline passed while the request was still queued."""
+    """Deadline passed while the request was still queued. `priority`
+    names the request's class (per-class deadline accounting)."""
+
+    def __init__(self, msg: str, priority: Optional[str] = None):
+        super().__init__(msg)
+        self.priority = priority
+
+
+@dataclass(frozen=True)
+class PriorityClass:
+    """One traffic class: its queue bound and its default deadline.
+    Order in the `MicroBatcher(classes=...)` tuple IS the policy —
+    earlier classes pop first and shed last."""
+    name: str
+    max_queue: int
+    default_deadline_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.max_queue < 1:
+            raise ValueError(f"class {self.name!r}: max_queue must be "
+                             f">= 1, got {self.max_queue}")
+        if (self.default_deadline_ms is not None
+                and self.default_deadline_ms <= 0):
+            raise ValueError(f"class {self.name!r}: default_deadline_ms "
+                             f"must be > 0, got {self.default_deadline_ms}")
+
+
+def default_priority_classes(
+        max_queue: int,
+        interactive_deadline_ms: Optional[float] = None,
+        bulk_deadline_ms: Optional[float] = None,
+        bulk_max_queue: Optional[int] = None,
+) -> Tuple[PriorityClass, PriorityClass]:
+    """The shipped two-class policy: `interactive` pops first and sheds
+    last; `bulk` takes the overload. Each class is bounded at
+    `max_queue` by default (the shared total bound is what forces the
+    shed interplay); cap bulk tighter with `bulk_max_queue`."""
+    return (PriorityClass(INTERACTIVE, max_queue=max_queue,
+                          default_deadline_ms=interactive_deadline_ms),
+            PriorityClass(BULK,
+                          max_queue=(max_queue if bulk_max_queue is None
+                                     else bulk_max_queue),
+                          default_deadline_ms=bulk_deadline_ms))
 
 
 class Future:
-    """Minimal one-shot result slot (stdlib Event; no asyncio loop to own)."""
+    """Minimal one-shot result slot (stdlib Event; no asyncio loop to
+    own). `add_done_callback` exists for the front door: the admission
+    gate (serve/router.py) releases its per-class slot the moment the
+    future resolves, on the resolving thread — callbacks must stay
+    cheap and leaf-locked (they may run under the batcher condition,
+    e.g. when a shed or drain resolves the future)."""
 
     def __init__(self):
         self._done = threading.Event()
         self._result: Any = None
         self._exc: Optional[BaseException] = None
+        self._cb_lock = locks_lib.RankedLock("serve.future")
+        # None once fired: late add_done_callback runs immediately
+        self._callbacks: Optional[List[Callable]] = []  # guarded-by: self._cb_lock
+
+    def _fire_callbacks(self) -> None:
+        with self._cb_lock:
+            cbs = self._callbacks or []
+            self._callbacks = None
+        for cb in cbs:
+            cb(self)
 
     def set_result(self, value: Any) -> None:
         self._result = value
         self._done.set()
+        self._fire_callbacks()
 
     def set_exception(self, exc: BaseException) -> None:
         self._exc = exc
         self._done.set()
+        self._fire_callbacks()
+
+    def add_done_callback(self, fn: Callable[["Future"], None]) -> None:
+        """Run `fn(self)` once the future resolves — immediately (on the
+        calling thread) if it already has, else exactly once on the
+        resolving thread. Callbacks fire at most once per future even
+        if a buggy caller double-resolves."""
+        with self._cb_lock:
+            if self._callbacks is not None:
+                self._callbacks.append(fn)
+                return
+        fn(self)
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -96,21 +208,30 @@ class Future:
 
 @dataclass
 class Request:
-    """One unit of work. `payload` is opaque to the batcher; `key` decides
-    what it may be batched with; `deadline` is absolute time.monotonic()."""
+    """One unit of work. `payload` is opaque to the batcher; `key`
+    decides what it may be batched with; `deadline` is absolute
+    time.monotonic(); `priority` names a configured class (None = the
+    batcher's first/most-latency-sensitive class, filled in at
+    submit)."""
     key: Hashable
     payload: Any
     deadline: Optional[float] = None
     future: Future = field(default_factory=Future)
     arrival: float = field(default_factory=time.monotonic)
+    priority: Optional[str] = None
 
 
 class MicroBatcher:
-    """Bounded multi-queue with same-key coalescing, deadlines, and drain.
+    """Bounded multi-queue with same-key coalescing, priority classes,
+    deadlines, and drain.
 
     Contract:
-      submit(req)        -> enqueue | raise ServiceOverloaded/ServiceDraining
-      next_batch(t)      -> [Request, ...] (one key, 1..max_batch of them)
+      submit(req)        -> enqueue | raise ServiceOverloaded (typed:
+                            class + depth in the message and on the
+                            exception) / ServiceDraining; may SHED the
+                            newest lower-class request to admit a
+                            higher-class one when the total bound is hit
+      next_batch(t)      -> [Request, ...] (one (class, key), 1..max_batch)
                             | [] on timeout | None once closed AND empty
       close()            -> reject everything queued with ServiceDraining;
                             workers mid-batch are unaffected (in-flight
@@ -118,15 +239,17 @@ class MicroBatcher:
 
     Device-affine consumers (serve/placement.py): `next_batch(accept=…)`
     takes an optional key SET — keys outside it are invisible to THIS
-    call, so a per-device executor only ever pops batches for buckets
-    placed on its device while other executors drain the rest. The
-    round-robin ring is shared across consumers (fairness is per-bucket,
-    not per-consumer); a consumer whose accepted keys are all empty
-    waits exactly like one facing an empty batcher.
+    call (across every class), so a per-device executor only ever pops
+    batches for buckets placed on its device while other executors
+    drain the rest. The round-robin ring is shared across consumers
+    (fairness is per-bucket, not per-consumer); a consumer whose
+    accepted keys are all empty waits exactly like one facing an empty
+    batcher.
     """
 
     def __init__(self, max_batch: int, max_wait_ms: float, max_queue: int,
-                 on_expired=None):
+                 on_expired=None, classes: Optional[Sequence[PriorityClass]]
+                 = None, on_shed=None):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         if max_wait_ms < 0:
@@ -134,32 +257,112 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait_ms) / 1000.0
         self.max_queue = int(max_queue)
-        #: called with the count of deadline-expired requests (under the
-        #: batcher lock — keep it leaf-locked and cheap, e.g. a counter)
+        if classes is None:
+            classes = (PriorityClass("default", max_queue=self.max_queue),)
+        if not classes:
+            raise ValueError("need at least one priority class")
+        names = [pc.name for pc in classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate priority class names: {names}")
+        #: pop-priority order: classes[0] pops first, sheds last
+        self.classes: Tuple[PriorityClass, ...] = tuple(classes)
+        self._by_name: Dict[str, PriorityClass] = {pc.name: pc
+                                                   for pc in self.classes}
+        self.default_class = self.classes[0].name
+        #: called with (total expired, {class: count}) — deadline-expired
+        #: requests (under the batcher lock — keep it leaf-locked and
+        #: cheap, e.g. metric counters)
         self.on_expired = on_expired
+        #: called with (class name, count) per overload shed — same
+        #: under-the-lock contract as on_expired
+        self.on_shed = on_shed
         self._cond = locks_lib.RankedCondition("serve.batcher")
-        self._queues: Dict[Hashable, deque] = {}  # guarded-by: self._cond
-        # live keys in first-seen order / ring index of the next probe
-        self._order: List[Hashable] = []   # guarded-by: self._cond
-        self._rr = 0                       # guarded-by: self._cond
+        # per-class: key -> deque of requests
+        self._queues: Dict[str, Dict[Hashable, deque]] = {
+            pc.name: {} for pc in self.classes}  # guarded-by: self._cond
+        # per-class: live keys in first-seen order / next-probe ring idx
+        self._order: Dict[str, List[Hashable]] = {
+            pc.name: [] for pc in self.classes}  # guarded-by: self._cond
+        self._rr: Dict[str, int] = {pc.name: 0
+                                    for pc in self.classes}  # guarded-by: self._cond
+        self._class_depth: Dict[str, int] = {
+            pc.name: 0 for pc in self.classes}   # guarded-by: self._cond
         self._depth = 0                    # guarded-by: self._cond
         self._closed = False               # guarded-by: self._cond
 
     # -- producer side ------------------------------------------------------
+
+    def _shed_lower_locked(self, cls: str) -> bool:
+        """The overload shed order: evict the NEWEST queued request of
+        the lowest-priority non-empty class strictly below `cls`, so
+        the incoming higher-class request can take its slot
+        ("interactive admits while bulk sheds"). Newest-loses within
+        the victim class: it has waited least, so shedding it wastes
+        the least queue time. Returns False when no lower-class work is
+        queued (the caller then sheds itself)."""
+        idx = next(i for i, pc in enumerate(self.classes)
+                   if pc.name == cls)
+        for pc in reversed(self.classes[idx + 1:]):
+            queues = self._queues[pc.name]
+            if self._class_depth[pc.name] <= 0 or not queues:
+                continue
+            # newest request = the latest tail across the class's keys
+            # (FIFO append keeps each deque's tail its newest)
+            key = max(queues, key=lambda k: queues[k][-1].arrival)
+            victim = queues[key].pop()
+            if not queues[key]:
+                self._drop_key_locked(pc.name, key)
+            self._class_depth[pc.name] -= 1
+            self._depth -= 1
+            depth_now = self._class_depth[pc.name]
+            victim.future.set_exception(ServiceOverloaded(
+                f"shed under overload: class {pc.name!r} request at key "
+                f"{key!r} (class depth now {depth_now}, total "
+                f"{self._depth}/{self.max_queue}) gave its slot to an "
+                f"incoming {cls!r} request",
+                priority=pc.name, depth=depth_now))
+            if self.on_shed is not None:
+                self.on_shed(pc.name, 1)
+            return True
+        return False
 
     def submit(self, request: Request) -> None:
         with self._cond:
             if self._closed:
                 raise ServiceDraining("service is draining; not accepting "
                                       "new requests")
-            if self._depth >= self.max_queue:
+            cls = request.priority
+            if cls is None:
+                cls = request.priority = self.default_class
+            pc = self._by_name.get(cls)
+            if pc is None:
+                raise ValueError(
+                    f"unknown priority class {cls!r} (configured: "
+                    f"{[c.name for c in self.classes]})")
+            if request.deadline is None and pc.default_deadline_ms is not None:
+                request.deadline = (time.monotonic()
+                                    + pc.default_deadline_ms / 1000.0)
+            cd = self._class_depth[cls]
+            if cd >= pc.max_queue:
                 raise ServiceOverloaded(
-                    f"request queue full ({self._depth}/{self.max_queue})")
-            q = self._queues.get(request.key)
+                    f"class {cls!r} queue full ({cd}/{pc.max_queue}) at "
+                    f"key {request.key!r} (total {self._depth}/"
+                    f"{self.max_queue}) — shed at the door",
+                    priority=cls, depth=cd)
+            if self._depth >= self.max_queue and \
+                    not self._shed_lower_locked(cls):
+                raise ServiceOverloaded(
+                    f"queue full (total {self._depth}/{self.max_queue}; "
+                    f"class {cls!r} at {cd}/{pc.max_queue}) with no "
+                    f"lower-priority victim to shed — {cls!r} request at "
+                    f"key {request.key!r} shed at the door",
+                    priority=cls, depth=self._depth)
+            q = self._queues[cls].get(request.key)
             if q is None:
-                q = self._queues[request.key] = deque()
-                self._order.append(request.key)
+                q = self._queues[cls][request.key] = deque()
+                self._order[cls].append(request.key)
             q.append(request)
+            self._class_depth[cls] += 1
             self._depth += 1
             self._cond.notify_all()
 
@@ -168,6 +371,11 @@ class MicroBatcher:
         with self._cond:
             return self._depth
 
+    def class_depths(self) -> Dict[str, int]:
+        """{class: queued count} snapshot (front-door observability)."""
+        with self._cond:
+            return dict(self._class_depth)
+
     @property
     def closed(self) -> bool:
         with self._cond:
@@ -175,63 +383,73 @@ class MicroBatcher:
 
     # -- consumer side ------------------------------------------------------
 
-    def _drop_key_locked(self, key: Hashable) -> None:
+    def _drop_key_locked(self, cls: str, key: Hashable) -> None:
         """Remove an emptied key's queue AND its ring slot, keeping the
-        round-robin probe pointed at the same successor key."""
-        del self._queues[key]
-        idx = self._order.index(key)
-        del self._order[idx]
-        if idx < self._rr:
-            self._rr -= 1
+        class's round-robin probe pointed at the same successor key."""
+        del self._queues[cls][key]
+        order = self._order[cls]
+        idx = order.index(key)
+        del order[idx]
+        if idx < self._rr[cls]:
+            self._rr[cls] -= 1
 
     def _expire_locked(self) -> None:
-        """Complete every already-dead queued request with DeadlineExceeded
-        (holding the lock; O(depth), fine at service queue scales)."""
+        """Complete every already-dead queued request with
+        DeadlineExceeded (holding the lock; O(depth), fine at service
+        queue scales)."""
         now = time.monotonic()
-        expired = 0
-        for key in list(self._queues):
-            q = self._queues[key]
-            if not any(r.deadline is not None and r.deadline <= now
-                       for r in q):
-                continue
-            alive = deque(r for r in q
-                          if r.deadline is None or r.deadline > now)
-            for r in q:
-                if r.deadline is not None and r.deadline <= now:
-                    self._depth -= 1
-                    expired += 1
-                    r.future.set_exception(DeadlineExceeded(
-                        f"deadline passed after "
-                        f"{(now - r.arrival) * 1e3:.1f}ms in queue"))
-            if alive:
-                self._queues[key] = alive
-            else:
-                self._drop_key_locked(key)
+        expired: Dict[str, int] = {}
+        for cls, queues in self._queues.items():
+            for key in list(queues):
+                q = queues[key]
+                if not any(r.deadline is not None and r.deadline <= now
+                           for r in q):
+                    continue
+                alive = deque(r for r in q
+                              if r.deadline is None or r.deadline > now)
+                for r in q:
+                    if r.deadline is not None and r.deadline <= now:
+                        self._depth -= 1
+                        self._class_depth[cls] -= 1
+                        expired[cls] = expired.get(cls, 0) + 1
+                        r.future.set_exception(DeadlineExceeded(
+                            f"class {cls!r} deadline passed after "
+                            f"{(now - r.arrival) * 1e3:.1f}ms in queue at "
+                            f"key {key!r}", priority=cls))
+                if alive:
+                    queues[key] = alive
+                else:
+                    self._drop_key_locked(cls, key)
         if expired and self.on_expired is not None:
-            self.on_expired(expired)
+            self.on_expired(sum(expired.values()), expired)
 
     def _next_key_locked(self, accept: Optional[AbstractSet[Hashable]] = None
-                         ) -> Optional[Hashable]:
-        """Weighted-fair pop order: round-robin over the live keys in
-        first-seen ring order, resuming after the last key served. Every
-        live key is at most len(ring) pops from service, so a hot bucket
-        with a continuously-refilling queue cannot starve the others
-        (oldest-head selection could: its head is always the oldest
-        while a backlog of its own requests keeps arriving behind it).
-        With `accept`, keys outside the set are skipped — they stay
-        queued for a consumer that does accept them."""
-        n = len(self._order)
-        if n == 0:
-            return None
-        start = self._rr % n
-        for i in range(n):
-            idx = (start + i) % n
-            key = self._order[idx]
-            if accept is not None and key not in accept:
+                         ) -> Optional[Tuple[str, Hashable]]:
+        """Class-then-bucket pop order: serve the highest-priority class
+        with eligible work, round-robin over ITS live keys in
+        first-seen ring order, resuming after the last key served.
+        Within a class every live key is at most len(ring) pops from
+        service, so a hot bucket with a continuously-refilling queue
+        cannot starve the others (oldest-head selection could: its head
+        is always the oldest while a backlog of its own requests keeps
+        arriving behind it). With `accept`, keys outside the set are
+        skipped — they stay queued for a consumer that does accept
+        them."""
+        for pc in self.classes:
+            cls = pc.name
+            order = self._order[cls]
+            n = len(order)
+            if n == 0:
                 continue
-            if self._queues.get(key):
-                self._rr = idx + 1
-                return key
+            start = self._rr[cls] % n
+            for i in range(n):
+                idx = (start + i) % n
+                key = order[idx]
+                if accept is not None and key not in accept:
+                    continue
+                if self._queues[cls].get(key):
+                    self._rr[cls] = idx + 1
+                    return cls, key
         return None
 
     def next_batch(self, timeout: Optional[float] = None,
@@ -247,8 +465,8 @@ class MicroBatcher:
         with self._cond:
             while True:
                 self._expire_locked()
-                key = self._next_key_locked(accept)
-                if key is None:
+                sel = self._next_key_locked(accept)
+                if sel is None:
                     if self._closed:
                         return None
                     if give_up is not None:
@@ -259,26 +477,28 @@ class MicroBatcher:
                     else:
                         self._cond.wait()
                     continue
+                cls, key = sel
                 # coalesce: wait for the head's key to fill, bounded by the
                 # HEAD's age so the first-in request caps the added latency
-                full_at = self._queues[key][0].arrival + self.max_wait
+                full_at = self._queues[cls][key][0].arrival + self.max_wait
                 while (not self._closed
-                       and key in self._queues
-                       and len(self._queues[key]) < self.max_batch):
+                       and key in self._queues[cls]
+                       and len(self._queues[cls][key]) < self.max_batch):
                     remaining = full_at - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
                     self._expire_locked()
-                q = self._queues.get(key)
+                q = self._queues[cls].get(key)
                 if not q:
                     continue   # everything expired or was rejected meanwhile
                 batch = []
                 while q and len(batch) < self.max_batch:
                     batch.append(q.popleft())
+                    self._class_depth[cls] -= 1
                     self._depth -= 1
                 if not q:
-                    self._drop_key_locked(key)
+                    self._drop_key_locked(cls, key)
                 return batch
 
     # -- drain --------------------------------------------------------------
@@ -292,14 +512,17 @@ class MicroBatcher:
                 return 0
             self._closed = True
             rejected = 0
-            for q in self._queues.values():
-                for r in q:
-                    rejected += 1
-                    r.future.set_exception(ServiceDraining(
-                        "service drained before this request was started"))
-            self._queues.clear()
-            self._order.clear()
-            self._rr = 0
+            for cls, queues in self._queues.items():
+                for q in queues.values():
+                    for r in q:
+                        rejected += 1
+                        r.future.set_exception(ServiceDraining(
+                            "service drained before this request was "
+                            "started"))
+                queues.clear()
+                self._order[cls].clear()
+                self._rr[cls] = 0
+                self._class_depth[cls] = 0
             self._depth = 0
             self._cond.notify_all()
             return rejected

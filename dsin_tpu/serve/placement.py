@@ -168,6 +168,84 @@ def plan_placement(buckets: Sequence[Bucket], num_devices: int,
         weights=dict(w))
 
 
+class RebalanceTrigger:
+    """Load-aware automatic rebalance decision (ISSUE 8 satellite:
+    before this, `rebalance_placement()` was operator-called only).
+
+    Pure windowed policy, no locks: the caller (the service supervisor
+    thread, single-threaded by construction) feeds it CUMULATIVE
+    per-bucket request counts each check; the trigger differences them
+    into a window, computes the skew
+
+        skew = (max bucket share in the window) / (uniform share)
+
+    and fires — returning the +1-smoothed window counts as the weights
+    to re-plan with — only when the skew has been >= `skew_threshold`
+    for `hysteresis_checks` CONSECUTIVE windows AND at least
+    `cooldown_s` has passed since the last fire. The two guards are the
+    anti-flap contract: a single hot burst (one window) cannot move the
+    ladder, and two triggers can never land closer than the cooldown —
+    each rebalance warms executables, so flapping would turn placement
+    churn into steady-state compiles.
+
+    Windows with fewer than `min_window_requests` total requests are
+    skipped entirely (skew over a handful of requests is noise) and
+    RESET the streak: quiet traffic is evidence against a persistent
+    hot spot, not for it.
+    """
+
+    def __init__(self, skew_threshold: float = 2.0,
+                 hysteresis_checks: int = 2, cooldown_s: float = 60.0,
+                 min_window_requests: int = 16):
+        if skew_threshold < 1.0:
+            raise PlacementError(
+                f"skew_threshold must be >= 1 (uniform traffic has skew "
+                f"1.0), got {skew_threshold}")
+        if hysteresis_checks < 1:
+            raise PlacementError(
+                f"hysteresis_checks must be >= 1, got {hysteresis_checks}")
+        if cooldown_s < 0 or min_window_requests < 1:
+            raise PlacementError(
+                f"bad trigger config: cooldown_s={cooldown_s}, "
+                f"min_window_requests={min_window_requests}")
+        self.skew_threshold = float(skew_threshold)
+        self.hysteresis_checks = int(hysteresis_checks)
+        self.cooldown_s = float(cooldown_s)
+        self.min_window_requests = int(min_window_requests)
+        self._last_counts: Dict[Bucket, int] = {}
+        self._streak = 0
+        self._last_fire: Optional[float] = None
+        #: most recent window's skew (1.0 = uniform; gauge fodder)
+        self.last_skew = 1.0
+
+    def observe(self, now: float, counts: Mapping[Bucket, int]
+                ) -> Optional[Dict[Bucket, float]]:
+        """One supervisor check. `counts` are cumulative per-bucket
+        request totals; returns the weight map to pass to
+        `rebalance_placement(weights=...)` when a rebalance should
+        happen NOW, else None."""
+        window = {tuple(b): max(0, int(c) - self._last_counts.get(
+            tuple(b), 0)) for b, c in counts.items()}
+        self._last_counts = {tuple(b): int(c) for b, c in counts.items()}
+        total = sum(window.values())
+        if not window or total < self.min_window_requests:
+            self._streak = 0
+            return None
+        self.last_skew = (max(window.values()) / total) * len(window)
+        if self.last_skew < self.skew_threshold:
+            self._streak = 0
+            return None
+        self._streak += 1
+        if self._streak < self.hysteresis_checks:
+            return None
+        if (self._last_fire is not None
+                and now - self._last_fire < self.cooldown_s):
+            return None
+        self._last_fire = now
+        self._streak = 0
+        return {b: 1.0 + c for b, c in window.items()}
+
+
 class DevicePlacement:
     """The live routing table plus the per-device sharding machinery.
 

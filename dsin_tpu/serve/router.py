@@ -1,0 +1,706 @@
+"""Multi-replica front door: admission control + shared-nothing scale-out.
+
+Aggregate serve throughput was capped at ONE Python interpreter: PRs 4-7
+pipelined the dataplane, sharded the bucket ladder across devices, and
+escaped the GIL on the entropy stage, but every request still funneled
+through one process and (until ISSUE 8) one FIFO-ish queue. This module
+is the layer "Evaluating the Practicality of Learned Image Compression"
+(PAPERS.md, arXiv 2207.14524) says decides deployment viability:
+
+* **AdmissionController** — the front-door gate. Tracks per-class
+  OUTSTANDING work (queued + in-flight, incremented at admit and
+  released by a `Future.add_done_callback` the moment the answer
+  lands) and sheds BEFORE anything is enqueued, pickled, or shipped to
+  a replica: a rejected request costs one counter read, never zombie
+  work. Sheds raise the same typed per-class `ServiceOverloaded` the
+  batcher uses; per-class `serve_admitted_<cls>` /
+  `serve_shed_admission_<cls>` counters export the decisions.
+
+* **FrontDoorRouter** — one lightweight router process (the caller's)
+  in front of N SHARED-NOTHING service replicas. Each replica is a full
+  `CompressionService` in its own spawn process (spawn, not fork: a
+  forked jax runtime is a deadlock lottery) that warms its OWN codec,
+  executables, and persistent compile cache; the picklable
+  `ServiceConfig` is the entire bootstrap, and each replica answers a
+  `coding/loader.py` `params_digest` at the ready handshake so the
+  router REFUSES a fleet whose replicas built different models (the
+  cross-replica bit-identity contract, pinned end to end by
+  tests/test_serve_router.py and serve_bench's frontdoor probe).
+  Routing is round-robin PER CLASS over the live replicas; per-replica
+  `/healthz` polling (each replica runs its own metrics endpoint)
+  feeds eviction after `evict_after` consecutive failures and
+  readmission on the next healthy poll. A replica that DIES with
+  requests in flight does not fail its callers: the reader thread
+  drains its in-flight map and re-dispatches each request once to a
+  live replica (encode/decode are pure, so the retry is safe), failing
+  typed `ServiceUnavailable` only when no replica remains. Every
+  future resolves exactly once.
+
+Topology (shared-nothing: no state crosses the dashed line except the
+pipe messages and the config):
+
+    caller ──> AdmissionController ──> FrontDoorRouter (per-class rr)
+                                         │ pipe        │ pipe
+                                   ┌─────┴─────┐ ┌─────┴─────┐
+                                   │ replica 0 │ │ replica 1 │  ...
+                                   │ service + │ │ service + │
+                                   │ /healthz  │ │ /healthz  │
+                                   └───────────┘ └───────────┘
+
+Locks (utils/locks.py ranks): `serve.frontdoor` (4) guards the replica
+state table and the per-class rr counters; `serve.replica` (6) guards
+each replica's in-flight map and serializes its pipe sends;
+`serve.admission` (14) guards the per-class outstanding counts — rank
+ABOVE the batcher (10) because the release callback may run under the
+batcher condition (a shed resolves the victim's future there).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import urllib.request
+from dataclasses import replace
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from dsin_tpu.serve import metrics as metrics_lib
+from dsin_tpu.serve.batcher import (DeadlineExceeded, Future,
+                                    ServiceOverloaded, ServiceUnavailable)
+from dsin_tpu.utils import locks as locks_lib
+
+
+def default_admission_limits(config) -> Dict[str, int]:
+    """ONE process's worth of admissible backlog per class: the class's
+    queue bound plus everything the executor pipelines can hold in
+    flight — max_batch * workers * pipeline_depth * devices (workers
+    are PER-DEVICE executor threads). Shared by the in-process service
+    gate and the front door (which scales it by replica count) so the
+    two derivations cannot drift."""
+    slack = (config.max_batch * max(1, config.workers)
+             * max(1, config.pipeline_depth)
+             * (1 if getattr(config, "devices", None) is None
+                else max(1, config.devices)))
+    classes = getattr(config, "priority_classes", None)
+    if classes:
+        return {pc.name: pc.max_queue + slack for pc in classes}
+    return {"default": config.max_queue + slack}
+
+
+class AdmissionController:
+    """Per-class outstanding-work caps, enforced at the door.
+
+    `limits` maps class name -> max outstanding (queued + in-flight)
+    requests. `admit(cls)` either takes a slot or raises a typed
+    per-class ServiceOverloaded — cheap rejection, nothing enqueued;
+    `attach(cls, future)` arranges the release on resolution (success,
+    shed, expiry, crash — any resolution frees the slot)."""
+
+    def __init__(self, limits: Mapping[str, int],
+                 metrics: Optional[metrics_lib.MetricsRegistry] = None):
+        if not limits:
+            raise ValueError("admission control needs at least one "
+                             "class limit")
+        bad = {c: n for c, n in limits.items() if int(n) < 1}
+        if bad:
+            raise ValueError(f"admission limits must be >= 1: {bad}")
+        self.limits: Dict[str, int] = {str(c): int(n)
+                                       for c, n in limits.items()}
+        self.metrics = (metrics if metrics is not None
+                        else metrics_lib.MetricsRegistry())
+        self._lock = locks_lib.RankedLock("serve.admission")
+        self._outstanding: Dict[str, int] = {
+            c: 0 for c in self.limits}     # guarded-by: self._lock
+
+    def admit(self, cls: str) -> None:
+        limit = self.limits.get(cls)
+        if limit is None:
+            raise ValueError(f"unknown priority class {cls!r} "
+                             f"(admission classes: {sorted(self.limits)})")
+        with self._lock:
+            n = self._outstanding[cls]
+            shed = n >= limit
+            if not shed:
+                self._outstanding[cls] = n + 1
+        if shed:
+            self.metrics.counter(f"serve_shed_admission_{cls}").inc()
+            raise ServiceOverloaded(
+                f"admission control: class {cls!r} at capacity "
+                f"({n}/{limit} outstanding) — shed before enqueue",
+                priority=cls, depth=n)
+        self.metrics.counter(f"serve_admitted_{cls}").inc()
+
+    def release(self, cls: str) -> None:
+        with self._lock:
+            self._outstanding[cls] = max(0, self._outstanding[cls] - 1)
+
+    def attach(self, cls: str, future: Future) -> None:
+        """Release the class slot the moment `future` resolves (runs on
+        the resolving thread; the admission rung ranks above the
+        batcher's so the callback is legal under it)."""
+        future.add_done_callback(lambda _f: self.release(cls))
+
+    def outstanding(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._outstanding)
+
+
+# -- replica child ------------------------------------------------------------
+
+def _picklable_exc(exc: BaseException) -> BaseException:
+    """Exceptions cross the pipe; one that cannot pickle (exotic ctor)
+    degrades to a RuntimeError carrying its repr rather than killing
+    the sender."""
+    import pickle
+    try:
+        pickle.loads(pickle.dumps(exc))
+        return exc
+    except Exception:
+        return RuntimeError(f"{type(exc).__name__}: {exc}")
+
+
+def _replica_main(conn, config, replica_id: int) -> None:
+    """Spawn target: one full shared-nothing service replica.
+
+    Builds + warms its own CompressionService from the picklable
+    ServiceConfig (own codec, own executables, own persistent-compile-
+    cache warm — `CompilationSentinel(budget=0)` holds per replica
+    because warmup is the same per-process warmup every service runs),
+    starts its own /healthz endpoint (metrics_port=0 -> ephemeral), and
+    answers the ready handshake with its pid, healthz port, and params
+    digest. Then: one reader loop (submit requests, answer via future
+    callbacks through a single sender thread so pipe writes never
+    interleave and never run under a ranked lock) until "stop" or
+    router death (EOF), then a graceful drain."""
+    from dsin_tpu.coding import loader as loader_lib
+    from dsin_tpu.serve.service import CompressionService
+    try:
+        cfg = replace(config, metrics_port=0)
+        service = CompressionService(cfg).start()
+        warm = service.warmup()
+        info = {"replica": replica_id, "pid": os.getpid(),
+                "healthz_port": service._metrics_server.port,
+                "warmup_compiles": warm["compiles"],
+                "warmup_cache_hits": warm["cache_hits"],
+                "params_digest": loader_lib.params_digest(
+                    (service.state.params, service.state.batch_stats))}
+    except BaseException as e:  # noqa: BLE001 — the router needs the cause
+        try:
+            conn.send(("failed", replica_id, _picklable_exc(e)))
+        finally:
+            conn.close()
+        return
+    outq: "queue.Queue" = queue.Queue()
+
+    def _sender():
+        while True:
+            item = outq.get()
+            if item is None:
+                return
+            try:
+                conn.send(item)
+            except (OSError, ValueError, BrokenPipeError):
+                return     # router gone; the reader will see EOF too
+
+    sender = threading.Thread(target=_sender, daemon=True,
+                              name=f"replica-{replica_id}-send")
+    sender.start()
+    outq.put(("ready", replica_id, info))
+
+    def _complete(rid, fut):
+        exc = fut.exception(timeout=0)
+        if exc is None:
+            outq.put(("ok", rid, fut.result(timeout=0)))
+        else:
+            outq.put(("err", rid, _picklable_exc(exc)))
+
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break              # router died: drain and exit
+            if msg[0] == "stop":
+                break
+            op, rid, payload, priority, deadline_ms = msg
+            try:
+                if op == "encode":
+                    fut = service.submit_encode(
+                        payload, deadline_ms=deadline_ms, priority=priority)
+                elif op == "decode":
+                    fut = service.submit_decode(
+                        payload, deadline_ms=deadline_ms, priority=priority)
+                else:
+                    raise ValueError(f"unknown replica op {op!r}")
+            except BaseException as e:  # noqa: BLE001 — typed door rejects
+                outq.put(("err", rid, _picklable_exc(e)))
+                continue
+            fut.add_done_callback(
+                lambda f, rid=rid: _complete(rid, f))
+    finally:
+        service.drain()
+        # "bye" goes through the sender queue like every other message:
+        # a main-thread conn.send here could interleave with an
+        # in-progress sender write and corrupt the stream
+        outq.put(("bye", replica_id, None))
+        outq.put(None)
+        sender.join(timeout=10)
+        if not sender.is_alive():
+            conn.close()
+        # a wedged sender keeps the fd — closing under its write would
+        # be the same interleaving; process exit reclaims it
+
+
+def _spawn_launcher(config, idx: int, ctx):
+    """Default replica launcher: a real spawn process + duplex pipe.
+    Tests substitute a launcher whose far end is driven in-process."""
+    parent, child = ctx.Pipe(duplex=True)
+    proc = ctx.Process(target=_replica_main, args=(child, config, idx),
+                       name=f"serve-replica-{idx}", daemon=True)
+    proc.start()
+    child.close()
+    return proc, parent
+
+
+# -- router (parent) ----------------------------------------------------------
+
+class _Pending:
+    """One routed request: everything needed to re-dispatch it if its
+    replica dies mid-flight (encode/decode are pure — a retry is
+    safe), plus the caller's future. Exactly-once resolution is owned
+    by whoever pops it from an in-flight map. The deadline is pinned
+    ABSOLUTE at intake (`expires_at`) so a reroute forwards only the
+    REMAINING budget instead of restarting the clock."""
+
+    __slots__ = ("op", "payload", "priority", "expires_at", "future",
+                 "retries")
+
+    def __init__(self, op, payload, priority, deadline_ms, retries):
+        self.op = op
+        self.payload = payload
+        self.priority = priority
+        self.expires_at = (None if deadline_ms is None
+                           else time.monotonic() + deadline_ms / 1000.0)
+        self.future = Future()
+        self.retries = retries
+
+    def remaining_ms(self) -> Optional[float]:
+        """Budget left right now; None = no deadline, <= 0 = expired."""
+        if self.expires_at is None:
+            return None
+        return (self.expires_at - time.monotonic()) * 1000.0
+
+
+class _Replica:
+    """Parent-side replica handle: process, pipe, and the in-flight map
+    (rid -> _Pending) under the per-replica `serve.replica` lock, which
+    also serializes pipe sends (interleaved Connection writes corrupt
+    the stream)."""
+
+    __slots__ = ("idx", "proc", "conn", "info", "lock", "inflight",
+                 "reader")
+
+    def __init__(self, idx: int, proc, conn):
+        self.idx = idx
+        self.proc = proc
+        self.conn = conn
+        self.info: Optional[dict] = None
+        self.lock = locks_lib.RankedLock("serve.replica")
+        self.inflight: Dict[int, _Pending] = {}   # guarded-by: self.lock
+        self.reader: Optional[threading.Thread] = None
+
+
+class FrontDoorRouter:
+    """N shared-nothing service replicas behind one in-process front
+    door: admission gate -> per-class round-robin -> replica pipe.
+
+    Lifecycle: start() (spawns + waits for every ready handshake,
+    refuses digest mismatches) -> submit_encode/submit_decode/encode/
+    decode -> drain(). `launcher(config, idx, ctx) -> (proc|None, conn)`
+    is injectable for tests (fake replicas driven in-process)."""
+
+    def __init__(self, config, replicas: int = 2,
+                 admission_limits: Optional[Mapping[str, int]] = None,
+                 poll_every_s: float = 0.25, evict_after: int = 2,
+                 death_retries: int = 1, health_timeout_s: float = 2.0,
+                 start_timeout_s: float = 600.0, launcher=None):
+        if replicas < 1:
+            raise ValueError(f"need at least one replica, got {replicas}")
+        if evict_after < 1:
+            raise ValueError(f"evict_after must be >= 1, got {evict_after}")
+        self.config = config
+        self.num_replicas = int(replicas)
+        self.poll_every_s = float(poll_every_s)
+        self.evict_after = int(evict_after)
+        self.death_retries = int(death_retries)
+        self.health_timeout_s = float(health_timeout_s)
+        self.start_timeout_s = float(start_timeout_s)
+        self.metrics = metrics_lib.MetricsRegistry()
+        classes = getattr(config, "priority_classes", None)
+        self._class_names: List[str] = (
+            [pc.name for pc in classes] if classes else ["default"])
+        # class default deadlines resolve HERE, at the front door, so a
+        # reroute off a dead replica spends the remaining budget rather
+        # than letting the replacement replica restart the default clock
+        self._default_deadline_ms: Dict[str, Optional[float]] = (
+            {pc.name: pc.default_deadline_ms for pc in classes}
+            if classes else {})
+        if admission_limits is None:
+            # default: every replica can hold a full class queue plus
+            # its pipelines in flight (shared derivation with the
+            # service's own gate) — the cap is on the AGGREGATE backlog
+            admission_limits = {
+                c: self.num_replicas * per_replica
+                for c, per_replica in
+                default_admission_limits(config).items()}
+        self.admission = AdmissionController(admission_limits,
+                                             metrics=self.metrics)
+        self._launcher = launcher or _spawn_launcher
+        self._lock = locks_lib.RankedLock("serve.frontdoor")
+        self._replicas: List[_Replica] = []   # fixed after start()
+        self._state: Dict[int, str] = {}   # guarded-by: self._lock
+        self._fails: Dict[int, int] = {}   # guarded-by: self._lock
+        self._rr: Dict[str, int] = {}      # guarded-by: self._lock
+        self._rid = 0                      # guarded-by: self._lock
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+        self._started = False
+        self.params_digest: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FrontDoorRouter":
+        if self._started:
+            return self
+        import multiprocessing
+        ctx = multiprocessing.get_context("spawn")
+        for i in range(self.num_replicas):
+            proc, conn = self._launcher(self.config, i, ctx)
+            self._replicas.append(_Replica(i, proc, conn))
+        deadline = time.monotonic() + self.start_timeout_s
+        digests = []
+        try:
+            for rep in self._replicas:
+                rep.info = self._wait_ready(rep, deadline)
+                digests.append(rep.info.get("params_digest"))
+        except BaseException:
+            self._kill_all()
+            raise
+        if len(set(digests)) > 1:
+            self._kill_all()
+            raise RuntimeError(
+                f"replicas built DIFFERENT models (params digests "
+                f"{digests}) — refusing a fleet whose members would "
+                f"answer the same request with different bytes")
+        self.params_digest = digests[0]
+        with self._lock:
+            for rep in self._replicas:
+                self._state[rep.idx] = "live"
+                self._fails[rep.idx] = 0
+        for rep in self._replicas:
+            rep.reader = threading.Thread(
+                target=self._reader, args=(rep,),
+                name=f"router-reader-{rep.idx}", daemon=True)
+            rep.reader.start()
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        name="router-health", daemon=True)
+        self._poller.start()
+        self.metrics.gauge("serve_router_replicas").set(self.num_replicas)
+        self._started = True
+        return self
+
+    def _wait_ready(self, rep: _Replica, deadline: float) -> dict:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"replica {rep.idx} not ready within "
+                    f"{self.start_timeout_s}s")
+            try:
+                if rep.conn.poll(min(remaining, 0.5)):
+                    tag, _idx, payload = rep.conn.recv()
+                    if tag == "ready":
+                        return payload
+                    if tag == "failed":
+                        raise RuntimeError(
+                            f"replica {rep.idx} failed to start"
+                            ) from payload
+                    continue
+            except EOFError:
+                raise RuntimeError(
+                    f"replica {rep.idx} died during startup") from None
+            if rep.proc is not None and not rep.proc.is_alive():
+                raise RuntimeError(
+                    f"replica {rep.idx} exited (code "
+                    f"{rep.proc.exitcode}) during startup")
+
+    def _kill_all(self) -> None:
+        for rep in self._replicas:
+            if rep.proc is not None and rep.proc.is_alive():
+                rep.proc.terminate()
+            try:
+                rep.conn.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "FrontDoorRouter":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.drain()
+
+    # -- intake -------------------------------------------------------------
+
+    # NOTE: parameter order mirrors CompressionService.submit_* /
+    # encode/decode exactly — the router is a drop-in front door, so
+    # positional calls written against one must mean the same thing
+    # against the other.
+
+    def submit_encode(self, img, deadline_ms: Optional[float] = None,
+                      priority: Optional[str] = None) -> Future:
+        return self._submit("encode", img, priority, deadline_ms)
+
+    def submit_decode(self, blob: bytes,
+                      deadline_ms: Optional[float] = None,
+                      priority: Optional[str] = None) -> Future:
+        return self._submit("decode", blob, priority, deadline_ms)
+
+    def encode(self, img, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = 120.0,
+               priority: Optional[str] = None):
+        return self.submit_encode(img, deadline_ms,
+                                  priority=priority).result(timeout)
+
+    def decode(self, blob: bytes, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = 120.0,
+               priority: Optional[str] = None):
+        return self.submit_decode(blob, deadline_ms,
+                                  priority=priority).result(timeout)
+
+    def _submit(self, op: str, payload, priority: Optional[str],
+                deadline_ms: Optional[float]) -> Future:
+        assert self._started, "start() the router before submitting"
+        cls = priority or self._class_names[0]
+        self.admission.admit(cls)   # sheds HERE, before any enqueue
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms.get(cls)
+        pending = _Pending(op, payload, cls, deadline_ms,
+                           self.death_retries)
+        self.admission.attach(cls, pending.future)
+        try:
+            self._dispatch(pending)
+        except ServiceUnavailable as e:
+            # resolve the (admission-attached) future so the slot frees,
+            # then still raise at the door like the single-process path
+            pending.future.set_exception(e)
+            raise
+        self.metrics.counter(f"serve_router_routed_{cls}").inc()
+        return pending.future
+
+    # -- routing ------------------------------------------------------------
+
+    def _next_rid_locked(self) -> int:
+        self._rid += 1
+        return self._rid
+
+    def _pick(self, cls: str) -> Optional[Tuple[_Replica, int]]:
+        with self._lock:
+            live = [rep for rep in self._replicas
+                    if self._state[rep.idx] == "live"]
+            if not live:
+                return None
+            i = self._rr.get(cls, 0)
+            self._rr[cls] = i + 1
+            return live[i % len(live)], self._next_rid_locked()
+
+    def _dispatch(self, pending: _Pending) -> None:
+        """Route to the class's next live replica; a send that discovers
+        a dead pipe marks the replica and moves on. Raises typed
+        ServiceUnavailable when no live replica accepts the send."""
+        for _ in range(self.num_replicas):
+            picked = self._pick(pending.priority)
+            if picked is None:
+                break
+            rep, rid = picked
+            sent = False
+            with rep.lock:
+                rep.inflight[rid] = pending
+                try:
+                    # forward the REMAINING budget: on a reroute the
+                    # replacement replica must not restart the clock
+                    rep.conn.send((pending.op, rid, pending.payload,
+                                   pending.priority,
+                                   pending.remaining_ms()))
+                    sent = True
+                except (OSError, ValueError, BrokenPipeError):
+                    del rep.inflight[rid]
+            if sent:
+                self.metrics.counter(
+                    f"serve_router_routed_r{rep.idx}").inc()
+                return
+            self._on_disconnect(rep)
+        raise ServiceUnavailable(
+            f"no live replica for class {pending.priority!r} "
+            f"({self.num_replicas} configured) — retry shortly")
+
+    def _reader(self, rep: _Replica) -> None:
+        """Per-replica response pump. EOF (or 'bye') means the replica
+        is gone — its in-flight work reroutes."""
+        while True:
+            try:
+                msg = rep.conn.recv()
+            except (EOFError, OSError):
+                break
+            tag = msg[0]
+            if tag == "bye":
+                break
+            if tag not in ("ok", "err"):
+                continue
+            _tag, rid, payload = msg
+            with rep.lock:
+                pending = rep.inflight.pop(rid, None)
+            if pending is None:
+                continue   # already rerouted by a death race: drop, the
+                #            live dispatch owns the future now
+            if tag == "ok":
+                pending.future.set_result(payload)
+            else:
+                if isinstance(payload, DeadlineExceeded):
+                    self.metrics.counter(
+                        f"serve_router_expired_{pending.priority}").inc()
+                pending.future.set_exception(payload)
+        self._on_disconnect(rep)
+
+    def _on_disconnect(self, rep: _Replica) -> None:
+        """First observer of a dead replica marks it and reroutes its
+        in-flight requests (idempotent: later observers find the state
+        already 'dead' and an empty map). Futures resolve exactly once:
+        ownership transfers by popping from the in-flight map."""
+        with self._lock:
+            already = self._state.get(rep.idx) == "dead"
+            self._state[rep.idx] = "dead"
+        if already:
+            return
+        draining = self._stop.is_set()
+        if not draining:
+            self.metrics.counter("serve_router_replica_deaths").inc()
+        with rep.lock:
+            orphans = list(rep.inflight.items())
+            rep.inflight.clear()
+        for _rid, pending in orphans:
+            if pending.future.done():
+                continue
+            rem = pending.remaining_ms()
+            if rem is not None and rem <= 0.0:
+                # budget spent while the dead replica held it: expire
+                # typed instead of rerouting zombie work
+                self.metrics.counter(
+                    f"serve_router_expired_{pending.priority}").inc()
+                pending.future.set_exception(DeadlineExceeded(
+                    f"replica {rep.idx} died holding this request and "
+                    f"its deadline has already passed (class "
+                    f"{pending.priority!r})", priority=pending.priority))
+                continue
+            if pending.retries > 0 and not draining:
+                pending.retries -= 1
+                self.metrics.counter("serve_router_reroutes").inc()
+                try:
+                    self._dispatch(pending)
+                    continue
+                except ServiceUnavailable as e:
+                    pending.future.set_exception(e)
+                    continue
+            pending.future.set_exception(ServiceUnavailable(
+                f"replica {rep.idx} went away with this request in "
+                f"flight" + ("" if draining else " (no retry left)")))
+
+    # -- health -------------------------------------------------------------
+
+    def _healthz_ok(self, rep: _Replica) -> bool:
+        """One /healthz poll. Replicas without a port (test fakes)
+        count as healthy while their transport lives."""
+        port = (rep.info or {}).get("healthz_port")
+        if port is None:
+            return rep.proc is None or rep.proc.is_alive()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=self.health_timeout_s) as resp:
+                return resp.status == 200
+        except Exception:   # noqa: BLE001 — any poll failure is a failure
+            return False
+
+    def _poll_loop(self) -> None:
+        """Eviction/readmission: `evict_after` consecutive failed polls
+        stop NEW traffic to a replica (in-flight work, if it is merely
+        slow, still completes); one healthy poll readmits it. 'dead'
+        (transport gone) is terminal — there is nobody to talk to."""
+        while not self._stop.wait(self.poll_every_s):
+            for rep in self._replicas:
+                with self._lock:
+                    state = self._state.get(rep.idx)
+                if state == "dead":
+                    continue
+                ok = self._healthz_ok(rep)   # no locks across the poll
+                with self._lock:
+                    if self._state.get(rep.idx) == "dead":
+                        continue
+                    if ok:
+                        self._fails[rep.idx] = 0
+                        if self._state[rep.idx] == "evicted":
+                            self._state[rep.idx] = "live"
+                            self.metrics.counter(
+                                "serve_router_readmissions").inc()
+                    else:
+                        self._fails[rep.idx] += 1
+                        if (self._fails[rep.idx] >= self.evict_after
+                                and self._state[rep.idx] == "live"):
+                            self._state[rep.idx] = "evicted"
+                            self.metrics.counter(
+                                "serve_router_evictions").inc()
+
+    def health(self) -> dict:
+        with self._lock:
+            states = {str(rep.idx): self._state.get(rep.idx, "unknown")
+                      for rep in self._replicas}
+        live = sum(1 for s in states.values() if s == "live")
+        status = ("ok" if live == len(states)
+                  else "degraded" if live else "unhealthy")
+        return {"status": status, "live": live, "replicas": states,
+                "outstanding": self.admission.outstanding()}
+
+    # -- shutdown -----------------------------------------------------------
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Graceful: stop polling, ask every replica to drain (their
+        queued work resolves typed there and the answers flow back),
+        join, then fail anything still unresolved — no hung futures."""
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=timeout_s)
+        for rep in self._replicas:
+            with rep.lock:
+                try:
+                    rep.conn.send(("stop", None, None, None, None))
+                except (OSError, ValueError, BrokenPipeError):
+                    pass
+        for rep in self._replicas:
+            if rep.reader is not None:
+                rep.reader.join(timeout=timeout_s)
+        for rep in self._replicas:
+            if rep.proc is not None:
+                rep.proc.join(timeout=timeout_s)
+                if rep.proc.is_alive():
+                    rep.proc.terminate()
+            try:
+                rep.conn.close()
+            except OSError:
+                pass
+            with rep.lock:
+                leftovers = list(rep.inflight.values())
+                rep.inflight.clear()
+            for pending in leftovers:
+                if not pending.future.done():
+                    pending.future.set_exception(ServiceUnavailable(
+                        "front door drained with this request in flight"))

@@ -134,8 +134,10 @@ import numpy as np
 from dsin_tpu.serve import buckets as buckets_lib
 from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve import placement as placement_lib
-from dsin_tpu.serve.batcher import (Future, MicroBatcher, Request,
-                                    ServiceDraining, ServiceUnavailable)
+from dsin_tpu.serve import router as router_lib
+from dsin_tpu.serve.batcher import (Future, MicroBatcher, PriorityClass,
+                                    Request, ServiceDraining,
+                                    ServiceUnavailable)
 from dsin_tpu.utils import faults, recompile
 from dsin_tpu.utils import locks as locks_lib
 from dsin_tpu.utils.integrity import IntegrityError, frame_crc, verify_crc
@@ -202,6 +204,29 @@ class ServiceConfig:
     #: entropy pending) before finishing the oldest; >= 2 overlaps
     #: batch N's entropy with batch N+1's device stage
     pipeline_depth: int = 2
+    #: traffic classes (ISSUE 8), most-latency-sensitive first — e.g.
+    #: batcher.default_priority_classes(max_queue): per-class bounded
+    #: queues, default deadlines, and the bulk-sheds-first overload
+    #: order, plus an AdmissionController front-door gate
+    #: (serve/router.py) with per-class admit/shed counters. None =
+    #: the single-class pre-priority behavior.
+    priority_classes: Optional[Sequence[PriorityClass]] = None
+    #: per-class outstanding (queued + in-flight) caps for the admission
+    #: gate; None = derived: class queue bound + the worker pipelines'
+    #: in-flight capacity. Only read when priority_classes is set.
+    admission_limits: Optional[Mapping[str, int]] = None
+    #: load-aware automatic rebalance (ISSUE 8 satellite): how often the
+    #: supervisor inspects per-bucket traffic skew; None = off (the
+    #: operator calls rebalance_placement() manually, the pre-ISSUE-8
+    #: behavior). A rebalance warms NEW census pairs, so auto mode
+    #: trades occasional compiles for placement that tracks traffic.
+    rebalance_check_every_s: Optional[float] = None
+    #: trigger when max bucket share >= threshold * the uniform share,
+    #: for `rebalance_hysteresis_checks` CONSECUTIVE windows, and not
+    #: within `rebalance_cooldown_s` of the last fire (no flapping)
+    rebalance_skew_threshold: float = 2.0
+    rebalance_hysteresis_checks: int = 2
+    rebalance_cooldown_s: float = 60.0
     #: persistent XLA compilation cache (utils/cache.py) at start(), so
     #: a restarted service re-warms from disk instead of recompiling
     persistent_cache: bool = True
@@ -359,10 +384,20 @@ class CompressionService:
         self.metrics = metrics_lib.MetricsRegistry()
         self._batcher = MicroBatcher(
             config.max_batch, config.max_wait_ms, config.max_queue,
-            on_expired=lambda n: self.metrics.counter(
-                "serve_rejected_deadline").inc(n))
+            classes=config.priority_classes,
+            on_expired=self._note_expired, on_shed=self._note_shed)
+        self._priority_enabled = config.priority_classes is not None
+        self._admission: Optional[router_lib.AdmissionController] = None
+        if self._priority_enabled:
+            limits = config.admission_limits
+            if limits is None:
+                limits = router_lib.default_admission_limits(config)
+            self._admission = router_lib.AdmissionController(
+                limits, metrics=self.metrics)
         self._workers = []                 # guarded-by: self._workers_lock
         self._workers_lock = locks_lib.RankedLock("serve.workers")
+        self._rebalance_lock = locks_lib.RankedLock("serve.rebalance")
+        self._rebalancing = False          # guarded-by: self._rebalance_lock
         # slot -> last fatal exit / consecutive restarts / restart time
         self._worker_exits = {}            # guarded-by: self._workers_lock
         self._restarts = []                # guarded-by: self._workers_lock
@@ -419,6 +454,20 @@ class CompressionService:
         if self.config.entropy_proc_timeout_s <= 0:
             raise ValueError(f"entropy_proc_timeout_s must be > 0, got "
                              f"{self.config.entropy_proc_timeout_s}")
+        # load-aware auto-rebalance (ISSUE 8 satellite) knobs, validated
+        # up front with the rest: a bad value must not leave spawned
+        # worker threads behind when start() raises
+        self._rebalance_trigger = None
+        self._next_rebalance_check = None
+        if self.config.rebalance_check_every_s is not None:
+            if self.config.rebalance_check_every_s <= 0:
+                raise ValueError(
+                    f"rebalance_check_every_s must be > 0, got "
+                    f"{self.config.rebalance_check_every_s}")
+            self._rebalance_trigger = placement_lib.RebalanceTrigger(
+                skew_threshold=self.config.rebalance_skew_threshold,
+                hysteresis_checks=self.config.rebalance_hysteresis_checks,
+                cooldown_s=self.config.rebalance_cooldown_s)
         from dsin_tpu.coding.loader import load_model_state, make_codec
         # init at the largest bucket; params are shape-independent (the
         # modules are fully convolutional) so every bucket shares them
@@ -479,6 +528,12 @@ class CompressionService:
         self.metrics.gauge("serve_workers_live").set(self._total_workers)
         self.metrics.gauge("serve_devices").set(self._num_devices)
         self._publish_placement()
+        # arm the auto-rebalance clock (trigger built + validated at
+        # start() top): the supervisor ticks the skew trigger; manual
+        # rebalance_placement() stays
+        if self._rebalance_trigger is not None:
+            self._next_rebalance_check = (
+                time.monotonic() + self.config.rebalance_check_every_s)
         self._supervisor = threading.Thread(target=self._supervise_loop,
                                             name="serve-supervisor",
                                             daemon=True)
@@ -585,6 +640,25 @@ class CompressionService:
         their next batch pop; in-flight batches finish on their old
         (still-warmed) device."""
         assert self._started, "start() + warmup() before rebalance"
+        # one rebalancer at a time: the supervisor auto-tick and the
+        # operator hook would otherwise race the warm-then-swap
+        # sequence (duplicate warms, stale plan landing last). The
+        # ranked lock guards only the claim flag — the warms are long
+        # compiles and must not run under any lock.
+        with self._rebalance_lock:
+            if self._rebalancing:
+                return {"changed": False, "warmed_pairs": 0,
+                        "skipped": "rebalance already in progress"}
+            self._rebalancing = True
+        try:
+            return self._rebalance_locked_out(weights)
+        finally:
+            with self._rebalance_lock:
+                self._rebalancing = False
+
+    def _rebalance_locked_out(self, weights) -> dict:
+        """Body of rebalance_placement; callers hold the claim flag
+        (NOT the lock — compiles happen here)."""
         if weights is None:
             weights = {
                 (bh, bw): 1.0 + self.metrics.counter(
@@ -703,6 +777,18 @@ class CompressionService:
         return (None if deadline_ms is None
                 else time.monotonic() + deadline_ms / 1000.0)
 
+    def _note_expired(self, n: int, by_class) -> None:
+        """Batcher on_expired hook (runs under the batcher lock —
+        metrics leaves only): total + per-class deadline counters."""
+        self.metrics.counter("serve_rejected_deadline").inc(n)
+        for cls, k in by_class.items():
+            self.metrics.counter(f"serve_expired_{cls}").inc(k)
+
+    def _note_shed(self, cls: str, n: int) -> None:
+        """Batcher on_shed hook: per-class overload-victim counter (the
+        bulk-sheds-first evidence serve_bench's frontdoor gate reads)."""
+        self.metrics.counter(f"serve_shed_{cls}").inc(n)
+
     def _submit(self, request: Request) -> Future:
         # the drain flag flips before the queue actually closes (the
         # close runs on the serve-drain thread) — refuse here too so no
@@ -718,14 +804,34 @@ class CompressionService:
             self.metrics.counter("serve_rejected_unavailable").inc()
             raise ServiceUnavailable(
                 "no live workers (pool is restarting); retry shortly")
+        cls = None
+        if self._admission is not None:
+            # front-door gate BEFORE enqueue (serve/router.py): a shed
+            # here costs one counter read — nothing was queued, padded,
+            # or pickled (no zombie work)
+            cls = request.priority or self._batcher.default_class
+            request.priority = cls
+            try:
+                self._admission.admit(cls)
+            except Exception:
+                self.metrics.counter("serve_rejected_overload").inc()
+                raise
         try:
             self._batcher.submit(request)
         except ServiceDraining:
+            if cls is not None:
+                self._admission.release(cls)
             self.metrics.counter("serve_rejected_drain").inc()
             raise
         except Exception:
+            if cls is not None:
+                self._admission.release(cls)
             self.metrics.counter("serve_rejected_overload").inc()
             raise
+        if cls is not None:
+            # attach AFTER a successful enqueue: resolution (result,
+            # shed-as-victim, expiry, drain, crash) frees the slot
+            self._admission.attach(cls, request.future)
         # counted only once ACCEPTED: submitted - completed must bound
         # the queued+in-flight backlog, so rejections stay out of it
         self.metrics.counter("serve_submitted").inc()
@@ -733,9 +839,13 @@ class CompressionService:
         return request.future
 
     def submit_encode(self, img: np.ndarray,
-                      deadline_ms: Optional[float] = None) -> Future:
+                      deadline_ms: Optional[float] = None,
+                      priority: Optional[str] = None) -> Future:
         """(h, w, 3) uint8/float image -> Future[EncodeResult]. Raises
-        ServiceOverloaded/ServiceDraining/NoBucketFits at the door."""
+        ServiceOverloaded/ServiceDraining/NoBucketFits at the door.
+        `priority` names a configured traffic class (None = the most
+        latency-sensitive one; the class's default deadline applies
+        when `deadline_ms` is None)."""
         img = np.asarray(img)
         if img.ndim != 3 or img.shape[-1] != 3:
             raise ValueError(f"expected (h, w, 3) image, got {img.shape}")
@@ -745,10 +855,11 @@ class CompressionService:
             img.astype(np.float32, copy=False), bucket)
         return self._submit(Request(
             key=(ENCODE, bucket), payload=(padded, (h, w)),
-            deadline=self._deadline(deadline_ms)))
+            deadline=self._deadline(deadline_ms), priority=priority))
 
     def submit_decode(self, blob: bytes,
-                      deadline_ms: Optional[float] = None) -> Future:
+                      deadline_ms: Optional[float] = None,
+                      priority: Optional[str] = None) -> Future:
         """Framed DSRV stream -> Future[(h, w, 3) uint8 image]. A v2
         frame failing its CRC raises IntegrityError here, at the door."""
         payload, shape, bucket = parse_stream(blob)
@@ -763,15 +874,19 @@ class CompressionService:
         return self._submit(Request(
             key=(DECODE, bucket), payload=(payload, shape,
                                            frame_crc(payload)),
-            deadline=self._deadline(deadline_ms)))
+            deadline=self._deadline(deadline_ms), priority=priority))
 
     def encode(self, img: np.ndarray, deadline_ms: Optional[float] = None,
-               timeout: Optional[float] = 60.0) -> EncodeResult:
-        return self.submit_encode(img, deadline_ms).result(timeout)
+               timeout: Optional[float] = 60.0,
+               priority: Optional[str] = None) -> EncodeResult:
+        return self.submit_encode(img, deadline_ms,
+                                  priority=priority).result(timeout)
 
     def decode(self, blob: bytes, deadline_ms: Optional[float] = None,
-               timeout: Optional[float] = 60.0) -> np.ndarray:
-        return self.submit_decode(blob, deadline_ms).result(timeout)
+               timeout: Optional[float] = 60.0,
+               priority: Optional[str] = None) -> np.ndarray:
+        return self.submit_decode(blob, deadline_ms,
+                                  priority=priority).result(timeout)
 
     # -- worker side --------------------------------------------------------
 
@@ -900,8 +1015,40 @@ class CompressionService:
                         self.metrics.counter("serve_worker_restarts").inc()
                         live += 1
             self.metrics.gauge("serve_workers_live").set(live)
+            if (self._rebalance_trigger is not None
+                    and now >= self._next_rebalance_check):
+                self._next_rebalance_check = (
+                    now + self.config.rebalance_check_every_s)
+                try:
+                    self._auto_rebalance_tick(now)
+                except Exception:  # noqa: BLE001 — a failed rebalance
+                    # (e.g. a compile error warming a new census pair)
+                    # must not unwind the supervisor: worker
+                    # self-healing outranks the opt-in rebalance
+                    self.metrics.counter(
+                        "serve_auto_rebalance_errors").inc()
             self._draining.wait(self.config.supervise_every_s)
         self.metrics.gauge("serve_workers_live").set(self.live_workers)
+
+    def _auto_rebalance_tick(self, now: float) -> None:
+        """One skew check on the supervisor thread (single-threaded use
+        of the trigger, its contract). Fires rebalance_placement() with
+        the window's observed weights; the warm-before-swap contract
+        there means an auto rebalance can compile (new census pairs)
+        INLINE here — worker crash-restart healing pauses for the
+        duration of the warm. Both costs are why auto mode is opt-in
+        (rebalance_check_every_s)."""
+        counts = {
+            (bh, bw): self.metrics.counter(
+                f"serve_bucket_requests_{bh}x{bw}").value
+            for bh, bw in self.policy.buckets}
+        weights = self._rebalance_trigger.observe(now, counts)
+        self.metrics.gauge("serve_traffic_skew").set(
+            self._rebalance_trigger.last_skew)
+        if weights is None or self._num_devices <= 1:
+            return
+        self.rebalance_placement(weights=weights)
+        self.metrics.counter("serve_auto_rebalances").inc()
 
     @property
     def _busy_ms(self) -> metrics_lib.Accumulator:
@@ -1252,9 +1399,14 @@ class CompressionService:
     def _observe_latency(self, req) -> None:
         """Record arrival -> future-RESOLUTION latency — called at the
         moment the request's future is set, so pipelined mode does not
-        bill the caller for pipeline dwell after their answer landed."""
-        self.metrics.histogram("serve_latency_ms").observe(
-            (time.monotonic() - req.arrival) * 1e3)
+        bill the caller for pipeline dwell after their answer landed.
+        With priority classes on, the per-class histogram carries the
+        per-class p99 the frontdoor bench gates."""
+        ms = (time.monotonic() - req.arrival) * 1e3
+        self.metrics.histogram("serve_latency_ms").observe(ms)
+        if self._priority_enabled and req.priority is not None:
+            self.metrics.histogram(
+                f"serve_latency_ms_{req.priority}").observe(ms)
 
     def _note_batch_done(self, batch, t0, device_ms, entropy_ms,
                          device: int, observe_latency: bool = False) -> None:

@@ -52,18 +52,32 @@ def enable_compilation_cache(tag: str | None = None) -> str:
     ``.cache/jax-<tag>[-<host fingerprint>]`` (default tag: the default
     backend name; the fingerprint joins for CPU-executed code, where
     XLA AOT-compiles to this host's machine features). Returns the
-    directory."""
+    directory. A ``DSIN_COMPILATION_CACHE_DIR`` env var overrides the
+    policy dir entirely (tests use it for stale-entry isolation)."""
     import jax
 
-    repo = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    tag = tag or jax.default_backend()
-    # Any cpu-tagged cache (including the dryrun's explicit "dryrun-cpu")
-    # holds host-feature-specific AOT results; TPU executables are
-    # compiled relay-side for the chip and are host-portable.
-    if "cpu" in tag:
-        tag = f"{tag}-{host_cpu_fingerprint()}"
-    cache_dir = os.path.join(repo, ".cache", f"jax-{tag}")
+    override = os.environ.get("DSIN_COMPILATION_CACHE_DIR")
+    if override:
+        # Explicit dir override (tests/conftest.py points this at a
+        # per-session tmpdir): cross-SESSION AOT entries stay out of
+        # the run — deserializing a stale CPU executable mid-suite has
+        # produced GC-time heap corruption (segfault in the training
+        # tests once serve tests had enabled the shared cache in
+        # process) — while cross-PROCESS warming within the run (serve
+        # replicas, restart tests) still shares one dir via the
+        # inherited environment.
+        cache_dir = override
+    else:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        tag = tag or jax.default_backend()
+        # Any cpu-tagged cache (including the dryrun's explicit
+        # "dryrun-cpu") holds host-feature-specific AOT results; TPU
+        # executables are compiled relay-side for the chip and are
+        # host-portable.
+        if "cpu" in tag:
+            tag = f"{tag}-{host_cpu_fingerprint()}"
+        cache_dir = os.path.join(repo, ".cache", f"jax-{tag}")
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -27,7 +27,11 @@ The repo lock hierarchy (rank ascending = acquire order outer->inner;
 a thread holding rank r may only acquire ranks > r):
 
     rank  name                where
+       4  serve.frontdoor     router replica table / per-class rr state (serve/router.py)
+       6  serve.replica       per-replica pipe send + in-flight map (serve/router.py)
       10  serve.batcher       MicroBatcher's condition (serve/batcher.py)
+      12  serve.future        Future done-callback slot (serve/batcher.py)
+      14  serve.admission     per-class outstanding counts (serve/router.py)
       15  serve.placement     bucket->device routing table (serve/placement.py)
       20  serve.workers       worker-pool bookkeeping (serve/service.py)
       25  serve.entropy_proc  process-pool slot / child-death rebuild (serve/service.py)
@@ -65,7 +69,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 #: the repo-wide lock hierarchy: name -> rank. See the module docstring
 #: for the rationale per rung.
 HIERARCHY: Dict[str, int] = {
+    "serve.frontdoor": 4,
+    "serve.replica": 6,
     "serve.batcher": 10,
+    "serve.future": 12,
+    "serve.rebalance": 13,
+    "serve.admission": 14,
     "serve.placement": 15,
     "serve.workers": 20,
     "serve.entropy_proc": 25,

@@ -17,6 +17,25 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Isolate this run from the repo's cross-session persistent compile
+# cache (.cache/jax-*): serve tests enable the cache process-globally,
+# and later training tests then DESERIALIZE stale AOT entries written
+# by previous sessions — which has segfaulted (GC-time heap corruption
+# in jaxlib) reproducibly. A per-session tmpdir keeps every read
+# same-session; spawned replica/worker children inherit the env, so
+# cross-process cache warming is still exercised.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+if "DSIN_COMPILATION_CACHE_DIR" not in os.environ:
+    _cache_dir = tempfile.mkdtemp(prefix="dsin-test-jax-cache-")
+    os.environ["DSIN_COMPILATION_CACHE_DIR"] = _cache_dir
+    # only the session that CREATED the dir removes it (spawned replica
+    # children re-import conftest-less entry points, but any pytest
+    # subprocess inheriting the env lands in this branch's else)
+    atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 

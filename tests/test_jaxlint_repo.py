@@ -57,6 +57,7 @@ def test_suppressions_stay_justified():
 THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
     ("dsin_tpu", "serve", "service.py"),
     ("dsin_tpu", "serve", "batcher.py"),
+    ("dsin_tpu", "serve", "router.py"),
     ("dsin_tpu", "serve", "placement.py"),
     ("dsin_tpu", "serve", "metrics.py"),
     ("dsin_tpu", "coding", "codec.py"),

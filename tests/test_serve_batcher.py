@@ -295,3 +295,306 @@ def test_deadline_expiry_wins_race_against_drain():
     # when the close landed — it exits via the None signal
     assert out["batch"] is None
     assert b.depth == 0
+
+
+# -- priority classes (ISSUE 8) -----------------------------------------------
+
+from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, Future,
+                                    PriorityClass,
+                                    default_priority_classes)
+
+
+def _preq(key="k", priority=None, deadline=None):
+    return Request(key=key, payload=None, deadline=deadline,
+                   priority=priority)
+
+
+def _classes(max_queue=8, **kw):
+    return default_priority_classes(max_queue, **kw)
+
+
+def test_priority_class_validation():
+    with pytest.raises(ValueError):
+        PriorityClass("x", max_queue=0)
+    with pytest.raises(ValueError):
+        PriorityClass("x", max_queue=2, default_deadline_ms=0)
+    with pytest.raises(ValueError):
+        MicroBatcher(1, 0, 4, classes=())
+    with pytest.raises(ValueError):
+        MicroBatcher(1, 0, 4, classes=(PriorityClass("a", 2),
+                                       PriorityClass("a", 2)))
+
+
+def test_default_policy_rejects_explicit_zero_bulk_queue():
+    # an explicit bulk_max_queue=0 must hit PriorityClass's >=1 check,
+    # not be silently replaced with the full max_queue
+    with pytest.raises(ValueError, match="max_queue"):
+        default_priority_classes(8, bulk_max_queue=0)
+    _, bulk = default_priority_classes(8, bulk_max_queue=2)
+    assert bulk.max_queue == 2
+
+
+def test_unknown_priority_class_rejected_typed():
+    b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=4,
+                     classes=_classes())
+    with pytest.raises(ValueError, match="unknown priority class"):
+        b.submit(_preq(priority="vip"))
+
+
+def test_default_class_is_the_most_latency_sensitive():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=4,
+                     classes=_classes())
+    r = _preq()                      # no priority given
+    b.submit(r)
+    assert r.priority == INTERACTIVE
+    assert b.class_depths() == {INTERACTIVE: 1, BULK: 0}
+
+
+def test_per_class_default_deadline_applied_at_submit():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=8,
+                     classes=_classes(bulk_deadline_ms=50.0))
+    r_bulk = _preq(priority=BULK)
+    r_int = _preq(priority=INTERACTIVE)
+    explicit = _preq(priority=BULK, deadline=time.monotonic() + 9.0)
+    t0 = time.monotonic()
+    for r in (r_bulk, r_int, explicit):
+        b.submit(r)
+    assert r_bulk.deadline is not None
+    assert 0.0 < r_bulk.deadline - t0 <= 0.2
+    assert r_int.deadline is None            # class has no default
+    assert explicit.deadline - t0 > 8.0      # explicit wins over default
+
+
+def test_interactive_pops_before_older_bulk():
+    """Class-then-bucket pop order: strict priority across classes —
+    a bulk backlog (older arrivals included) never delays interactive."""
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=16,
+                     classes=_classes())
+    bulk = [_preq(key="kb", priority=BULK) for _ in range(2)]
+    for r in bulk:
+        r.arrival -= 1.0
+        b.submit(r)
+    ri = _preq(key="ki", priority=INTERACTIVE)
+    b.submit(ri)
+    assert b.next_batch(timeout=1) == [ri]
+    assert b.next_batch(timeout=1) == [bulk[0]]
+    assert b.next_batch(timeout=1) == [bulk[1]]
+
+
+def test_round_robin_within_class_across_buckets():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=16,
+                     classes=_classes())
+    ra = [_preq(key="a", priority=BULK) for _ in range(2)]
+    rb = [_preq(key="b", priority=BULK) for _ in range(2)]
+    for r in (ra[0], ra[1], rb[0], rb[1]):
+        b.submit(r)
+    keys = [b.next_batch(timeout=1)[0].key for _ in range(4)]
+    assert keys == ["a", "b", "a", "b"]
+
+
+def test_per_class_queue_bound_is_typed_and_names_the_queue():
+    """Satellite: every ServiceOverloaded message carries the class and
+    the depth at the decision, so shed choices are debuggable from
+    logs alone — and the exception is typed per class."""
+    classes = (PriorityClass(INTERACTIVE, max_queue=8),
+               PriorityClass(BULK, max_queue=1))
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=8,
+                     classes=classes)
+    b.submit(_preq(key="kb", priority=BULK))
+    with pytest.raises(ServiceOverloaded) as ei:
+        b.submit(_preq(key="kb", priority=BULK))
+    assert ei.value.priority == BULK and ei.value.depth == 1
+    assert "'bulk'" in str(ei.value) and "1/1" in str(ei.value)
+    assert "kb" in str(ei.value)
+
+
+def test_overload_sheds_newest_bulk_to_admit_interactive():
+    """The shed order: at the shared total bound, interactive admits by
+    evicting the NEWEST queued bulk request, whose future resolves with
+    a typed per-class ServiceOverloaded."""
+    sheds = []
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=2,
+                     classes=_classes(),
+                     on_shed=lambda cls, n: sheds.append((cls, n)))
+    old_bulk = _preq(key="kb", priority=BULK)
+    new_bulk = _preq(key="kb", priority=BULK)
+    b.submit(old_bulk)
+    b.submit(new_bulk)
+    ri = _preq(key="ki", priority=INTERACTIVE)
+    b.submit(ri)                      # total was full: bulk must shed
+    exc = new_bulk.future.exception(timeout=0)
+    assert isinstance(exc, ServiceOverloaded)
+    assert exc.priority == BULK
+    assert "shed under overload" in str(exc) and "'interactive'" in str(exc)
+    assert not old_bulk.future.done()            # oldest bulk survives
+    assert sheds == [(BULK, 1)]
+    assert b.class_depths() == {INTERACTIVE: 1, BULK: 1}
+    assert b.next_batch(timeout=1) == [ri]
+
+
+def test_bulk_sheds_itself_when_total_full():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=2,
+                     classes=_classes())
+    b.submit(_preq(key="ki", priority=INTERACTIVE))
+    b.submit(_preq(key="ki", priority=INTERACTIVE))
+    with pytest.raises(ServiceOverloaded) as ei:
+        b.submit(_preq(key="kb", priority=BULK))
+    assert ei.value.priority == BULK
+    assert "no lower-priority victim" in str(ei.value)
+
+
+def test_interactive_sheds_itself_when_only_interactive_queued():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=2,
+                     classes=_classes())
+    b.submit(_preq(priority=INTERACTIVE))
+    b.submit(_preq(priority=INTERACTIVE))
+    with pytest.raises(ServiceOverloaded) as ei:
+        b.submit(_preq(priority=INTERACTIVE))
+    assert ei.value.priority == INTERACTIVE
+
+
+def test_expiry_reports_per_class_counts():
+    expired = {}
+    b = MicroBatcher(
+        max_batch=4, max_wait_ms=0, max_queue=16, classes=_classes(),
+        on_expired=lambda n, by_cls: expired.update(total=n, **by_cls))
+    dead_b = _preq(key="kb", priority=BULK,
+                   deadline=time.monotonic() - 0.01)
+    dead_i = _preq(key="ki", priority=INTERACTIVE,
+                   deadline=time.monotonic() - 0.01)
+    alive = _preq(key="ki", priority=INTERACTIVE)
+    for r in (dead_b, dead_i, alive):
+        b.submit(r)
+    assert b.next_batch(timeout=1) == [alive]
+    assert expired == {"total": 2, BULK: 1, INTERACTIVE: 1}
+    exc = dead_b.future.exception(timeout=0)
+    assert isinstance(exc, DeadlineExceeded) and exc.priority == BULK
+    assert "'bulk'" in str(exc) and "kb" in str(exc)
+
+
+def test_single_class_legacy_message_still_names_queue_and_depth():
+    """Satellite: the pre-priority single-class batcher also names its
+    (default) class, the key, and the depth in overload messages."""
+    b = MicroBatcher(max_batch=2, max_wait_ms=10, max_queue=2)
+    b.submit(_req(key="kx"))
+    b.submit(_req(key="kx"))
+    with pytest.raises(ServiceOverloaded) as ei:
+        b.submit(_req(key="kx"))
+    msg = str(ei.value)
+    assert "'default'" in msg and "2/2" in msg and "kx" in msg
+    assert ei.value.priority == "default" and ei.value.depth == 2
+
+
+def test_close_clears_every_class():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=8,
+                     classes=_classes())
+    reqs = [_preq(priority=INTERACTIVE), _preq(priority=BULK)]
+    for r in reqs:
+        b.submit(r)
+    assert b.close() == 2
+    for r in reqs:
+        assert isinstance(r.future.exception(timeout=0), ServiceDraining)
+    assert b.class_depths() == {INTERACTIVE: 0, BULK: 0}
+
+
+def test_accept_filter_applies_across_classes():
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=8,
+                     classes=_classes())
+    ri = _preq(key="a", priority=INTERACTIVE)
+    rb = _preq(key="b", priority=BULK)
+    b.submit(ri)
+    b.submit(rb)
+    # a consumer that only accepts "b" skips the higher class's "a"
+    assert b.next_batch(timeout=1, accept=frozenset(["b"])) == [rb]
+    assert b.next_batch(timeout=1) == [ri]
+
+
+# -- Future.add_done_callback (the admission-release hook) --------------------
+
+def test_future_done_callback_fires_once_on_resolution():
+    f = Future()
+    calls = []
+    f.add_done_callback(lambda fut: calls.append(fut))
+    f.set_result(1)
+    f.set_result(2)            # buggy double-resolve: callback stays once
+    assert calls == [f]
+
+
+def test_future_done_callback_fires_immediately_when_already_done():
+    f = Future()
+    f.set_exception(ValueError("x"))
+    calls = []
+    f.add_done_callback(lambda fut: calls.append(fut))
+    assert calls == [f]
+
+
+# -- shed-vs-admit racing a consumer pop (forced interleavings) ---------------
+#
+# A bulk request sitting at the shared total bound can, in the same
+# instant, be POPPED into a batch by a worker and SHED by an incoming
+# interactive submit. The acquire hook pins both orderings; the
+# invariant under both: the request resolves (or is batched) EXACTLY
+# once — never both, never neither.
+
+def _run_shed_vs_pop(first: str):
+    classes = default_priority_classes(4)
+    b = MicroBatcher(max_batch=1, max_wait_ms=0, max_queue=1,
+                     classes=classes)
+    bulk = _preq(key="kb", priority=BULK)
+    b.submit(bulk)                 # total bound hit: next submit sheds
+    interactive = _preq(key="ki", priority=INTERACTIVE)
+
+    loser = "consumer" if first == "shed" else "producer"
+    release_loser = threading.Event()
+    out = {}
+
+    def hook(lock):
+        if lock.name == "serve.batcher" and \
+                threading.current_thread().name == loser:
+            release_loser.wait(5)
+
+    prev = locks_lib.set_acquire_hook(hook)
+    try:
+        consumer = threading.Thread(
+            target=lambda: out.__setitem__("batch",
+                                           b.next_batch(timeout=5.0)),
+            name="consumer")
+        producer = threading.Thread(
+            target=lambda: b.submit(interactive), name="producer")
+        consumer.start()
+        producer.start()
+        if first == "shed":
+            assert bulk.future.exception(timeout=5) is not None
+        else:
+            while "batch" not in out:
+                time.sleep(0.005)
+        release_loser.set()
+        for t in (consumer, producer):
+            t.join(5)
+            assert not t.is_alive()
+    finally:
+        locks_lib.set_acquire_hook(prev)
+    return b, bulk, interactive, out
+
+
+def test_shed_wins_race_against_pop():
+    """The interactive submit sheds first: the bulk future is typed
+    ServiceOverloaded, and the consumer's pop finds the interactive
+    request instead — the shed victim is never ALSO batched."""
+    b, bulk, interactive, out = _run_shed_vs_pop(first="shed")
+    exc = bulk.future.exception(timeout=0)
+    assert isinstance(exc, ServiceOverloaded) and exc.priority == BULK
+    assert out["batch"] == [interactive]
+    assert b.depth == 0
+
+
+def test_pop_wins_race_against_shed():
+    """The consumer pops the bulk request first: it is in-flight work
+    now, so the interactive submit finds a free slot and admits WITHOUT
+    shedding — the popped request's future stays unresolved for the
+    worker that owns it (resolved exactly once, later, by that worker)."""
+    b, bulk, interactive, out = _run_shed_vs_pop(first="pop")
+    assert out["batch"] == [bulk]
+    assert not bulk.future.done()
+    assert b.class_depths() == {INTERACTIVE: 1, BULK: 0}
+    assert b.next_batch(timeout=1) == [interactive]

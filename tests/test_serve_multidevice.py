@@ -192,6 +192,19 @@ def test_rebalance_warms_new_pairs_then_swaps(tiny_cfg_files):
         snap = svc.metrics.snapshot()
         assert snap["info"]["serve_device_assignments"] \
             == out["assignments"]
+        # one rebalancer at a time: a call arriving while another holds
+        # the claim flag skips typed instead of racing warm-then-swap
+        with svc._rebalance_lock:
+            svc._rebalancing = True
+        try:
+            skipped = svc.rebalance_placement(
+                weights={(16, 24): 1.0, (32, 48): 10.0})
+            assert skipped["skipped"] and not skipped["changed"]
+            assert svc.metrics.counter(
+                "serve_placement_rebalances").value == 1   # unchanged
+        finally:
+            with svc._rebalance_lock:
+                svc._rebalancing = False
         rng = np.random.default_rng(3)
         with CompilationSentinel(budget=0, label="post-rebalance"):
             for _ in range(4):

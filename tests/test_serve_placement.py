@@ -156,3 +156,88 @@ def test_make_mesh_rejects_bad_spatial_with_typed_error():
         mesh_lib.make_mesh(devices=[])
     with pytest.raises(ValueError, match="spatial"):
         mesh_lib.make_mesh(num_devices=4, spatial=0)
+
+
+# -- load-aware automatic rebalance trigger (ISSUE 8 satellite) ---------------
+
+def test_rebalance_trigger_validates_config():
+    from dsin_tpu.serve.placement import RebalanceTrigger
+    with pytest.raises(PlacementError):
+        RebalanceTrigger(skew_threshold=0.5)
+    with pytest.raises(PlacementError):
+        RebalanceTrigger(hysteresis_checks=0)
+    with pytest.raises(PlacementError):
+        RebalanceTrigger(cooldown_s=-1)
+    with pytest.raises(PlacementError):
+        RebalanceTrigger(min_window_requests=0)
+
+
+def _trigger(**kw):
+    from dsin_tpu.serve.placement import RebalanceTrigger
+    kw.setdefault("skew_threshold", 1.5)
+    kw.setdefault("hysteresis_checks", 2)
+    kw.setdefault("cooldown_s", 100.0)
+    kw.setdefault("min_window_requests", 4)
+    return RebalanceTrigger(**kw)
+
+
+A, B = (16, 24), (32, 48)
+
+
+def test_trigger_quiet_below_threshold():
+    t = _trigger()
+    # perfectly balanced windows: skew 1.0, never fires
+    assert t.observe(0.0, {A: 10, B: 10}) is None
+    assert t.observe(10.0, {A: 20, B: 20}) is None
+    assert t.last_skew == 1.0
+
+
+def test_trigger_needs_consecutive_windows_and_fires_with_weights():
+    """Hysteresis: ONE skewed window never moves the ladder; the second
+    consecutive one fires, returning the window's observed (+1) weights."""
+    t = _trigger()
+    assert t.observe(0.0, {A: 20, B: 0}) is None       # streak 1: held
+    weights = t.observe(10.0, {A: 60, B: 0})           # streak 2: fire
+    assert weights == {A: 41.0, B: 1.0}                # window delta + 1
+    assert t.last_skew == pytest.approx(2.0)
+
+
+def test_trigger_streak_resets_on_a_calm_window():
+    t = _trigger()
+    assert t.observe(0.0, {A: 20, B: 0}) is None       # skewed: streak 1
+    assert t.observe(10.0, {A: 30, B: 10}) is None     # calm: reset
+    assert t.observe(20.0, {A: 50, B: 10}) is None     # skewed: streak 1
+    assert t.observe(30.0, {A: 70, B: 10}) is not None  # streak 2: fire
+
+
+def test_trigger_cooldown_prevents_flapping():
+    """Two fires can never land closer than the cooldown — each
+    rebalance warms executables, so flapping would turn placement churn
+    into steady-state compiles."""
+    t = _trigger(hysteresis_checks=1, cooldown_s=50.0)
+    assert t.observe(0.0, {A: 20, B: 0}) is not None    # fire at t=0
+    assert t.observe(10.0, {A: 40, B: 0}) is None       # cooling down
+    assert t.observe(40.0, {A: 60, B: 0}) is None       # still cooling
+    assert t.observe(55.0, {A: 80, B: 0}) is not None   # cooldown over
+
+
+def test_trigger_skips_tiny_windows_and_resets_streak():
+    t = _trigger(min_window_requests=10)
+    assert t.observe(0.0, {A: 20, B: 0}) is None        # streak 1
+    assert t.observe(10.0, {A: 22, B: 0}) is None       # 2 reqs: skipped
+    # the quiet window broke the streak: one more skewed window is
+    # still only streak 1
+    assert t.observe(20.0, {A: 52, B: 0}) is None
+    assert t.observe(30.0, {A: 82, B: 0}) is not None
+
+
+def test_trigger_counts_are_cumulative_deltas():
+    """The trigger differences CUMULATIVE counters (the service feeds it
+    serve_bucket_requests_* totals): absolute magnitude never matters,
+    only the per-window delta."""
+    t = _trigger(hysteresis_checks=1)
+    assert t.observe(0.0, {A: 1000, B: 1000}) is None   # first window:
+    #   deltas vs the implicit 0 start are balanced... (1000, 1000)
+    assert t.last_skew == 1.0
+    w = t.observe(10.0, {A: 1100, B: 1000})             # delta (100, 0)
+    assert w == {A: 101.0, B: 1.0}

@@ -1,0 +1,455 @@
+"""Front-door tests (dsin_tpu/serve/router.py): admission control,
+per-class routing, /healthz-fed eviction/readmission, replica-death
+rerouting, and the shared-nothing spawn path with cross-replica
+bit-identity.
+
+Most tests drive the router against FAKE replicas — in-process threads
+speaking the replica pipe protocol through an injected launcher — so
+the routing/eviction/reroute contracts pin in milliseconds with no jax.
+One end-to-end test spawns REAL replica processes (tiny model) and pins
+byte-identity against the single-process service.
+"""
+
+import multiprocessing
+import threading
+import time
+
+import pytest
+
+from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, DeadlineExceeded,
+                                    ServiceOverloaded, ServiceUnavailable,
+                                    default_priority_classes)
+from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
+from dsin_tpu.serve.router import AdmissionController, FrontDoorRouter
+from dsin_tpu.utils import locks as locks_lib
+
+
+# -- admission control --------------------------------------------------------
+
+def test_admission_validates_limits():
+    with pytest.raises(ValueError):
+        AdmissionController({})
+    with pytest.raises(ValueError):
+        AdmissionController({INTERACTIVE: 0})
+
+
+def test_admission_unknown_class_is_typed():
+    gate = AdmissionController({INTERACTIVE: 2})
+    with pytest.raises(ValueError, match="unknown priority class"):
+        gate.admit("vip")
+
+
+def test_admission_sheds_at_capacity_with_class_and_depth():
+    gate = AdmissionController({INTERACTIVE: 2, BULK: 1})
+    gate.admit(INTERACTIVE)
+    gate.admit(INTERACTIVE)
+    with pytest.raises(ServiceOverloaded) as ei:
+        gate.admit(INTERACTIVE)
+    assert ei.value.priority == INTERACTIVE and ei.value.depth == 2
+    assert "2/2" in str(ei.value) and "admission" in str(ei.value)
+    # classes are independent: bulk still admits
+    gate.admit(BULK)
+    assert gate.outstanding() == {INTERACTIVE: 2, BULK: 1}
+    assert gate.metrics.counter(
+        f"serve_admitted_{INTERACTIVE}").value == 2
+    assert gate.metrics.counter(
+        f"serve_shed_admission_{INTERACTIVE}").value == 1
+
+
+def test_admission_attach_releases_on_any_resolution():
+    from dsin_tpu.serve.batcher import Future
+    gate = AdmissionController({INTERACTIVE: 1})
+    gate.admit(INTERACTIVE)
+    f = Future()
+    gate.attach(INTERACTIVE, f)
+    with pytest.raises(ServiceOverloaded):
+        gate.admit(INTERACTIVE)            # still held
+    f.set_exception(DeadlineExceeded("x", priority=INTERACTIVE))
+    assert gate.outstanding() == {INTERACTIVE: 0}
+    gate.admit(INTERACTIVE)                # slot freed by the resolution
+
+
+def test_default_admission_limits_shared_formula_includes_devices():
+    """The front door and the in-process gate derive per-process
+    backlog from ONE helper; the slack term must count every executor
+    pipeline — workers are PER-DEVICE threads."""
+    from dsin_tpu.serve.router import default_admission_limits
+    from dsin_tpu.serve.service import ServiceConfig
+    cfg = ServiceConfig(ae_config="x", pc_config="y", max_queue=8,
+                        max_batch=4, workers=2, pipeline_depth=3,
+                        devices=2,
+                        priority_classes=default_priority_classes(8))
+    slack = 4 * 2 * 3 * 2
+    assert default_admission_limits(cfg) == {INTERACTIVE: 8 + slack,
+                                             BULK: 8 + slack}
+    # no classes configured -> single "default" class off max_queue
+    plain = ServiceConfig(ae_config="x", pc_config="y", max_queue=5,
+                          max_batch=2, workers=1, pipeline_depth=1)
+    assert default_admission_limits(plain) == {"default": 5 + 2}
+
+
+# -- fake replicas ------------------------------------------------------------
+
+class _Fakes:
+    """Injected launcher: each replica is an in-process thread speaking
+    the pipe protocol. The test keeps both pipe ends and the per-replica
+    controls (received-request events, kill switches, health state)."""
+
+    def __init__(self, n, digests=None, health_ports=None):
+        self.n = n
+        self.digests = digests or ["d0"] * n
+        self.health_ports = health_ports or [None] * n
+        self.child_conns = {}
+        self.received = {i: [] for i in range(n)}
+        self.deadlines = {i: [] for i in range(n)}
+        self.got_request = {i: threading.Event() for i in range(n)}
+        self.respond = {i: True for i in range(n)}
+        self.dead = {i: threading.Event() for i in range(n)}
+        self.threads = {}
+
+    def launcher(self, config, idx, ctx):
+        parent, child = multiprocessing.Pipe(duplex=True)
+        self.child_conns[idx] = child
+        t = threading.Thread(target=self._run, args=(idx, child),
+                             name=f"fake-replica-{idx}", daemon=True)
+        self.threads[idx] = t
+        t.start()
+        return None, parent
+
+    def _run(self, idx, conn):
+        conn.send(("ready", idx, {
+            "replica": idx, "pid": 0,
+            "healthz_port": self.health_ports[idx],
+            "params_digest": self.digests[idx]}))
+        # poll loop (never parked inside recv): kill() must be able to
+        # close the pipe from the test thread and have the router's
+        # reader see a clean EOF, exactly like a process crash
+        while not self.dead[idx].is_set():
+            try:
+                if not conn.poll(0.02):
+                    continue
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg[0] == "stop":
+                try:
+                    conn.send(("bye", idx, None))
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            op, rid, payload, priority, deadline_ms = msg
+            self.received[idx].append((op, rid, priority))
+            self.deadlines[idx].append(deadline_ms)
+            self.got_request[idx].set()
+            if self.respond[idx]:
+                conn.send(("ok", rid, ("echo", idx, op, priority)))
+        conn.close()
+
+    def kill(self, idx):
+        """Simulate replica death: the fake closes its own pipe end (on
+        its own thread, so no fd is yanked out from under a blocked
+        read); the router's reader sees EOF like a process crash."""
+        self.dead[idx].set()
+        self.threads[idx].join(timeout=5)
+
+
+def _router(fakes, replicas=2, **kw):
+    from dsin_tpu.serve.service import ServiceConfig
+    cfg = ServiceConfig(ae_config="unused", pc_config="unused",
+                        max_queue=8,
+                        priority_classes=default_priority_classes(8))
+    kw.setdefault("poll_every_s", 5.0)   # polling quiet unless asked
+    return FrontDoorRouter(cfg, replicas=replicas,
+                           launcher=fakes.launcher, **kw)
+
+
+def test_router_round_robins_per_class_across_live_replicas():
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        results = [r.encode(f"img{i}", timeout=5) for i in range(4)]
+        assert [res[1] for res in results] == [0, 1, 0, 1]
+        # bulk has its OWN rr cursor, starting at replica 0 again
+        res = r.decode(b"blob", priority=BULK, timeout=5)
+        assert res == ("echo", 0, "decode", BULK)
+        assert r.metrics.counter("serve_router_routed_r0").value == 3
+        assert r.metrics.counter(
+            f"serve_router_routed_{INTERACTIVE}").value == 4
+        assert r.metrics.counter(
+            f"serve_router_routed_{BULK}").value == 1
+        assert r.params_digest == "d0"
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_router_refuses_mismatched_replica_digests():
+    fakes = _Fakes(2, digests=["aaaa", "bbbb"])
+    r = _router(fakes)
+    with pytest.raises(RuntimeError, match="DIFFERENT models"):
+        r.start()
+
+
+def test_router_admission_sheds_before_any_dispatch():
+    fakes = _Fakes(1)
+    r = _router(fakes, replicas=1,
+                admission_limits={INTERACTIVE: 1, BULK: 1})
+    r.start()
+    try:
+        fakes.respond[0] = False          # park one request in flight
+        f1 = r.submit_encode("img")
+        with pytest.raises(ServiceOverloaded) as ei:
+            r.submit_encode("img2")
+        assert ei.value.priority == INTERACTIVE
+        # nothing was shipped for the shed request
+        fakes.got_request[0].wait(2)
+        assert len(fakes.received[0]) == 1
+        # a resolution frees the slot
+        assert not f1.done()
+    finally:
+        r.drain(timeout_s=5)
+        assert isinstance(f1.exception(timeout=1), ServiceUnavailable)
+
+
+def test_replica_death_reroutes_inflight_without_failing_caller():
+    """Ordering: dispatch wins, THEN the replica dies with the request
+    in flight — the reader drains the in-flight map and re-dispatches
+    to the surviving replica; the caller's future resolves exactly
+    once, with the live replica's answer."""
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        fakes.respond[0] = False
+        fut = r.submit_encode("img")              # rr -> replica 0
+        assert fakes.got_request[0].wait(2)
+        assert not fut.done()
+        fakes.kill(0)                             # dies holding the req
+        res = fut.result(timeout=5)
+        assert res[1] == 1                        # answered by replica 1
+        assert r.metrics.counter("serve_router_reroutes").value == 1
+        assert r.metrics.counter(
+            "serve_router_replica_deaths").value == 1
+        assert r.health()["replicas"]["0"] == "dead"
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_reroute_forwards_remaining_deadline_budget():
+    """A reroute must not restart the caller's clock: the replacement
+    replica sees only the budget REMAINING at re-dispatch time."""
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        fakes.respond[0] = False
+        fut = r.submit_encode("img", deadline_ms=10_000.0)
+        assert fakes.got_request[0].wait(2)
+        first = fakes.deadlines[0][0]
+        assert first is not None and first <= 10_000.0
+        time.sleep(0.05)
+        fakes.kill(0)
+        assert fut.result(timeout=5)[1] == 1
+        rerouted = fakes.deadlines[1][0]
+        # ~50ms of the budget was burned on the dead replica
+        assert rerouted < first - 25.0
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_reroute_of_expired_request_fails_typed_not_zombie():
+    """A request whose deadline passed while its replica died must
+    expire typed at the router — not be rerouted as zombie work."""
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        fakes.respond[0] = False
+        fut = r.submit_encode("img", deadline_ms=40.0)
+        assert fakes.got_request[0].wait(2)
+        time.sleep(0.1)                           # burn the whole budget
+        fakes.kill(0)
+        exc = fut.exception(timeout=5)
+        assert isinstance(exc, DeadlineExceeded)
+        assert exc.priority == INTERACTIVE
+        assert r.metrics.counter("serve_router_reroutes").value == 0
+        assert r.metrics.counter(
+            f"serve_router_expired_{INTERACTIVE}").value == 1
+        assert not fakes.received[1]              # nothing shipped to 1
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_replica_death_with_no_survivor_fails_typed():
+    fakes = _Fakes(1)
+    r = _router(fakes, replicas=1).start()
+    try:
+        fakes.respond[0] = False
+        fut = r.submit_encode("img")
+        assert fakes.got_request[0].wait(2)
+        fakes.kill(0)
+        exc = fut.exception(timeout=5)
+        assert isinstance(exc, ServiceUnavailable)
+        with pytest.raises(ServiceUnavailable):
+            r.submit_encode("img2")               # door now fails fast
+    finally:
+        r.drain(timeout_s=5)
+
+
+# -- replica eviction racing an in-flight dispatch (forced ordering) ----------
+#
+# A submitter can pick a replica while it is dying: the reader thread's
+# death handling and the submitter's send race on the replica handle.
+# The acquire hook on the per-replica `serve.replica` lock parks the
+# submitter until the death handler has won; the invariant (both here
+# and in the natural ordering above): the caller's future resolves
+# EXACTLY once, typed or with the survivor's answer — never hung.
+
+def test_eviction_wins_race_against_dispatch_future_resolves_once():
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        rep0 = r._replicas[0]
+        parked = threading.Event()
+        release = threading.Event()
+
+        def hook(lock):
+            if lock is rep0.lock and \
+                    threading.current_thread().name == "submitter":
+                parked.set()
+                release.wait(5)
+
+        prev = locks_lib.set_acquire_hook(hook)
+        out = {}
+        try:
+            t = threading.Thread(
+                target=lambda: out.__setitem__(
+                    "res", r.encode("img", timeout=10)),
+                name="submitter")
+            t.start()
+            assert parked.wait(5)      # submitter picked replica 0 and
+            #                            is about to register + send
+            fakes.kill(0)              # death handler wins the race
+            deadline = time.monotonic() + 5
+            while r.health()["replicas"]["0"] != "dead":
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            release.set()              # submitter now sends into a dead
+            #                            pipe and must fail over cleanly
+            t.join(10)
+            assert not t.is_alive()
+        finally:
+            locks_lib.set_acquire_hook(prev)
+        assert out["res"][1] == 1      # exactly one resolution: survivor
+        results = [r.encode(f"img{i}", timeout=5) for i in range(2)]
+        assert all(res[1] == 1 for res in results)
+    finally:
+        r.drain(timeout_s=5)
+
+
+# -- /healthz-fed eviction and readmission ------------------------------------
+
+def test_healthz_eviction_and_readmission():
+    """A replica whose /healthz fails `evict_after` consecutive polls
+    stops receiving NEW traffic (its process may merely be sick, so it
+    is evicted, not declared dead); one healthy poll readmits it."""
+    state = {"status": "ok"}
+    server = MetricsServer(MetricsRegistry(), lambda: dict(state),
+                           port=0).start()
+    try:
+        fakes = _Fakes(2, health_ports=[server.port, None])
+        r = _router(fakes, poll_every_s=0.05, evict_after=2,
+                    health_timeout_s=1.0).start()
+        try:
+            state["status"] = "unhealthy"          # /healthz -> 503
+            deadline = time.monotonic() + 5
+            while r.health()["replicas"]["0"] != "evicted":
+                assert time.monotonic() < deadline, r.health()
+                time.sleep(0.02)
+            # all new traffic lands on the survivor
+            assert [r.encode(f"i{k}", timeout=5)[1]
+                    for k in range(3)] == [1, 1, 1]
+            assert r.metrics.counter("serve_router_evictions").value == 1
+            state["status"] = "ok"
+            deadline = time.monotonic() + 5
+            while r.health()["replicas"]["0"] != "live":
+                assert time.monotonic() < deadline, r.health()
+                time.sleep(0.02)
+            assert r.metrics.counter(
+                "serve_router_readmissions").value == 1
+            # readmitted: replica 0 is back in the rotation
+            got = {r.encode(f"j{k}", timeout=5)[1] for k in range(2)}
+            assert got == {0, 1}
+        finally:
+            r.drain(timeout_s=5)
+    finally:
+        server.stop()
+
+
+# -- real shared-nothing replicas (spawn) -------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("router_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def test_spawned_replicas_bit_identical_to_single_process(tiny_cfg_files):
+    """The shared-nothing contract end to end: two REAL replica
+    processes (own model build, own warmup, own compile cache) answer
+    encode with bytes identical to each other AND to the in-process
+    single-service path; decode roundtrips through the router; the
+    digest handshake passed (start() would have refused otherwise)."""
+    import numpy as np
+
+    from dsin_tpu.serve import CompressionService, ServiceConfig
+    ae_p, pc_p = tiny_cfg_files
+    cfg = ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=((16, 24),),
+        max_batch=2, max_wait_ms=2.0, max_queue=16, workers=1,
+        priority_classes=default_priority_classes(16))
+    rng = np.random.default_rng(7)
+    imgs = [rng.integers(0, 255, (16, 24, 3), dtype=np.uint8),
+            rng.integers(0, 255, (10, 17, 3), dtype=np.uint8)]
+
+    router = FrontDoorRouter(cfg, replicas=2, poll_every_s=0.5,
+                             start_timeout_s=600.0).start()
+    try:
+        assert router.params_digest
+        # each image encoded twice IN THE SAME CLASS: consecutive
+        # same-class submits round-robin across both replicas, so
+        # a == b IS cross-replica bit-identity (a bulk copy rides
+        # along for the per-class admission counters — its rr cursor
+        # is independent, so it alone would not change replica)
+        streams = {}
+        for i, img in enumerate(imgs):
+            a = router.encode(img, timeout=120.0)       # replica 0
+            b = router.encode(img, timeout=120.0)       # replica 1
+            c = router.encode(img, priority=BULK, timeout=120.0)
+            assert a.stream == b.stream == c.stream
+            streams[i] = a.stream
+        decoded = router.decode(streams[1], timeout=120.0)
+        assert decoded.shape == (10, 17, 3)
+        snap = router.metrics.snapshot()["counters"]
+        assert snap.get("serve_router_routed_r0", 0) > 0
+        assert snap.get("serve_router_routed_r1", 0) > 0
+        assert snap.get(f"serve_admitted_{INTERACTIVE}", 0) >= 3
+        assert snap.get(f"serve_admitted_{BULK}", 0) >= 2
+        assert router.health()["status"] == "ok"
+    finally:
+        router.drain(timeout_s=60)
+
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=((16, 24),),
+        max_batch=2, max_wait_ms=2.0, max_queue=16, workers=1)).start()
+    try:
+        svc.warmup()
+        for i, img in enumerate(imgs):
+            assert svc.encode(img).stream == streams[i], \
+                "replica stream differs from the single-process path"
+    finally:
+        svc.drain()

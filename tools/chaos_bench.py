@@ -31,7 +31,10 @@ Since ISSUE 4 the default run exercises the PIPELINED dataplane
 device dispatch and entropy-pool completion, and the serve.rans site
 fires inside pool tasks — the invariants above (zero hung futures in
 particular) must hold regardless. `--entropy_workers 0` soaks the
-serialized legacy path.
+serialized legacy path. `--entropy_backend process` (ISSUE 8
+satellite, the PR 7 follow-up) runs the whole battery over the spawn
+process pool of worker-resident codecs — the committed
+CHAOS_BENCH.json soaks that path.
 
 Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
 (tests/test_tools_smoke.py) and the `chaos-smoke` stage of
@@ -113,6 +116,7 @@ def run_chaos(args) -> dict:
         seed=args.seed, buckets=buckets, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         workers=args.workers, entropy_workers=args.entropy_workers,
+        entropy_backend=args.entropy_backend,
         pipeline_depth=args.pipeline_depth, restart_backoff_s=0.02,
         restart_backoff_max_s=0.25)
     service = CompressionService(cfg).start()
@@ -246,6 +250,7 @@ def run_chaos(args) -> dict:
             "buckets": [list(b) for b in buckets],
             "workers": args.workers,
             "entropy_workers": service._entropy_workers,
+            "entropy_backend": args.entropy_backend,
             "pipeline_depth": args.pipeline_depth,
             "max_batch": args.max_batch,
             "max_queue": args.max_queue, "requests": args.requests,
@@ -322,6 +327,16 @@ def main(argv=None) -> int:
                         "crashes/corruption land while batches are in "
                         "flight between device dispatch and entropy "
                         "completion, and the invariants must still hold")
+    p.add_argument("--entropy_backend", default="thread",
+                   choices=("thread", "process"),
+                   help="entropy-stage backend for the soaked service "
+                        "(PR 7 follow-up: 'process' runs the whole "
+                        "chaos soak — worker crashes, serve.rans "
+                        "corruption, drain — over the spawn process "
+                        "pool of worker-resident codecs, so pool-child "
+                        "semantics face the same fault battery as the "
+                        "thread path; the committed CHAOS_BENCH.json "
+                        "covers it)")
     p.add_argument("--pipeline_depth", type=int, default=2)
     p.add_argument("--max_batch", type=int, default=2)
     p.add_argument("--max_wait_ms", type=float, default=2.0)

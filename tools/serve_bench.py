@@ -139,20 +139,49 @@ def _write_smoke_cfgs(tmpdir):
     return ae_p, pc_p
 
 
-def _build_service(args, entropy_workers: int, devices=None,
-                   backend: str = "thread"):
-    from dsin_tpu.serve import CompressionService, ServiceConfig
-
+def _service_config(args, entropy_workers, devices=None,
+                    backend: str = "thread", classes=None, max_queue=None):
+    from dsin_tpu.serve import ServiceConfig
     buckets = _parse_shapes(args.buckets)
-    cfg = ServiceConfig(
+    return ServiceConfig(
         ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
         seed=args.seed, buckets=buckets, max_batch=args.max_batch,
-        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue if max_queue is None else max_queue,
         workers=args.workers, entropy_workers=entropy_workers,
-        entropy_backend=backend,
+        entropy_backend=backend, priority_classes=classes,
         pipeline_depth=args.pipeline_depth, devices=devices)
+
+
+def _build_service(args, entropy_workers: int, devices=None,
+                   backend: str = "thread", classes=None, max_queue=None):
+    from dsin_tpu.serve import CompressionService
+    cfg = _service_config(args, entropy_workers, devices=devices,
+                          backend=backend, classes=classes,
+                          max_queue=max_queue)
     service = CompressionService(cfg).start()
     return service, service.warmup()
+
+
+def _pace(i: int, t0: float, period: float) -> None:
+    """Open-loop arrival pacing: sleep until request i's scheduled
+    slot (t0 + i*period); overruns submit immediately, no catch-up
+    burst. One definition so every scenario measures the same
+    arrival process."""
+    delay = t0 + i * period - time.monotonic()
+    if delay > 0:
+        time.sleep(delay)
+
+
+def _mixed_class(i: int, int_share: float) -> str:
+    """Deterministic interactive/bulk interleave at the configured
+    share (same stream every run, no RNG)."""
+    # lazy import like every dsin_tpu.serve use here: the module must
+    # stay importable before _force_host_devices pins XLA flags
+    from dsin_tpu.serve import BULK, INTERACTIVE
+    return (INTERACTIVE
+            if int((i + 1) * int_share) > int(i * int_share)
+            else BULK)
 
 
 def _run_stream(service, args) -> dict:
@@ -195,10 +224,7 @@ def _run_stream(service, args) -> dict:
     with CompilationSentinel(budget=0, label="serve steady state",
                              raise_on_exceed=False) as sentinel:
         for i in range(args.requests):
-            target = t_start + i * period
-            delay = target - time.monotonic()
-            if delay > 0:
-                time.sleep(delay)
+            _pace(i, t_start, period)
             try:
                 futures.append(service.submit_encode(
                     images[i % len(images)],
@@ -516,6 +542,297 @@ def _gate_device_axis(devices_section) -> list:
     return violations
 
 
+def _parse_mix(spec: str) -> dict:
+    """'interactive:0.3 bulk:0.7' -> {class: share} (normalized)."""
+    mix = {}
+    for part in spec.split():
+        name, share = part.split(":")
+        mix[name] = float(share)
+    total = sum(mix.values())
+    if total <= 0 or any(v < 0 for v in mix.values()):
+        raise ValueError(f"bad --priority_mix {spec!r}")
+    return {k: v / total for k, v in mix.items()}
+
+
+def _frontdoor_classes(args, max_queue):
+    from dsin_tpu.serve.batcher import default_priority_classes
+    return default_priority_classes(
+        max_queue, bulk_deadline_ms=args.bulk_deadline_ms)
+
+
+def _run_frontdoor_overload(args) -> dict:
+    """Open-loop OVERLOAD with a priority mix through ONE in-process
+    service wearing the full front door (priority classes + admission
+    gate): arrivals far above capacity against a deliberately small
+    queue, interactive/bulk interleaved per --priority_mix. The section
+    records, per class: door sheds (admission + queue bounds, both
+    typed with the class), shed VICTIMS (bulk evicted to admit
+    interactive — the shed-order evidence), expiries, completions, and
+    the per-class latency quantiles the smoke gate holds `interactive`
+    p99 to. Bulk starving/shedding while interactive's p99 stays inside
+    its SLO is the whole point of the class system; a FIFO door fails
+    this scenario by construction (interactive waits behind the bulk
+    backlog)."""
+    from dsin_tpu.serve import (BULK, INTERACTIVE, DeadlineExceeded,
+                                ServeError, ServiceOverloaded)
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    classes = _frontdoor_classes(args, args.frontdoor_queue)
+    svc, warm = _build_service(args, args.entropy_workers,
+                               classes=classes,
+                               max_queue=args.frontdoor_queue)
+    mix = _parse_mix(args.priority_mix)
+    int_share = mix.get(INTERACTIVE, 0.0)
+    shapes = _parse_shapes(args.shapes)
+    rng = np.random.default_rng(args.seed)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    cores = round(_effective_cores(), 2)
+
+    per = {cls: {"submitted": 0, "shed_at_door": 0, "completed": 0,
+                 "shed_inflight": 0, "expired": 0, "failed": 0}
+           for cls in (INTERACTIVE, BULK)}
+    futures = []
+    period = 1.0 / args.frontdoor_rate
+    t_start = time.monotonic()
+    with CompilationSentinel(budget=0, label="frontdoor overload",
+                             raise_on_exceed=False) as sentinel:
+        for i in range(args.frontdoor_requests):
+            _pace(i, t_start, period)
+            cls = _mixed_class(i, int_share)
+            per[cls]["submitted"] += 1
+            try:
+                futures.append(
+                    (cls, svc.submit_encode(images[i % len(images)],
+                                            priority=cls)))
+            except ServeError:
+                per[cls]["shed_at_door"] += 1
+        for cls, f in futures:
+            try:
+                exc = f.exception(timeout=120.0)
+            except TimeoutError:
+                per[cls]["failed"] += 1     # hung future: hard violation
+                continue
+            if exc is None:
+                per[cls]["completed"] += 1
+            elif isinstance(exc, ServiceOverloaded):
+                per[cls]["shed_inflight"] += 1   # evicted as a victim
+            elif isinstance(exc, DeadlineExceeded):
+                per[cls]["expired"] += 1
+            elif isinstance(exc, Exception):
+                per[cls]["failed"] += 1
+    duration = time.monotonic() - t_start
+    snap = svc.metrics.snapshot()
+    svc.drain()
+    for cls in per:
+        lat = snap["histograms"].get(
+            f"serve_latency_ms_{cls}",
+            {"count": 0, "mean": 0.0, "p50": 0.0, "p99": 0.0})
+        per[cls]["latency_ms"] = {k: round(float(v), 3)
+                                  for k, v in lat.items()}
+        per[cls]["shed_victims"] = snap["counters"].get(
+            f"serve_shed_{cls}", 0)
+        per[cls]["admitted"] = snap["counters"].get(
+            f"serve_admitted_{cls}", 0)
+        per[cls]["shed_admission"] = snap["counters"].get(
+            f"serve_shed_admission_{cls}", 0)
+    shed_total = {cls: per[cls]["shed_at_door"] + per[cls]["shed_inflight"]
+                  for cls in per}
+    return {
+        "rate_rps": args.frontdoor_rate,
+        "requests": args.frontdoor_requests,
+        "queue": args.frontdoor_queue,
+        "mix": mix,
+        "duration_s": round(duration, 3),
+        "per_class": per,
+        "interactive_slo_ms": args.interactive_slo_ms,
+        "interactive_p99_ms": per[INTERACTIVE]["latency_ms"]["p99"],
+        "bulk_p99_ms": per[BULK]["latency_ms"]["p99"],
+        "sheds_bulk_first": (shed_total[BULK] > 0
+                             and shed_total[INTERACTIVE] == 0),
+        "shed_total": shed_total,
+        "effective_cores": cores,
+        "steady_compiles": sentinel.compilations,
+        "warmup": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in warm.items()},
+    }
+
+
+def _run_frontdoor_replicas(args) -> dict:
+    """Shared-nothing scale-out axis: the same saturating mixed-class
+    stream through the FrontDoorRouter at 1 and --replicas service
+    PROCESSES (each a full spawn replica warming its own codec +
+    compile cache). Records aggregate throughput, per-replica routing,
+    per-class admission sheds, and the cross-replica bit-identity
+    probe: every replica must emit byte-identical streams (round-robin
+    lands one probe copy on each), and N>1 must match the N=1 run —
+    the single-process path. On the shared 2-core CI host two extra
+    interpreter processes often CANNOT show the scaling win (the cores
+    are already saturated), so the smoke gate reads the per-run
+    _effective_cores probe and downgrades a missed scaling floor to a
+    host-weather note — the PR 4/7 convention; the committed artifact
+    documents the real curve."""
+    from dsin_tpu.serve import BULK, INTERACTIVE, ServeError
+    from dsin_tpu.serve.router import FrontDoorRouter
+
+    classes = _frontdoor_classes(args, args.max_queue)
+    cfg = _service_config(args, args.entropy_workers, classes=classes)
+    mix = _parse_mix(args.priority_mix)
+    int_share = mix.get(INTERACTIVE, 0.0)
+    shapes = _parse_shapes(args.shapes)
+    rng = np.random.default_rng(args.seed + 2)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    probes = images[:2]
+    axis = sorted({1, max(1, int(args.replicas))})
+    out = {"axis": axis, "runs": {}, "bit_identical": None}
+    frames = {}
+    for n in axis:
+        cores = round(_effective_cores(), 2)
+        router = FrontDoorRouter(cfg, replicas=n).start()
+        futures, shed = [], 0
+        period = 1.0 / args.frontdoor_rate
+        t0 = time.monotonic()
+        for i in range(args.frontdoor_requests):
+            _pace(i, t0, period)
+            cls = _mixed_class(i, int_share)
+            try:
+                futures.append(router.submit_encode(images[i % len(images)],
+                                                    priority=cls))
+            except ServeError:
+                shed += 1
+        completed = failed = rejected_inflight = 0
+        for f in futures:
+            try:
+                exc = f.exception(timeout=180.0)
+            except TimeoutError:
+                failed += 1
+                continue
+            if exc is None:
+                completed += 1
+            elif isinstance(exc, ServeError):
+                rejected_inflight += 1
+            else:
+                failed += 1
+        duration = time.monotonic() - t0
+        # probe every replica: n copies of each probe image round-robin
+        # across the fleet; a mismatch anywhere breaks bit_identical
+        frames[n] = [[router.encode(im, timeout=180.0).stream
+                      for im in probes] for _ in range(n)]
+        snap = router.metrics.snapshot()["counters"]
+        router.drain()
+        out["runs"][str(n)] = {
+            "throughput_rps": round(completed / duration, 3)
+            if duration > 0 else 0.0,
+            "completed": completed,
+            "failed": failed,
+            "shed_at_door": shed,
+            "rejected_inflight": rejected_inflight,
+            "per_replica_routed": {
+                str(i): snap.get(f"serve_router_routed_r{i}", 0)
+                for i in range(n)},
+            "reroutes": snap.get("serve_router_reroutes", 0),
+            "replica_deaths": snap.get("serve_router_replica_deaths", 0),
+            "params_digest": router.params_digest,
+            "effective_cores": cores,
+            "host_cores": os.cpu_count(),
+        }
+    same_within = all(all(row == fleet[0] for row in fleet)
+                      for fleet in frames.values())
+    same_across = all(fleet[0] == frames[axis[0]][0]
+                      for fleet in frames.values())
+    out["bit_identical"] = bool(same_within and same_across)
+    base = out["runs"].get("1", {}).get("throughput_rps") or None
+    for entry in out["runs"].values():
+        entry["scaling_vs_1"] = (round(entry["throughput_rps"] / base, 3)
+                                 if base else None)
+    return out
+
+
+def _gate_frontdoor(section, scaling_floor: float = 1.3) -> list:
+    """--smoke violations for the front door: the overload scenario
+    must show bulk shedding FIRST (and only bulk), interactive
+    completing with its p99 inside the SLO (host-weather escape per
+    the PR 4/7 convention), zero untyped errors, zero steady compiles;
+    the replica axis (when present) must be bit-identical and either
+    clear the scaling floor or record the serial-host note."""
+    from dsin_tpu.serve import BULK, INTERACTIVE
+    violations = []
+    ov = section.get("overload")
+    if ov is not None:
+        if not ov["sheds_bulk_first"]:
+            violations.append(
+                f"overload did not shed bulk first: shed totals "
+                f"{ov['shed_total']} (bulk must shed, interactive must "
+                f"not)")
+        if ov["per_class"][INTERACTIVE]["completed"] == 0:
+            violations.append("no interactive request completed under "
+                              "overload")
+        for cls, stats in ov["per_class"].items():
+            if stats["failed"]:
+                violations.append(f"overload: {stats['failed']} untyped/"
+                                  f"hung {cls} requests")
+        if ov["steady_compiles"]:
+            violations.append(f"overload: {ov['steady_compiles']} "
+                              f"steady-state compiles")
+        p99, slo = ov["interactive_p99_ms"], ov["interactive_slo_ms"]
+        if not p99 or p99 > slo:
+            cores = ov.get("effective_cores")
+            if isinstance(cores, float) and cores < 1.3:
+                print(f"SERVE_BENCH_NOTE: interactive p99 {p99}ms over "
+                      f"the {slo}ms SLO in a serial window (effective "
+                      f"cores {cores}) — SLO gate not applied",
+                      file=sys.stderr)
+            else:
+                violations.append(
+                    f"interactive p99 {p99}ms exceeds its {slo}ms SLO "
+                    f"with parallel headroom (effective cores {cores}) "
+                    f"while bulk was shedding — the priority door is "
+                    f"not protecting the latency class")
+    reps = section.get("replicas")
+    if reps is not None:
+        if reps["bit_identical"] is not True:
+            violations.append("replica fleet emitted non-identical "
+                              "streams for the same probe images")
+        for n, entry in reps["runs"].items():
+            if entry["failed"]:
+                violations.append(f"replicas={n}: {entry['failed']} "
+                                  f"untyped/hung requests")
+        top = str(max(int(k) for k in reps["runs"]))
+        if top != "1":
+            entry = reps["runs"][top]
+            scaling = entry.get("scaling_vs_1")
+            if scaling is None or scaling < scaling_floor:
+                # host-weather escape, PR 4/7 convention: each replica
+                # is itself a multi-threaded pipeline (worker + entropy
+                # pool), so N replicas only scale with ~2N cores of
+                # real headroom — a 2-core CI box can NEVER show the
+                # win (the single replica already saturates it), and
+                # the thread-pair probe can read "headroom" that three
+                # extra interpreter processes immediately consume. The
+                # committed artifact records the honest curve + both
+                # probes; a host that physically cannot scale records
+                # a note instead of failing the queue.
+                cores = entry.get("effective_cores")
+                host = entry.get("host_cores") or 0
+                needed = 2 * int(top)
+                if host < needed or (isinstance(cores, float)
+                                     and cores < 1.6):
+                    print(f"SERVE_BENCH_NOTE: {top}-replica scaling "
+                          f"{scaling} below the {scaling_floor} floor "
+                          f"on a host without ~{needed} cores of "
+                          f"headroom (host cores {host}, effective "
+                          f"cores {cores}) — scaling gate not applied",
+                          file=sys.stderr)
+                else:
+                    violations.append(
+                        f"replicas={top} aggregate throughput only "
+                        f"{scaling}x the single replica with parallel "
+                        f"headroom (host cores {host}, effective cores "
+                        f"{cores})")
+    return violations
+
+
 def run_bench(args) -> dict:
     """Serialized-vs-pipelined comparison with an interleaved-repeats
     methodology: both services are built and warmed once, then the same
@@ -668,6 +985,38 @@ def main(argv=None) -> int:
                         "serialized-vs-pipelined comparison and the "
                         "device axis) — the entropy-bench "
                         "tpu_session.sh stage")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="replica count for the front-door scale-out "
+                        "axis (shared-nothing spawn processes behind "
+                        "FrontDoorRouter; the axis always includes 1)")
+    p.add_argument("--priority_mix", default="interactive:0.125 bulk:0.875",
+                   help="class shares for the frontdoor scenarios. The "
+                        "default keeps INTERACTIVE under service "
+                        "capacity while bulk floods far past it — the "
+                        "scenario the class system exists for (an "
+                        "interactive class that itself exceeds capacity "
+                        "must shed too; that is a sizing problem, not a "
+                        "scheduling one)")
+    p.add_argument("--interactive_slo_ms", type=float, default=1500.0,
+                   help="per-class p99 bound the overload gate holds "
+                        "the interactive class to (--smoke)")
+    p.add_argument("--bulk_deadline_ms", type=float, default=30000.0,
+                   help="bulk class default deadline in the frontdoor "
+                        "scenarios (generous: shedding, not expiry, is "
+                        "the intended overload behavior)")
+    p.add_argument("--frontdoor_rate", type=float, default=120.0,
+                   help="frontdoor scenarios' open-loop arrival rate — "
+                        "deliberately ABOVE capacity in aggregate "
+                        "(overload is the point), while the interactive "
+                        "share of it stays within capacity")
+    p.add_argument("--frontdoor_requests", type=int, default=240)
+    p.add_argument("--frontdoor_queue", type=int, default=24,
+                   help="overload scenario's shared queue bound (small "
+                        "on purpose: the shed order needs a full queue)")
+    p.add_argument("--frontdoor_only", action="store_true",
+                   help="run ONLY the front-door scenarios (priority-"
+                        "mix overload + replica scale-out) — the "
+                        "frontdoor-bench tpu_session.sh stage")
     p.add_argument("--out", default="SERVE_BENCH.json")
     p.add_argument("--smoke_model", action="store_true",
                    help="use the built-in tiny model configs but keep "
@@ -701,16 +1050,20 @@ def main(argv=None) -> int:
         args.max_queue = 128
         args.repeats = 5       # median of 5 pairs: one noisy host
         args.sample_every_ms = 20.0    # window cannot flip the verdict
+        args.frontdoor_requests = 200   # ~1.7s window: a real backlog
 
-    if args.devices_only and args.backends_only:
-        print("SERVE_BENCH_FAILED: --devices_only and --backends_only "
-              "are mutually exclusive", file=sys.stderr)
+    only_flags = [f for f in ("devices_only", "backends_only",
+                              "frontdoor_only") if getattr(args, f)]
+    if len(only_flags) > 1:
+        print(f"SERVE_BENCH_FAILED: {only_flags} are mutually "
+              f"exclusive", file=sys.stderr)
         return 2
     if args.devices is None:
         # smoke keeps the axis short (CI seconds); the committed
-        # artifact run records the full curve; backends_only never
-        # runs the device axis, so it never forces host devices
-        args.devices = ("" if args.backends_only
+        # artifact run records the full curve; backends_only and
+        # frontdoor_only never run the device axis, so they never
+        # force host devices
+        args.devices = ("" if (args.backends_only or args.frontdoor_only)
                         else "1 2" if args.smoke else "1 2 4 8")
     axis = [int(v) for v in args.devices.split()]
     if any(n < 1 for n in axis):
@@ -763,6 +1116,26 @@ def main(argv=None) -> int:
             },
             "entropy_backends": _run_backend_axis(args),
         }
+    elif args.frontdoor_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "frontdoor_rate_rps": args.frontdoor_rate,
+                "frontdoor_requests": args.frontdoor_requests,
+                "priority_mix": args.priority_mix,
+                "replicas": args.replicas,
+                "smoke": args.smoke,
+            },
+            "frontdoor": {
+                "overload": _run_frontdoor_overload(args),
+                "replicas": _run_frontdoor_replicas(args),
+            },
+        }
     else:
         report = run_bench(args)
         report["config"]["entropy_backend"] = args.entropy_backend
@@ -771,13 +1144,23 @@ def main(argv=None) -> int:
         if axis:
             report["config"]["devices_axis"] = axis
             report["devices"] = _run_device_axis(args, axis)
+        # front door (ISSUE 8): the overload + priority-mix scenario
+        # rides every run (the --smoke gate holds interactive's p99 and
+        # the bulk-sheds-first order); the replica scale-out axis spawns
+        # full processes, so it rides only the full (artifact) run and
+        # the dedicated --frontdoor_only stage
+        report["config"]["priority_mix"] = args.priority_mix
+        report["frontdoor"] = {"overload": _run_frontdoor_overload(args)}
+        if not args.smoke:
+            report["config"]["replicas"] = args.replicas
+            report["frontdoor"]["replicas"] = _run_frontdoor_replicas(args)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
     summary_keys = ("load", "latency_ms", "batch_occupancy",
                     "steady_compiles", "pipeline", "entropy_backends",
-                    "devices")
+                    "devices", "frontdoor")
     print(json.dumps({k: report[k] for k in summary_keys if k in report},
                      indent=1))
     if args.smoke and args.devices_only:
@@ -788,6 +1171,12 @@ def main(argv=None) -> int:
         return 0
     if args.smoke and args.backends_only:
         violations = _gate_backend_axis(report["entropy_backends"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
+    if args.smoke and args.frontdoor_only:
+        violations = _gate_frontdoor(report["frontdoor"])
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1
@@ -841,6 +1230,8 @@ def main(argv=None) -> int:
                 _gate_backend_axis(report["entropy_backends"]))
         if "devices" in report:
             violations.extend(_gate_device_axis(report["devices"]))
+        if "frontdoor" in report:
+            violations.extend(_gate_frontdoor(report["frontdoor"]))
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

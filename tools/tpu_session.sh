@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -110,6 +110,25 @@ entropy-bench)
     exit 1
   fi
   ;;
+frontdoor-bench)
+  # front-door smoke before chip time (ISSUE 8): the priority-mix
+  # overload scenario (interactive p99 inside its SLO while bulk sheds
+  # FIRST — typed, per-class) and the shared-nothing replica axis
+  # (spawned service processes behind FrontDoorRouter, cross-replica
+  # bit-identity pinned; the 1.3x scaling floor downgrades to a noted
+  # host-weather line on boxes without ~2N cores). --frontdoor_only
+  # skips the pair/device/backend benches (their stages own them) and
+  # --devices "" keeps jax off forced host devices, so the stage stays
+  # seconds-fast.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --frontdoor_only \
+    --devices "" --out artifacts/frontdoor_bench.json \
+    > artifacts/frontdoor_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/frontdoor_bench.log
+    echo "TPU_SESSION_FAILED: frontdoor-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -181,7 +200,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
