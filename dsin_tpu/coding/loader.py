@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence, Tuple
 
@@ -63,7 +64,45 @@ def load_model_state(ae_config_path: str, pc_config_path: str,
         if need_sinet:
             parts.append("sinet")
         state = ckpt_lib.restore_partitions(ckpt_dir, state, parts)
+        # verify what was restored against the checkpoint's manifest
+        # (ISSUE 9): a mismatch raises typed ManifestMismatch HERE, at
+        # build time — never discovered as flaky bit-identity in
+        # production. Pre-manifest checkpoints load with a recorded
+        # warning (the operator's cue to re-save with identity).
+        info = ckpt_lib.verify_manifest(ckpt_dir, state, parts,
+                                        pc_config=pc_cfg)
+        if info["status"] == "legacy":
+            warnings.warn(
+                f"checkpoint {ckpt_dir} predates manifest.json — loaded "
+                f"WITHOUT identity verification (re-save it to gain "
+                f"digest/pc-hash checks and hot-swap eligibility)",
+                stacklevel=2)
     return model, state
+
+
+def load_swap_state(ckpt_dir: str, state, *, pc_config=None, buckets=None,
+                    need_sinet: bool = False):
+    """Restore an INCOMING checkpoint's params into a copy of a live
+    service's state template (same architecture — the template's pytree
+    IS the compatibility contract) and verify its manifest, for the
+    hot-swap path. Returns (new_state, manifest_info); any identity
+    disagreement raises typed ManifestMismatch, a manifest-less
+    checkpoint is REFUSED (unlike cold start, a hot swap replaces a
+    known-good model — adopting an unverifiable one silently is exactly
+    the failure mode manifests exist to kill)."""
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    parts = list(ckpt_lib.AE_PARTITIONS)
+    if need_sinet:
+        parts.append("sinet")
+    new_state = ckpt_lib.restore_partitions(ckpt_dir, state, parts)
+    info = ckpt_lib.verify_manifest(ckpt_dir, new_state, parts,
+                                    pc_config=pc_config, buckets=buckets)
+    if info["status"] == "legacy":
+        raise ckpt_lib.ManifestMismatch(
+            f"checkpoint {ckpt_dir} has no manifest.json — hot-swap "
+            f"refuses unversioned checkpoints (re-save it with the "
+            f"current trainer to gain a manifest)")
+    return new_state, info
 
 
 def make_codec(model, state):

@@ -161,7 +161,8 @@ def run_3phase(ae_config, pc_config, out_root: str,
         # Guarantee the dir holds the final trained state.
         if not os.path.exists(os.path.join(exp1.ckpt_dir, "meta.json")):
             ckpt_lib.save_checkpoint(exp1.ckpt_dir, exp1.state,
-                                     extra_meta={"kind": "phase1_final"})
+                                     extra_meta={"kind": "phase1_final"},
+                                     manifest_extra=exp1._manifest_extra())
         best1 = exp1.restore_best_for_test(
             extra_candidates=_prior_best_dir(out_root, prior))
         t1 = exp1.test(max_images=max_test_images, save_images=True)
@@ -218,7 +219,8 @@ def run_3phase(ae_config, pc_config, out_root: str,
     # checkpoint the closing test actually scored
     if not os.path.exists(os.path.join(exp2.ckpt_dir, "meta.json")):
         ckpt_lib.save_checkpoint(exp2.ckpt_dir, exp2.state,
-                                 extra_meta={"kind": "phase2_final"})
+                                 extra_meta={"kind": "phase2_final"},
+                                 manifest_extra=exp2._manifest_extra())
     best2 = exp2.restore_best_for_test(
         extra_candidates=_prior_best_dir(out_root, prior2))
     t2 = exp2.test(max_images=max_test_images, save_images=True,

@@ -67,6 +67,7 @@ class Experiment:
         self.ae_config = ae_config
         self.pc_config = pc_config
         self.out_root = out_root
+        self.seed = seed
         self.model = DSIN(ae_config, pc_config)
 
         train_manifest = os.path.join(ae_config.root_data,
@@ -178,6 +179,15 @@ class Experiment:
 
     # -- restore ------------------------------------------------------------
 
+    def _manifest_extra(self) -> dict:
+        """Trainer-side identity for every checkpoint manifest
+        (train/checkpoint.py, ISSUE 9): the canonical pc-config hash a
+        loader re-derives from its own config (a swapped-in model with
+        a different context model is refused before it serves) and the
+        init seed (reproducibility bookkeeping)."""
+        return {"pc_config_sha256": ckpt_lib.config_sha256(self.pc_config),
+                "seed": self.seed}
+
     def maybe_restore(self) -> None:
         cfg = self.ae_config
         self.restored_best_val = float("inf")
@@ -247,7 +257,8 @@ class Experiment:
             best_val = val_loss
         if (improved or force_save) and cfg.get("save_model", True):
             ckpt_lib.save_checkpoint(self.ckpt_dir, self.state,
-                                     best_val=best_val)
+                                     best_val=best_val,
+                                     manifest_extra=self._manifest_extra())
             ckpt_lib.write_sidecars(
                 self.weights_root, self.model_name, cfg, self.pc_config,
                 iteration=i + 1, total_iterations=iterations,
@@ -379,7 +390,8 @@ class Experiment:
             if checkpoint_every and (j + 1) % checkpoint_every == 0:
                 ckpt_lib.save_checkpoint(
                     os.path.join(self.ckpt_dir, "periodic"), self.state,
-                    extra_meta={"kind": "periodic"})
+                    extra_meta={"kind": "periodic"},
+                    manifest_extra=self._manifest_extra())
 
             ve = get_validate_every(j, iterations, cfg.validate_every,
                                     cfg.get("decrease_val_steps", True))
@@ -453,7 +465,8 @@ class Experiment:
                 try:
                     ckpt_lib.save_checkpoint(
                         emergency, self.state,
-                        extra_meta={"kind": "emergency", "error": repr(e)})
+                        extra_meta={"kind": "emergency", "error": repr(e)},
+                        manifest_extra=self._manifest_extra())
                     color_print(f"crash at step {int(self.state.step)}; "
                                 f"state saved to {emergency}", "red",
                                 bold=True)

@@ -31,19 +31,24 @@ from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
 from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
                                       PlacementPlan, RebalanceTrigger,
                                       plan_placement)
-from dsin_tpu.serve.router import AdmissionController, FrontDoorRouter
+from dsin_tpu.serve.router import (AdmissionController, AggregatedMetrics,
+                                   FleetSwapError, FrontDoorRouter)
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
+from dsin_tpu.serve.swap import ModelBundle, SwapCoordinator, SwapError
+from dsin_tpu.train.checkpoint import ManifestMismatch
 from dsin_tpu.utils.integrity import IntegrityError
 
 __all__ = [
     "BULK", "INTERACTIVE",
-    "AdmissionController", "BucketPolicy", "CompressionService",
-    "DeadlineExceeded", "DevicePlacement", "EncodeResult",
-    "FrontDoorRouter", "Future", "IntegrityError", "MetricsRegistry",
-    "MetricsServer", "MicroBatcher", "NoBucketFits", "PlacementError",
-    "PlacementPlan", "PriorityClass", "RebalanceTrigger", "Request",
-    "ServeError", "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
-    "ServiceUnavailable", "crop_from_bucket",
+    "AdmissionController", "AggregatedMetrics", "BucketPolicy",
+    "CompressionService", "DeadlineExceeded", "DevicePlacement",
+    "EncodeResult", "FleetSwapError", "FrontDoorRouter", "Future",
+    "IntegrityError", "ManifestMismatch", "MetricsRegistry",
+    "MetricsServer", "MicroBatcher", "ModelBundle", "NoBucketFits",
+    "PlacementError", "PlacementPlan", "PriorityClass",
+    "RebalanceTrigger", "Request", "ServeError", "ServiceConfig",
+    "ServiceDraining", "ServiceOverloaded", "ServiceUnavailable",
+    "SwapCoordinator", "SwapError", "crop_from_bucket",
     "default_priority_classes", "pad_to_bucket", "plan_placement",
 ]

@@ -193,29 +193,35 @@ class MetricsRegistry:
         }
 
     def render_text(self) -> str:
-        snap = self.snapshot()
-        lines = []
-        for k, v in snap["info"].items():
-            lines.append(f"# {k} {json.dumps(v, sort_keys=True)}")
-        for k, v in snap["counters"].items():
-            lines.append(f"{k}_total {v}")
-        for k, v in snap["gauges"].items():
-            lines.append(f"{k} {v:g}")
-        for k, v in snap["accumulators"].items():
-            lines.append(f"{k} {v:g}")
-        for k, s in snap["histograms"].items():
-            lines.append(f"{k}_count {s['count']}")
-            for stat in ("mean", "p50", "p99"):
-                lines.append(f"{k}_{stat} {s[stat]:g}")
-        for name, s in snap["locks"].items():
-            stem = "lock_" + name.replace(".", "_")
-            lines.append(f"{stem}_acquisitions_total "
-                         f"{s['acquisitions']}")
-            lines.append(f"{stem}_contentions_total {s['contentions']}")
-            lines.append(f"{stem}_hold_ms_total {s['hold_ms_total']:g}")
-        lines.append(f"lock_order_inversions_total "
-                     f"{snap['lock_order_inversions']}")
-        return "\n".join(lines) + "\n"
+        return render_snapshot_text(self.snapshot())
+
+
+def render_snapshot_text(snap: dict) -> str:
+    """Snapshot dict -> the prometheus-ish text format. Module-level so
+    the router's AGGREGATED (fleet-merged) snapshot renders through the
+    identical formatter as a single service's — one text dialect."""
+    lines = []
+    for k, v in snap["info"].items():
+        lines.append(f"# {k} {json.dumps(v, sort_keys=True)}")
+    for k, v in snap["counters"].items():
+        lines.append(f"{k}_total {v}")
+    for k, v in snap["gauges"].items():
+        lines.append(f"{k} {v:g}")
+    for k, v in snap["accumulators"].items():
+        lines.append(f"{k} {v:g}")
+    for k, s in snap["histograms"].items():
+        lines.append(f"{k}_count {s['count']}")
+        for stat in ("mean", "p50", "p99"):
+            lines.append(f"{k}_{stat} {s[stat]:g}")
+    for name, s in snap.get("locks", {}).items():
+        stem = "lock_" + name.replace(".", "_")
+        lines.append(f"{stem}_acquisitions_total "
+                     f"{s['acquisitions']}")
+        lines.append(f"{stem}_contentions_total {s['contentions']}")
+        lines.append(f"{stem}_hold_ms_total {s['hold_ms_total']:g}")
+    lines.append(f"lock_order_inversions_total "
+                 f"{snap.get('lock_order_inversions', 0)}")
+    return "\n".join(lines) + "\n"
 
 
 class MetricsServer:

@@ -47,6 +47,31 @@ pipe messages and the config):
                                    │ /healthz  │ │ /healthz  │
                                    └───────────┘ └───────────┘
 
+Fleet-coordinated hot swap (ISSUE 9): `swap_model(ckpt_dir)` drives the
+service-level swap primitives (serve/swap.py) as a TWO-PHASE commit so
+the fleet can never settle on two models. Phase 1 (prepare): every live
+replica loads + manifest-verifies + warms the incoming checkpoint in
+the background of its own traffic and reports the digest it built.
+Phase 2 (commit): only on a UNANIMOUS digest match, the router briefly
+gates new dispatches (commits are O(1) pointer swaps, so the gate holds
+for milliseconds) and tells every replica to commit exactly that
+digest. Any prepare failure — a typed ManifestMismatch, a replica dying
+mid-prepare — aborts the whole fleet (staged bundles discarded, old
+params keep serving); a commit failure rolls the already-committed
+replicas BACK, converging on the old model rather than a split fleet.
+Control ops ride the same pipes as requests but are never rerouted on
+replica death — a dead replica fails ITS phase, typed. `rollback()`
+fan-outs the instant per-replica rollback the same way. Router-side
+evidence: `serve_router_swaps` / `serve_router_swap_aborts` /
+`serve_router_rollbacks` counters and the refreshed fleet digest.
+
+Router-level /metrics aggregation (the PR 8 follow-up): pass
+`metrics_port` and the router serves ONE endpoint merging every
+replica's snapshot — counters/gauges/accumulators summed, histograms
+merged (count-weighted mean; p50/p99 as the fleet-wide max, the
+conservative operator view), per-replica model digests + scrape health
+in the info section — so operators stop polling N ports.
+
 Locks (utils/locks.py ranks): `serve.frontdoor` (4) guards the replica
 state table and the per-class rr counters; `serve.replica` (6) guards
 each replica's in-flight map and serializes its pipe sends;
@@ -57,6 +82,7 @@ batcher condition (a shed resolves the victim's future there).
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -69,6 +95,29 @@ from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future,
                                     ServiceOverloaded, ServiceUnavailable)
 from dsin_tpu.utils import locks as locks_lib
+
+#: pipe ops that drive the two-phase hot swap instead of carrying a
+#: request; they target a SPECIFIC replica and are never rerouted on
+#: death — a dead replica fails its swap phase, typed
+CONTROL_OPS = frozenset(
+    {"swap_prepare", "swap_commit", "swap_abort", "rollback"})
+
+#: how long _dispatch will wait on the commit gate before proceeding
+#: anyway (fail-open: a wedged swap must degrade to pre-swap routing,
+#: never to a frozen front door)
+_SWAP_GATE_TIMEOUT_S = 10.0
+
+
+class FleetSwapError(RuntimeError):
+    """A fleet-coordinated swap did not converge on the NEW model: a
+    prepare failed or disagreed (fleet aborted, old model serving), or
+    a commit failed partway (committed replicas rolled back). Carries
+    `per_replica` — {replica_idx: outcome-or-exception} — so the
+    operator sees exactly which replica refused and why."""
+
+    def __init__(self, msg: str, per_replica: Optional[Dict] = None):
+        super().__init__(msg)
+        self.per_replica = dict(per_replica or {})
 
 
 def default_admission_limits(config) -> Dict[str, int]:
@@ -173,7 +222,6 @@ def _replica_main(conn, config, replica_id: int) -> None:
     callbacks through a single sender thread so pipe writes never
     interleave and never run under a ranked lock) until "stop" or
     router death (EOF), then a graceful drain."""
-    from dsin_tpu.coding import loader as loader_lib
     from dsin_tpu.serve.service import CompressionService
     try:
         cfg = replace(config, metrics_port=0)
@@ -183,8 +231,10 @@ def _replica_main(conn, config, replica_id: int) -> None:
                 "healthz_port": service._metrics_server.port,
                 "warmup_compiles": warm["compiles"],
                 "warmup_cache_hits": warm["cache_hits"],
-                "params_digest": loader_lib.params_digest(
-                    (service.state.params, service.state.batch_stats))}
+                # the service's cached bundle digest IS
+                # coding/loader.py params_digest over (params,
+                # batch_stats) — one digest story everywhere
+                "params_digest": service.model_digest}
     except BaseException as e:  # noqa: BLE001 — the router needs the cause
         try:
             conn.send(("failed", replica_id, _picklable_exc(e)))
@@ -215,6 +265,25 @@ def _replica_main(conn, config, replica_id: int) -> None:
         else:
             outq.put(("err", rid, _picklable_exc(exc)))
 
+    def _run_control(op, rid, payload):
+        """One hot-swap phase against this replica's service; the
+        outcome (or its typed error — ManifestMismatch, SwapError)
+        crosses the pipe like any response."""
+        try:
+            if op == "swap_prepare":
+                res = service.prepare_swap(payload)
+            elif op == "swap_commit":
+                res = service.commit_swap(expect_digest=payload)
+            elif op == "swap_abort":
+                res = service.abort_swap()
+            else:                            # "rollback"
+                # payload = digest to roll AWAY from (conditional, the
+                # fleet commit-failure recovery) or None (operator)
+                res = service.rollback(expect_current=payload)
+            outq.put(("ok", rid, res))
+        except BaseException as e:  # noqa: BLE001 — router needs the cause
+            outq.put(("err", rid, _picklable_exc(e)))
+
     try:
         while True:
             try:
@@ -224,6 +293,22 @@ def _replica_main(conn, config, replica_id: int) -> None:
             if msg[0] == "stop":
                 break
             op, rid, payload, priority, deadline_ms = msg
+            if op in CONTROL_OPS:
+                if op == "swap_prepare":
+                    # prepare is the slow phase (load + census warm):
+                    # run it OFF the recv loop so requests keep flowing
+                    # — the zero-downtime half of the contract. The
+                    # service's own claim flag serializes overlapping
+                    # prepares (the second fails typed).
+                    threading.Thread(
+                        target=_run_control, args=(op, rid, payload),
+                        name=f"replica-{replica_id}-swap",
+                        daemon=True).start()
+                else:
+                    # commit/abort/rollback are O(1) pointer swaps —
+                    # inline keeps them ordered with request intake
+                    _run_control(op, rid, payload)
+                continue
             try:
                 if op == "encode":
                     fut = service.submit_encode(
@@ -324,7 +409,8 @@ class FrontDoorRouter:
                  admission_limits: Optional[Mapping[str, int]] = None,
                  poll_every_s: float = 0.25, evict_after: int = 2,
                  death_retries: int = 1, health_timeout_s: float = 2.0,
-                 start_timeout_s: float = 600.0, launcher=None):
+                 start_timeout_s: float = 600.0, launcher=None,
+                 metrics_port: Optional[int] = None):
         if replicas < 1:
             raise ValueError(f"need at least one replica, got {replicas}")
         if evict_after < 1:
@@ -367,6 +453,17 @@ class FrontDoorRouter:
         self._poller: Optional[threading.Thread] = None
         self._started = False
         self.params_digest: Optional[str] = None
+        self._swapping = False             # guarded-by: self._lock
+        # set = dispatch flows; cleared only for the fleet COMMIT window
+        # (O(1) per replica), so "the fleet serves two models at once"
+        # has no dispatch to land in. Fail-open after a bounded wait.
+        self._swap_gate = threading.Event()
+        self._swap_gate.set()
+        self.metrics_port = metrics_port
+        self._metrics_server: Optional[metrics_lib.MetricsServer] = None
+        #: the fleet-merged metrics view (the one-endpoint aggregation);
+        #: usable directly (`.snapshot()`) or served via `metrics_port`
+        self.aggregate = AggregatedMetrics(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -407,6 +504,10 @@ class FrontDoorRouter:
                                         name="router-health", daemon=True)
         self._poller.start()
         self.metrics.gauge("serve_router_replicas").set(self.num_replicas)
+        if self.metrics_port is not None:
+            self._metrics_server = metrics_lib.MetricsServer(
+                self.aggregate, self.health,
+                port=self.metrics_port).start()
         self._started = True
         return self
 
@@ -517,7 +618,10 @@ class FrontDoorRouter:
     def _dispatch(self, pending: _Pending) -> None:
         """Route to the class's next live replica; a send that discovers
         a dead pipe marks the replica and moves on. Raises typed
-        ServiceUnavailable when no live replica accepts the send."""
+        ServiceUnavailable when no live replica accepts the send.
+        Briefly parks on the swap gate during a fleet commit (the
+        never-two-models window), failing OPEN after a bounded wait."""
+        self._swap_gate.wait(_SWAP_GATE_TIMEOUT_S)
         for _ in range(self.num_replicas):
             picked = self._pick(pending.priority)
             if picked is None:
@@ -591,6 +695,14 @@ class FrontDoorRouter:
         for _rid, pending in orphans:
             if pending.future.done():
                 continue
+            if pending.op in CONTROL_OPS:
+                # a swap phase is pinned to ITS replica — rerouting a
+                # prepare/commit to a different process would corrupt
+                # the two-phase bookkeeping; the coordinator (swap_model)
+                # sees the typed failure and aborts the fleet
+                pending.future.set_exception(ServiceUnavailable(
+                    f"replica {rep.idx} died during {pending.op}"))
+                continue
             rem = pending.remaining_ms()
             if rem is not None and rem <= 0.0:
                 # budget spent while the dead replica held it: expire
@@ -615,21 +727,188 @@ class FrontDoorRouter:
                 f"replica {rep.idx} went away with this request in "
                 f"flight" + ("" if draining else " (no retry left)")))
 
+    # -- fleet-coordinated hot swap (ISSUE 9) --------------------------------
+
+    def _control(self, rep: _Replica, op: str, payload=None) -> Future:
+        """Ship one swap-phase op to a SPECIFIC replica; the returned
+        future resolves with the replica's outcome dict, or typed
+        ServiceUnavailable if it dies first (never rerouted)."""
+        pending = _Pending(op, payload, "control", None, 0)
+        with self._lock:
+            rid = self._next_rid_locked()
+        sent = False
+        with rep.lock:
+            rep.inflight[rid] = pending
+            try:
+                rep.conn.send((op, rid, payload, None, None))
+                sent = True
+            except (OSError, ValueError, BrokenPipeError):
+                del rep.inflight[rid]
+        if not sent:
+            self._on_disconnect(rep)
+            pending.future.set_exception(ServiceUnavailable(
+                f"replica {rep.idx} pipe is gone — cannot drive {op}"))
+        return pending.future
+
+    def _live_replicas(self) -> List[_Replica]:
+        with self._lock:
+            return [rep for rep in self._replicas
+                    if self._state.get(rep.idx) == "live"]
+
+    def _broadcast(self, reps, op: str, payload, timeout_s: float):
+        """op to every rep; returns ({idx: result}, {idx: exception})."""
+        futs = [(rep, self._control(rep, op, payload)) for rep in reps]
+        deadline = time.monotonic() + timeout_s
+        results: Dict[int, Any] = {}
+        errors: Dict[int, BaseException] = {}
+        for rep, fut in futs:
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                exc = fut.exception(timeout=remaining)
+            except TimeoutError:
+                errors[rep.idx] = TimeoutError(
+                    f"replica {rep.idx} did not answer {op} within "
+                    f"{timeout_s}s")
+                continue
+            if exc is None:
+                results[rep.idx] = fut.result(timeout=0)
+            else:
+                errors[rep.idx] = exc
+        return results, errors
+
+    def swap_model(self, ckpt_dir: str, prepare_timeout_s: float = 600.0,
+                   commit_timeout_s: float = 60.0) -> dict:
+        """Two-phase fleet hot swap. Prepare on every live replica
+        (each loads + manifest-verifies + warms in the background of
+        its own traffic and reports the digest it built); commit only
+        on a UNANIMOUS digest, under the brief dispatch gate. Any
+        prepare failure aborts the whole fleet back to the old model;
+        a commit failure rolls the committed replicas back — the fleet
+        converges on ONE model either way, and this raises typed
+        FleetSwapError naming each replica's outcome. Only LIVE
+        replicas participate: one that sits out a swap evicted is
+        refused readmission while its digest disagrees with the
+        fleet's (`serve_router_digest_skew`) — re-swap or restart it."""
+        assert self._started, "start() the router before swapping"
+        with self._lock:
+            if self._swapping:
+                raise FleetSwapError("a fleet swap is already in flight "
+                                     "— one at a time")
+            self._swapping = True
+        try:
+            reps = self._live_replicas()
+            if not reps:
+                raise ServiceUnavailable("no live replica to swap")
+            prepared, errors = self._broadcast(
+                reps, "swap_prepare", ckpt_dir, prepare_timeout_s)
+            digests = {info["digest"] for info in prepared.values()}
+            if errors or len(digests) != 1:
+                # abort EVERY replica, not just the ones that answered:
+                # a replica whose prepare merely TIMED OUT may still
+                # stage later — the abort cancels the in-flight prepare
+                # (SwapCoordinator refuses the late stage) so it cannot
+                # park a bundle that would wedge every future swap.
+                # Abort is a safe no-op where nothing is staged.
+                self._broadcast(reps, "swap_abort", None,
+                                commit_timeout_s)
+                self.metrics.counter("serve_router_swap_aborts").inc()
+                outcome = {i: f"prepared digest "
+                              f"{prepared[i]['digest']}"
+                           for i in prepared}
+                outcome.update({i: e for i, e in errors.items()})
+                raise FleetSwapError(
+                    f"fleet prepare did not converge (digests "
+                    f"{sorted(digests)!r}, {len(errors)} failure(s)) — "
+                    f"aborted; every replica still serves the old "
+                    f"model", per_replica=outcome)
+            digest = digests.pop()
+            # the never-two-models window: dispatch parks while every
+            # replica executes its O(1) commit of the SAME digest
+            self._swap_gate.clear()
+            try:
+                committed, commit_errors = self._broadcast(
+                    reps, "swap_commit", digest, commit_timeout_s)
+            finally:
+                self._swap_gate.set()
+            if commit_errors:
+                # converge DOWN. A commit that merely TIMED OUT may
+                # still land later (the pipe is FIFO), so recovery for
+                # the errored replicas is abort (clears a still-staged
+                # bundle — the late commit then finds nothing) followed
+                # by a CONDITIONAL rollback sent to EVERYONE: it only
+                # fires where the serving digest IS the new one (a
+                # late commit that did land gets rolled back; a replica
+                # that never committed refuses typed). Either way each
+                # replica ends on the OLD model.
+                abort_reps = [r for r in reps if r.idx in commit_errors]
+                self._broadcast(abort_reps, "swap_abort", None,
+                                commit_timeout_s)
+                self._broadcast(reps, "rollback", digest,
+                                commit_timeout_s)
+                self.metrics.counter("serve_router_swap_aborts").inc()
+                outcome = {i: "committed, rolled back" for i in committed}
+                outcome.update({i: e for i, e in commit_errors.items()})
+                raise FleetSwapError(
+                    f"fleet commit failed on {len(commit_errors)} "
+                    f"replica(s) — committed replicas rolled back; the "
+                    f"fleet serves the OLD model", per_replica=outcome)
+            self.params_digest = digest
+            self.metrics.counter("serve_router_swaps").inc()
+            return {"digest": digest,
+                    "replicas": sorted(committed),
+                    "prepare": prepared}
+        finally:
+            with self._lock:
+                self._swapping = False
+
+    def rollback(self, timeout_s: float = 60.0) -> dict:
+        """Fleet-wide instant rollback (every replica re-instates its
+        warm previous bundle) under the same dispatch gate. Partial
+        failure raises FleetSwapError — the operator must know the
+        fleet split rather than discover it as bit-identity flakes."""
+        assert self._started, "start() the router before rollback"
+        reps = self._live_replicas()
+        if not reps:
+            raise ServiceUnavailable("no live replica to roll back")
+        self._swap_gate.clear()
+        try:
+            results, errors = self._broadcast(reps, "rollback", None,
+                                              timeout_s)
+        finally:
+            self._swap_gate.set()
+        digests = {info["digest"] for info in results.values()}
+        if errors or len(digests) != 1:
+            self.metrics.counter("serve_router_swap_aborts").inc()
+            outcome = {i: f"rolled back to {results[i]['digest']}"
+                       for i in results}
+            outcome.update({i: e for i, e in errors.items()})
+            raise FleetSwapError(
+                f"fleet rollback did not converge (digests "
+                f"{sorted(digests)!r}, {len(errors)} failure(s))",
+                per_replica=outcome)
+        self.params_digest = digests.pop()
+        self.metrics.counter("serve_router_rollbacks").inc()
+        return {"digest": self.params_digest, "replicas": sorted(results)}
+
     # -- health -------------------------------------------------------------
 
-    def _healthz_ok(self, rep: _Replica) -> bool:
-        """One /healthz poll. Replicas without a port (test fakes)
-        count as healthy while their transport lives."""
+    def _healthz_ok(self, rep: _Replica):
+        """One /healthz poll -> (ok, serving_model_digest). Replicas
+        without a port (test fakes) count as healthy while their
+        transport lives, with no digest claim."""
         port = (rep.info or {}).get("healthz_port")
         if port is None:
-            return rep.proc is None or rep.proc.is_alive()
+            return (rep.proc is None or rep.proc.is_alive()), None
         try:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/healthz",
                     timeout=self.health_timeout_s) as resp:
-                return resp.status == 200
+                if resp.status != 200:
+                    return False, None
+                body = json.loads(resp.read().decode("utf-8"))
+                return True, (body.get("model") or {}).get("digest")
         except Exception:   # noqa: BLE001 — any poll failure is a failure
-            return False
+            return False, None
 
     def _poll_loop(self) -> None:
         """Eviction/readmission: `evict_after` consecutive failed polls
@@ -642,13 +921,25 @@ class FrontDoorRouter:
                     state = self._state.get(rep.idx)
                 if state == "dead":
                     continue
-                ok = self._healthz_ok(rep)   # no locks across the poll
+                # no locks across the poll
+                ok, digest = self._healthz_ok(rep)
                 with self._lock:
                     if self._state.get(rep.idx) == "dead":
                         continue
                     if ok:
                         self._fails[rep.idx] = 0
                         if self._state[rep.idx] == "evicted":
+                            if (digest is not None
+                                    and self.params_digest is not None
+                                    and digest != self.params_digest):
+                                # healthy but serving the WRONG model —
+                                # it missed a fleet swap while evicted.
+                                # Readmitting it would split the fleet;
+                                # keep it out and surface the skew for
+                                # the operator (re-swap or restart it).
+                                self.metrics.counter(
+                                    "serve_router_digest_skew").inc()
+                                continue
                             self._state[rep.idx] = "live"
                             self.metrics.counter(
                                 "serve_router_readmissions").inc()
@@ -668,7 +959,8 @@ class FrontDoorRouter:
         status = ("ok" if live == len(states)
                   else "degraded" if live else "unhealthy")
         return {"status": status, "live": live, "replicas": states,
-                "outstanding": self.admission.outstanding()}
+                "outstanding": self.admission.outstanding(),
+                "params_digest": self.params_digest}
 
     # -- shutdown -----------------------------------------------------------
 
@@ -677,6 +969,10 @@ class FrontDoorRouter:
         queued work resolves typed there and the answers flow back),
         join, then fail anything still unresolved — no hung futures."""
         self._stop.set()
+        self._swap_gate.set()     # never strand a dispatcher on drain
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         if self._poller is not None:
             self._poller.join(timeout=timeout_s)
         for rep in self._replicas:
@@ -704,3 +1000,116 @@ class FrontDoorRouter:
                 if not pending.future.done():
                     pending.future.set_exception(ServiceUnavailable(
                         "front door drained with this request in flight"))
+
+
+# -- router-level /metrics aggregation (ISSUE 9 satellite) --------------------
+
+class AggregatedMetrics:
+    """ONE fleet-wide metrics view: the router's own registry merged
+    with a live scrape of every replica's `/metrics?format=json`.
+
+    Merge rules (each scrape is a fresh fan-out — no caching, matching
+    a single service's scrape semantics): counters, gauges, and
+    accumulators SUM across the router + replicas (queue depths, worker
+    counts, stage milliseconds all add meaningfully); histograms merge
+    as total count, count-weighted mean, and the fleet-wide MAX p50/p99
+    (quantiles do not compose exactly from summaries, and for an
+    operator's SLO view the worst replica is the honest aggregate —
+    per-replica values remain one port away). The info section carries
+    the router's own info, each replica's scraped info + model digest
+    (the fleet-version-skew view the two-phase swap maintains), and
+    which replicas failed to answer the scrape. Duck-types the
+    `MetricsRegistry` surface `MetricsServer` needs (`snapshot()` /
+    `render_text()`), so `FrontDoorRouter(metrics_port=...)` serves it
+    over the standard endpoint."""
+
+    def __init__(self, router: "FrontDoorRouter"):
+        self._router = router
+
+    def _scrape(self, rep: _Replica) -> Optional[dict]:
+        port = (rep.info or {}).get("healthz_port")
+        if port is None:
+            return None
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=json",
+                timeout=self._router.health_timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def snapshot(self) -> dict:
+        own = self._router.metrics.snapshot()
+        counters = dict(own["counters"])
+        gauges = dict(own["gauges"])
+        accumulators = dict(own["accumulators"])
+        # histogram partials: name -> [count_total, weighted_sum, p50s, p99s]
+        hist: Dict[str, list] = {
+            k: [s["count"], s["mean"] * s["count"], [s["p50"]], [s["p99"]]]
+            for k, s in own["histograms"].items()}
+        per_replica_info: Dict[str, dict] = {}
+        digests: Dict[str, Optional[str]] = {}
+        unreachable = []
+        # fan the scrapes out: unreachable replicas each burn up to
+        # health_timeout_s, and paying that N times IN SERIES would
+        # blow the operator's scrape interval — concurrent GETs bound
+        # the endpoint at ~one timeout total
+        from concurrent.futures import ThreadPoolExecutor
+
+        def _safe_scrape(rep):
+            try:
+                return self._scrape(rep)
+            except Exception:   # noqa: BLE001 — a dead scrape is data
+                return None
+        replicas = list(self._router._replicas)
+        with ThreadPoolExecutor(
+                max_workers=max(1, len(replicas))) as pool:
+            snaps = list(pool.map(_safe_scrape, replicas))
+        for rep, snap in zip(replicas, snaps):
+            if snap is None:
+                unreachable.append(rep.idx)
+                digests[str(rep.idx)] = (rep.info or {}).get(
+                    "params_digest")
+                continue
+            for k, v in snap.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for k, v in snap.get("gauges", {}).items():
+                gauges[k] = gauges.get(k, 0.0) + v
+            for k, v in snap.get("accumulators", {}).items():
+                accumulators[k] = accumulators.get(k, 0.0) + v
+            for k, s in snap.get("histograms", {}).items():
+                part = hist.setdefault(k, [0, 0.0, [], []])
+                part[0] += s["count"]
+                part[1] += s["mean"] * s["count"]
+                part[2].append(s["p50"])
+                part[3].append(s["p99"])
+            info = snap.get("info", {})
+            per_replica_info[str(rep.idx)] = info
+            model = info.get("serve_model_digest") or {}
+            digests[str(rep.idx)] = (model.get("digest")
+                                     or (rep.info or {}).get(
+                                         "params_digest"))
+        histograms = {
+            k: {"count": c,
+                "mean": (wsum / c) if c else 0.0,
+                "p50": max(p50s) if p50s else 0.0,
+                "p99": max(p99s) if p99s else 0.0}
+            for k, (c, wsum, p50s, p99s) in sorted(hist.items())}
+        return {
+            "info": {
+                "router": own["info"],
+                "replica_digests": digests,
+                "per_replica": per_replica_info,
+                "replicas_scraped": len(per_replica_info),
+                "replicas_unreachable": unreachable,
+            },
+            "counters": dict(sorted(counters.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "accumulators": dict(sorted(accumulators.items())),
+            "histograms": histograms,
+            # lock ledgers are per-process by nature; the aggregate
+            # carries the ROUTER process's own (each replica's stay on
+            # its port)
+            "locks": own["locks"],
+            "lock_order_inversions": own["lock_order_inversions"],
+        }
+
+    def render_text(self) -> str:
+        return metrics_lib.render_snapshot_text(self.snapshot())

@@ -115,6 +115,28 @@ traffic, warming any pair new to the plan BEFORE the atomic table swap.
 Per-device observability: `serve_devices`, `serve_device_batches_d<i>`,
 `serve_device_busy_ms_d<i>`, `serve_placement_rebalances`, and the
 `serve_device_assignments` census in the /metrics info section.
+
+Live model operations (ISSUE 9): the model is no longer frozen at
+start(). Everything a batch reads about "the model" — per-device
+replicated params, the host codec, per-thread codec clones, the
+process-backend worker pool — lives in ONE immutable `ModelBundle`
+(serve/swap.py), captured once per batch, so a batch is version-
+coherent by construction. `swap_model(ckpt_dir)` loads the incoming
+checkpoint (manifest-verified: typed `ManifestMismatch` on wrong
+params/pc-config/bucket ladder), warms it against the live executable
+census in the BACKGROUND of serving traffic (executables are
+shape-keyed, params are arguments — the warm re-uses every compiled
+program, so `CompilationSentinel(budget=0)` holds through and after
+the swap), then commits with an O(1) pointer swap under the ranked
+`serve.model` lock while in-flight batches finish on the bundle they
+started with. The displaced model stays WARM in the `prev` slot:
+`rollback()` re-instates it in milliseconds with zero compiles. Swap
+observability: `serve_swaps` / `serve_rollbacks` / `serve_swap_errors`
+counters, the `serve_swap_state` gauge (0 idle / 1 preparing /
+2 staged), the `serve_model_digest` info entry, and a `model` section
+in /healthz. The `serve.swap` fault site (prepare + commit windows)
+lets chaos_bench kill a swap at its narrowest points and assert the
+service keeps serving the old params.
 """
 
 from __future__ import annotations
@@ -135,6 +157,7 @@ from dsin_tpu.serve import buckets as buckets_lib
 from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve import placement as placement_lib
 from dsin_tpu.serve import router as router_lib
+from dsin_tpu.serve import swap as swap_lib
 from dsin_tpu.serve.batcher import (Future, MicroBatcher, PriorityClass,
                                     Request, ServiceDraining,
                                     ServiceUnavailable)
@@ -247,6 +270,10 @@ class EncodeResult:
     bpp: float             # payload bits over ORIGINAL h*w pixels
     shape: Tuple[int, int]
     bucket: Tuple[int, int]
+    #: digest of the model bundle that produced this stream (ISSUE 9):
+    #: during a hot swap, every response is attributable to exactly one
+    #: model version — the no-torn-batch evidence tests/chaos read
+    model_digest: Optional[str] = None
 
 
 def frame_stream(payload: bytes, shape: Tuple[int, int],
@@ -355,15 +382,18 @@ class _Inflight:
     finishing it (wait for entropy tasks; decode's device stage) and the
     per-batch ledger the stage metrics come from."""
 
-    __slots__ = ("kind", "batch", "bucket", "t0", "device", "tasks",
-                 "handle", "sym", "per_item_exc", "crash")
+    __slots__ = ("kind", "batch", "bucket", "t0", "device", "bundle",
+                 "tasks", "handle", "sym", "per_item_exc", "crash")
 
-    def __init__(self, kind, batch, bucket, t0, device):
+    def __init__(self, kind, batch, bucket, t0, device, bundle):
         self.kind = kind
         self.batch = batch
         self.bucket = bucket
         self.t0 = t0
         self.device = device   # executor's device index (placement)
+        #: the ONE ModelBundle every stage of this batch reads — version
+        #: coherence across a hot swap is this capture (serve/swap.py)
+        self.bundle = bundle
         self.tasks = []
         self.handle: Optional[_DeviceBatch] = None   # encode
         self.sym: Optional[np.ndarray] = None        # decode gather
@@ -416,23 +446,44 @@ class CompressionService:
         self._batch_hook = None   # test/diagnostic: called with each batch
         self._entropy_hook = None  # test/diagnostic: called per pool task
         self._entropy_pool: Optional[ThreadPoolExecutor] = None
-        # "process"-backend ProcessPoolExecutor. A child segfault/OOM-kill
-        # marks the whole executor broken forever, so bridge threads swap
-        # in a fresh pool on that signal (_proc_call) — hence the lock.
-        self._proc_lock = locks_lib.RankedLock("serve.entropy_proc")
-        self._entropy_proc = None   # guarded-by: self._proc_lock
-        self._proc_initargs = None  # written once in start(), then read-only
+        # "process"-backend pools live INSIDE each ModelBundle
+        # (serve/swap.py): a hot swap gives the incoming model its own
+        # worker-resident codecs, so a batch's entropy stage always
+        # matches its device stage's params
+        self._proc_backend = False
         self._proc_warm = []        # warmup's worker-residence pings
         self._codec_local = threading.local()
         self.placement: Optional[placement_lib.DevicePlacement] = None
         self._num_devices = 1
         self._total_workers = 0
-        # (bucket, device) pairs whose two executables exist — mutated
-        # only by warmup()/rebalance_placement() on the caller's thread
-        self._warmed_pairs = set()
+        # (bucket, device) pairs whose two executables exist. COPY-ON-
+        # WRITE (rebound, never mutated in place): warmup()/
+        # rebalance_placement()/prepare_swap() run on different threads
+        # (operator, supervisor auto-tick, a replica's swap thread) and
+        # a reader iterating a live set while another thread .add()s
+        # would raise mid-iteration — snapshot with one attribute read
+        self._warmed_pairs = frozenset()
+        self._warm_shapes = []      # per-bucket (D, H, W) volume shapes
         self.model = None
-        self.state = None
-        self.codec = None
+        #: the hot-swap state machine; current/prev/staged ModelBundles
+        self._swap: Optional[swap_lib.SwapCoordinator] = None
+
+    # -- model state (always the CURRENT bundle's view) ----------------------
+
+    @property
+    def state(self):
+        """Host-side TrainState of the model currently serving."""
+        return self._swap.current.state if self._swap is not None else None
+
+    @property
+    def codec(self):
+        return self._swap.current.codec if self._swap is not None else None
+
+    @property
+    def model_digest(self) -> Optional[str]:
+        """coding/loader.py params_digest of the serving model — the
+        value the fleet handshake and the two-phase swap compare."""
+        return self._swap.current.digest if self._swap is not None else None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -468,17 +519,21 @@ class CompressionService:
                 skew_threshold=self.config.rebalance_skew_threshold,
                 hysteresis_checks=self.config.rebalance_hysteresis_checks,
                 cooldown_s=self.config.rebalance_cooldown_s)
+        from dsin_tpu.coding import loader as loader_lib
         from dsin_tpu.coding.loader import load_model_state, make_codec
         # init at the largest bucket; params are shape-independent (the
         # modules are fully convolutional) so every bucket shares them
         init_shape = self.policy.buckets[-1]
-        self.model, self.state = load_model_state(
+        self.model, state = load_model_state(
             self.config.ae_config, self.config.pc_config, self.config.ckpt,
             init_shape, need_sinet=False, seed=self.config.seed,
             persistent_cache=self.config.persistent_cache)
-        self.codec = make_codec(self.model, self.state)
+        codec = make_codec(self.model, state)
         self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
         self._bn_channels = int(self.model.ae_config.num_chan_bn)
+        sub = buckets_lib.SUBSAMPLING
+        self._warm_shapes = [(self._bn_channels, bh // sub, bw // sub)
+                             for bh, bw in self.policy.buckets]
         # ladder -> mesh: the routing table executors read, plus one
         # committed replica of (params, batch_stats) per serve device so
         # a dispatch never drags parameters across devices at call time
@@ -490,9 +545,8 @@ class CompressionService:
         self.placement = placement_lib.DevicePlacement(
             self.policy.buckets, num_devices=self._num_devices,
             weights=self.config.placement_weights)
-        self._device_state = [
-            self.placement.replicate(
-                d, (self.state.params, self.state.batch_stats))
+        device_state = [
+            self.placement.replicate(d, (state.params, state.batch_stats))
             for d in range(self._num_devices)]
         recompile.install()
         ew = self.config.entropy_workers
@@ -504,18 +558,21 @@ class CompressionService:
         if ew > 0:
             self._entropy_pool = ThreadPoolExecutor(
                 max_workers=ew, thread_name_prefix="serve-entropy")
-        if backend == "process":
-            from dsin_tpu.coding import loader as loader_lib
-            sub = buckets_lib.SUBSAMPLING
-            warm_shapes = [(self._bn_channels, bh // sub, bw // sub)
-                           for bh, bw in self.policy.buckets]
-            # the spec is built ONCE (numpy pulls happen here, on the
-            # caller's thread, never under _proc_lock) and reused by
-            # child-death rebuilds
-            self._proc_initargs = (loader_lib.make_codec_spec(self.codec),
-                                   warm_shapes)
-            with self._proc_lock:
-                self._entropy_proc = self._make_entropy_proc()
+        self._proc_backend = backend == "process"
+        initargs = None
+        if self._proc_backend:
+            # the spec is built per BUNDLE (numpy pulls happen here, on
+            # the caller's thread, never under the pool-slot lock) and
+            # reused by that bundle's child-death rebuilds
+            initargs = (loader_lib.make_codec_spec(codec),
+                        list(self._warm_shapes))
+        bundle = swap_lib.ModelBundle(
+            0, loader_lib.params_digest((state.params, state.batch_stats)),
+            state, codec, device_state, ckpt=self.config.ckpt,
+            proc_initargs=initargs)
+        if initargs is not None:
+            bundle.set_proc(self._make_entropy_proc(initargs))
+        self._swap = swap_lib.SwapCoordinator(bundle, self.metrics)
         self.metrics.set_info("serve_entropy_backend", {
             "backend": backend, "entropy_workers": ew,
             "pipeline_depth": self.config.pipeline_depth})
@@ -575,15 +632,15 @@ class CompressionService:
             # threads), so the first pipelined batch pays no lazy setup
             n = self._entropy_workers
             barrier = threading.Barrier(n)
+            bundle = self._swap.current
 
             def _prime():
                 barrier.wait(timeout=60)
-                self._thread_codec()
+                self._thread_codec(bundle)
 
             for f in [self._entropy_pool.submit(_prime) for _ in range(n)]:
                 f.result(timeout=120)
-        with self._proc_lock:
-            proc = self._entropy_proc
+        proc = self._swap.current.proc()
         if proc is not None:
             # spin every pool process up now (spawn + codec rebuild +
             # schedule warm happen in the initializer) so the first real
@@ -603,13 +660,19 @@ class CompressionService:
                 "cache_hits": cache_hits,
                 "seconds": time.monotonic() - t0}
 
-    def _warm_pair(self, bucket: Tuple[int, int], device: int) -> np.ndarray:
+    def _warm_pair(self, bucket: Tuple[int, int], device: int,
+                   bundle: Optional[swap_lib.ModelBundle] = None
+                   ) -> np.ndarray:
         """Compile/prime BOTH executables of one (bucket, device) census
         pair — the input shardings commit the jit cache entries to that
         device. Returns the encode symbols so warmup can prime the
-        bucket's entropy schedules."""
+        bucket's entropy schedules. With `bundle`, runs the SAME (shape-
+        keyed, already compiled) executables against an incoming model's
+        replicas — the hot-swap warm, zero new compiles."""
         bh, bw = bucket
-        params, bs = self._device_state[device]
+        if bundle is None:
+            bundle = self._swap.current
+        params, bs = bundle.device_state[device]
         x = self.placement.put_batch(
             device, np.zeros((self.config.max_batch, bh, bw, 3),
                              np.float32))
@@ -620,7 +683,9 @@ class CompressionService:
                  bw // buckets_lib.SUBSAMPLING, self._bn_channels),
                 np.int32))
         np.asarray(self._decode_fn(params, bs, sym))
-        self._warmed_pairs.add((bucket, device))
+        # copy-on-write rebind (see __init__): concurrent readers keep
+        # iterating their own snapshot
+        self._warmed_pairs = self._warmed_pairs | {(bucket, device)}
         return symbols
 
     def _publish_placement(self) -> None:
@@ -678,6 +743,140 @@ class CompressionService:
         return {"changed": changed, "warmed_pairs": len(new_pairs),
                 "assignments": plan.as_dict()}
 
+    # -- live model operations (ISSUE 9) -------------------------------------
+
+    def prepare_swap(self, ckpt_dir: str) -> dict:
+        """Load + warm an incoming checkpoint into a staged ModelBundle,
+        in the background of serving traffic (this runs on the CALLER's
+        thread; the dataplane keeps serving the current bundle
+        throughout). Manifest-verified: a wrong-params / wrong-pc-config
+        / wrong-ladder checkpoint raises typed ManifestMismatch and
+        nothing stages. The warm drives every already-compiled (bucket,
+        device) executable with the incoming replicas and primes a
+        fresh codec (+ process pool, when that backend is on) — zero
+        new XLA compiles, because executables are keyed by shapes and
+        params enter as arguments. Returns {"digest", "epoch", "ckpt",
+        "warm", "seconds"}; commit_swap() makes it live."""
+        assert self._started, "start() + warmup() before a hot swap"
+        from dsin_tpu.coding import loader as loader_lib
+        epoch = self._swap.begin_prepare()
+        t0 = time.monotonic()
+        bundle = None
+        try:
+            new_state, info = loader_lib.load_swap_state(
+                ckpt_dir, self.state,
+                pc_config=self.model.pc_config,
+                buckets=self.policy.buckets)
+            # the prepare window: a kill here must leave the service
+            # serving the old params with the claim released
+            faults.inject("serve.swap")
+            digest = loader_lib.params_digest(
+                (new_state.params, new_state.batch_stats))
+            codec = loader_lib.make_codec(self.model, new_state)
+            device_state = [
+                self.placement.replicate(
+                    d, (new_state.params, new_state.batch_stats))
+                for d in range(self._num_devices)]
+            initargs = None
+            if self._proc_backend:
+                initargs = (loader_lib.make_codec_spec(codec),
+                            list(self._warm_shapes))
+            bundle = swap_lib.ModelBundle(
+                epoch, digest, new_state, codec, device_state,
+                ckpt=ckpt_dir, proc_initargs=initargs,
+                manifest=info.get("manifest"))
+            if initargs is not None:
+                bundle.set_proc(self._make_entropy_proc(initargs))
+            warm = self._warm_bundle(bundle)
+            self._swap.stage(bundle)
+        except BaseException:
+            # InjectedCrash included: the kill-during-swap chaos
+            # contract is "still serving on the old params" — release
+            # the claim, retire the partial bundle, surface the cause
+            if bundle is not None:
+                bundle.retire()
+            self._swap.abandon_prepare()
+            raise
+        return {"digest": digest, "epoch": epoch, "ckpt": ckpt_dir,
+                "warm": warm,
+                "seconds": round(time.monotonic() - t0, 3)}
+
+    def _warm_bundle(self, bundle: swap_lib.ModelBundle) -> dict:
+        """Run the incoming bundle through the live executable census
+        (pages its replicas onto their devices; the jit cache serves
+        every call — zero compiles), prime its codec's schedule cache
+        with one entropy roundtrip per bucket, and spin up + ping its
+        process pool when that backend is on."""
+        from dsin_tpu.coding import loader as loader_lib
+        t0 = time.monotonic()
+        symbols_by_bucket = {}
+        for bucket, device in sorted(self._warmed_pairs):
+            symbols_by_bucket[bucket] = self._warm_pair(bucket, device,
+                                                        bundle=bundle)
+        for symbols in symbols_by_bucket.values():
+            stream = bundle.codec.encode(np.transpose(symbols[0], (2, 0, 1)))
+            bundle.codec.decode(stream)
+        pings = []
+        proc = bundle.proc()
+        if proc is not None:
+            futs = [proc.submit(loader_lib.worker_ping)
+                    for _ in range(self._entropy_workers)]
+            pings = [f.result(timeout=300) for f in futs]
+        return {"pairs": len(self._warmed_pairs),
+                "buckets": len(symbols_by_bucket),
+                "proc_workers": len(pings),
+                "seconds": round(time.monotonic() - t0, 3)}
+
+    def commit_swap(self, expect_digest: Optional[str] = None) -> dict:
+        """Make the staged bundle live: an O(1) pointer swap under the
+        ranked `serve.model` lock. In-flight batches finish on the
+        bundle they captured; the displaced model is retained warm for
+        rollback(). `expect_digest` pins which model the caller
+        believes it is committing (the fleet two-phase contract)."""
+        assert self._started, "start() before commit_swap()"
+        # the commit window: a kill HERE leaves current serving and the
+        # staged bundle parked (the caller aborts it)
+        faults.inject("serve.swap")
+        for b in self._swap.commit(expect_digest):
+            b.retire()
+        return self._swap.snapshot()
+
+    def abort_swap(self) -> dict:
+        """Discard the staged bundle (or release a dangling prepare
+        claim); safe to call when nothing is staged. The service keeps
+        serving the current bundle — aborting is never an outage."""
+        assert self._started, "start() before abort_swap()"
+        for b in self._swap.abort():
+            b.retire()
+        return self._swap.snapshot()
+
+    def swap_model(self, ckpt_dir: str) -> dict:
+        """The one-call operator hot swap: prepare (load + manifest
+        verify + background warm) then commit. Any failure — manifest
+        mismatch, injected kill in either window — aborts back to the
+        old params; the service never stops serving. The fleet router
+        (serve/router.py) drives the two phases separately instead."""
+        info = self.prepare_swap(ckpt_dir)
+        try:
+            self.commit_swap(expect_digest=info["digest"])
+        except BaseException:
+            self.abort_swap()
+            raise
+        return info
+
+    def rollback(self, expect_current: Optional[str] = None) -> dict:
+        """Re-instate the previous model bundle: instant (already warm,
+        zero compiles — its executables never left the jit cache, its
+        replicas never left their devices, its pool never died).
+        `expect_current` makes it conditional: only roll back if the
+        serving digest IS that one (the fleet commit-failure recovery —
+        a replica that never committed refuses typed instead of
+        re-instating some older model)."""
+        assert self._started, "start() before rollback()"
+        for b in self._swap.rollback(expect_current=expect_current):
+            b.retire()
+        return self._swap.snapshot()
+
     @property
     def draining(self) -> bool:
         return self._draining.is_set()
@@ -719,10 +918,11 @@ class CompressionService:
                 # workers flushed their pipelines before exiting, so the
                 # pool is idle; shutdown is immediate (and idempotent)
                 self._entropy_pool.shutdown(wait=True)
-            with self._proc_lock:
-                proc = self._entropy_proc
-            if proc is not None:
-                proc.shutdown(wait=True)
+            if self._swap is not None:
+                # every bundle (current/prev/staged) retires its
+                # process pool; workers joined, so the pools are idle
+                for b in self._swap.all_bundles():
+                    b.retire()
             if self._metrics_server is not None:
                 self._metrics_server.stop()
                 self._metrics_server = None
@@ -771,7 +971,10 @@ class CompressionService:
                 "workers_live": live,
                 "workers_configured": configured,
                 "worker_restarts":
-                    self.metrics.counter("serve_worker_restarts").value}
+                    self.metrics.counter("serve_worker_restarts").value,
+                # which model is serving + where a swap stands (ISSUE 9)
+                "model": (self._swap.snapshot()
+                          if self._swap is not None else {})}
 
     def _deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
         return (None if deadline_ms is None
@@ -1063,17 +1266,28 @@ class CompressionService:
         records (an idle device shows up as a flat line here)."""
         return self.metrics.accumulator(f"serve_device_busy_ms_d{device}")
 
-    def _thread_codec(self):
-        """Entropy-stage codec for the CURRENT thread: pool threads each
-        own a BottleneckCodec clone (per-pass rANS/buffer state stays
-        thread-private) sharing the service codec's schedule-cached,
-        lock-guarded incremental engine (coding/incremental.py)."""
+    def _thread_codec(self, bundle: swap_lib.ModelBundle):
+        """Entropy-stage codec for the CURRENT thread and the batch's
+        model bundle: pool threads each own a BottleneckCodec clone PER
+        EPOCH (per-pass rANS/buffer state stays thread-private; the
+        clone shares its bundle codec's schedule-cached, lock-guarded
+        incremental engine). Keying by epoch is the hot-swap coherence:
+        a thread coding an old-bundle batch keeps using the old model's
+        clone even after the swap commits. Clones of retired epochs are
+        pruned lazily against the coordinator's live set."""
         if self._entropy_pool is None:
-            return self.codec
-        codec = getattr(self._codec_local, "codec", None)
+            return bundle.codec
+        clones = getattr(self._codec_local, "clones", None)
+        if clones is None:
+            clones = self._codec_local.clones = {}
+        codec = clones.get(bundle.epoch)
         if codec is None:
-            codec = self.codec.thread_clone()
-            self._codec_local.codec = codec
+            codec = clones[bundle.epoch] = bundle.codec.thread_clone()
+            if len(clones) > 3:
+                live = set(self._swap.live_epochs())
+                live.add(bundle.epoch)
+                for e in [e for e in clones if e not in live]:
+                    del clones[e]
         return codec
 
     def _start_batch(self, batch, device: int) -> Optional[_Inflight]:
@@ -1088,6 +1302,10 @@ class CompressionService:
         if self._batch_hook is not None:
             self._batch_hook(batch)
         kind, bucket = batch[0].key
+        # ONE bundle read per batch: every stage below — device params,
+        # entropy codec, process pool — comes from this capture, so a
+        # hot swap landing mid-batch cannot tear it (serve/swap.py)
+        bundle = self._swap.current
         t0 = time.monotonic()
         self.metrics.gauge("serve_queue_depth").set(self._batcher.depth)
         self.metrics.histogram("serve_batch_occupancy").observe(
@@ -1095,23 +1313,23 @@ class CompressionService:
         if self._entropy_pool is None:
             if kind == ENCODE:
                 device_ms, entropy_ms = self._run_encode(
-                    batch, bucket, device)
+                    batch, bucket, device, bundle)
             else:
                 device_ms, entropy_ms = self._run_decode(
-                    batch, bucket, device)
+                    batch, bucket, device, bundle)
             dt = (time.monotonic() - t0) * 1e3
             self._busy_ms.add(dt)
             self._device_busy(device).add(dt)
             self._note_batch_done(batch, t0, device_ms, entropy_ms, device,
                                   observe_latency=True)
             return None
-        rec = _Inflight(kind, batch, bucket, t0, device)
+        rec = _Inflight(kind, batch, bucket, t0, device, bundle)
         if kind == ENCODE:
             bh, bw = bucket
             x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
             for i, r in enumerate(batch):
                 x[i] = r.payload[0]
-            params, bs = self._device_state[device]
+            params, bs = bundle.device_state[device]
             # async dispatch: the jit call returns before the device
             # finishes; the transfer happens in whichever pool task
             # first calls rec.handle.host() — the worker never blocks
@@ -1146,14 +1364,14 @@ class CompressionService:
         if not isinstance(e, Exception):
             rec.crash = e
 
-    def _make_entropy_proc(self):
-        """A fresh "process"-backend pool. spawn (not fork): forking a
-        process whose jax backend has live threads is a deadlock
-        lottery. Workers rebuild the codec from the picklable spec ONCE
-        (initializer) and warm every bucket's schedule there —
-        worker-resident state, nothing re-pickled per task
-        (coding/loader.py). Called from start() and from _proc_call's
-        child-death rebuild."""
+    def _make_entropy_proc(self, initargs):
+        """A fresh "process"-backend pool for ONE bundle's CodecSpec.
+        spawn (not fork): forking a process whose jax backend has live
+        threads is a deadlock lottery. Workers rebuild the codec from
+        the picklable spec ONCE (initializer) and warm every bucket's
+        schedule there — worker-resident state, nothing re-pickled per
+        task (coding/loader.py). Called from start(), prepare_swap(),
+        and _proc_call's child-death rebuild."""
         import multiprocessing
         from concurrent.futures import ProcessPoolExecutor
         from dsin_tpu.coding import loader as loader_lib
@@ -1161,9 +1379,9 @@ class CompressionService:
             max_workers=self._entropy_workers,
             mp_context=multiprocessing.get_context("spawn"),
             initializer=loader_lib.init_worker_codec,
-            initargs=self._proc_initargs)
+            initargs=initargs)
 
-    def _proc_call(self, fn, *args):
+    def _proc_call(self, bundle, fn, *args):
         """One coding task on the process backend, surviving child
         death: a pool worker that segfaults or is OOM-killed marks the
         whole ProcessPoolExecutor broken — every later submit raises
@@ -1189,8 +1407,14 @@ class CompressionService:
         timeout = self.config.entropy_proc_timeout_s
         last_exc = None
         for attempt in (0, 1):
-            with self._proc_lock:
-                proc = self._entropy_proc
+            proc = bundle.proc()
+            if proc is None:
+                # the bundle was retired mid-batch (two swaps landed
+                # inside one batch's lifetime) — fail this batch typed;
+                # the NEXT batch captures a live bundle
+                raise RuntimeError(
+                    f"entropy pool of model bundle epoch {bundle.epoch} "
+                    f"was retired while this batch was in flight")
             try:
                 fut = proc.submit(fn, *args)
             except RuntimeError as e:
@@ -1202,44 +1426,44 @@ class CompressionService:
                 if (not isinstance(e, BrokenProcessPool) and
                         "cannot schedule new futures" not in str(e)):
                     raise
-                self._swap_entropy_proc(proc)
+                self._swap_entropy_proc(bundle, proc)
                 last_exc = e
                 continue
             try:
                 return fut.result(timeout)
             except BrokenProcessPool as e:
-                self._swap_entropy_proc(proc)
+                self._swap_entropy_proc(bundle, proc)
                 last_exc = e
                 continue
             except FutTimeout:
-                self._swap_entropy_proc(proc)
+                self._swap_entropy_proc(bundle, proc)
                 raise TimeoutError(
                     f"entropy process backend task exceeded {timeout}s "
                     f"(child alive but stuck); pool replaced") from None
         raise last_exc
 
-    def _swap_entropy_proc(self, seen) -> None:
-        """Replace a broken/wedged pool with a fresh one (first bridge
-        thread to report `seen` swaps; the rest find it already done)
-        and abandon the old one without waiting on its children."""
-        with self._proc_lock:
-            if self._entropy_proc is seen:
-                self._entropy_proc = self._make_entropy_proc()
-                self.metrics.counter(
-                    "serve_entropy_proc_rebuilds").inc()
+    def _swap_entropy_proc(self, bundle, seen) -> None:
+        """Replace a bundle's broken/wedged pool with a fresh one built
+        from ITS OWN CodecSpec (first bridge thread to report `seen`
+        swaps; the rest find it already done) and abandon the old one
+        without waiting on its children."""
+        if bundle.swap_proc_if(
+                seen,
+                lambda: self._make_entropy_proc(bundle.proc_initargs)):
+            self.metrics.counter("serve_entropy_proc_rebuilds").inc()
         seen.shutdown(wait=False)                # idempotent
 
-    def _encode_vols(self, vols) -> list:
+    def _encode_vols(self, bundle, vols) -> list:
         """N (D, H, W) symbol volumes -> [(payload, None) |
         (None, exc)] per lane (loader.encode_batch_isolated's
         contract on both backends), one batch call on the configured
-        backend."""
+        backend — always against the BATCH's bundle, never the live
+        pointer (hot-swap coherence)."""
         from dsin_tpu.coding import loader as loader_lib
-        with self._proc_lock:
-            has_proc = self._entropy_proc is not None
-        if has_proc:
-            return self._proc_call(loader_lib.worker_encode_batch, vols)
-        return loader_lib.encode_batch_isolated(self._thread_codec(),
+        if bundle.proc_initargs is not None:
+            return self._proc_call(bundle, loader_lib.worker_encode_batch,
+                                   vols)
+        return loader_lib.encode_batch_isolated(self._thread_codec(bundle),
                                                 vols)
 
     @staticmethod
@@ -1250,14 +1474,12 @@ class CompressionService:
         from dsin_tpu.coding import loader as loader_lib
         return loader_lib.decode_batch_isolated(codec, payloads)
 
-    def _decode_payloads(self, payloads) -> list:
-        with self._proc_lock:
-            has_proc = self._entropy_proc is not None
-        if has_proc:
+    def _decode_payloads(self, bundle, payloads) -> list:
+        if bundle.proc_initargs is not None:
             from dsin_tpu.coding import loader as loader_lib
-            return self._proc_call(loader_lib.worker_decode_batch,
+            return self._proc_call(bundle, loader_lib.worker_decode_batch,
                                    payloads)
-        return self._decode_with(self._thread_codec(), payloads)
+        return self._decode_with(self._thread_codec(bundle), payloads)
 
     def _decode_batch_lanes(self, batch, sym, decode, fail) -> None:
         """One micro-batch's decode-side entropy work under the
@@ -1322,7 +1544,7 @@ class CompressionService:
                 te0 = time.monotonic()
                 vols = [np.transpose(symbols[i], (2, 0, 1))
                         for i in range(len(rec.batch))]
-                payloads = self._encode_vols(vols)
+                payloads = self._encode_vols(rec.bundle, vols)
                 te1 = time.monotonic()
                 for i, req in enumerate(rec.batch):
                     payload, exc = payloads[i]
@@ -1337,12 +1559,14 @@ class CompressionService:
                         stream=frame_stream(payload, (h, w), rec.bucket),
                         payload_bytes=len(payload),
                         bpp=len(payload) * 8.0 / (h * w),
-                        shape=(h, w), bucket=rec.bucket))
+                        shape=(h, w), bucket=rec.bucket,
+                        model_digest=rec.bundle.digest))
                     self._observe_latency(req)
             else:
                 te0 = time.monotonic()
                 self._decode_batch_lanes(
-                    rec.batch, rec.sym, self._decode_payloads,
+                    rec.batch, rec.sym,
+                    lambda p: self._decode_payloads(rec.bundle, p),
                     lambda i, req, e: self._item_failed(rec, i, req, e))
                 te1 = time.monotonic()
         except BaseException as e:  # noqa: BLE001 — answer every caller
@@ -1372,7 +1596,7 @@ class CompressionService:
             self.metrics.counter("serve_device_skipped_batches").inc()
         else:
             t_dev = time.monotonic()
-            params, bs = self._device_state[rec.device]
+            params, bs = rec.bundle.device_state[rec.device]
             imgs = np.asarray(self._decode_fn(
                 params, bs, self.placement.put_batch(rec.device, rec.sym)))
             device_ms = (time.monotonic() - t_dev) * 1e3
@@ -1446,21 +1670,22 @@ class CompressionService:
             self.metrics.gauge("serve_overlap_ratio").set(
                 max(0.0, 1.0 - busy / (dev + ent)))
 
-    def _run_encode(self, batch, bucket, device: int) -> Tuple[float, float]:
+    def _run_encode(self, batch, bucket, device: int,
+                    bundle) -> Tuple[float, float]:
         """Serialized encode (entropy_workers=0): device then entropy,
         inline on the worker thread. Returns (device_ms, entropy_ms)."""
         bh, bw = bucket
         x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
         for i, r in enumerate(batch):
             x[i] = r.payload[0]
-        params, bs = self._device_state[device]
+        params, bs = bundle.device_state[device]
         t_dev = time.monotonic()
         symbols = np.asarray(self._encode_fn(
             params, bs, self.placement.put_batch(device, x)))
         t_ent = time.monotonic()
         from dsin_tpu.coding import loader as loader_lib
         payloads = loader_lib.encode_batch_isolated(
-            self.codec,
+            bundle.codec,
             [np.transpose(symbols[i], (2, 0, 1))
              for i in range(len(batch))])
         for i, r in enumerate(batch):
@@ -1475,10 +1700,12 @@ class CompressionService:
                 stream=frame_stream(payload, (h, w), bucket),
                 payload_bytes=len(payload),
                 bpp=len(payload) * 8.0 / (h * w),
-                shape=(h, w), bucket=bucket))
+                shape=(h, w), bucket=bucket,
+                model_digest=bundle.digest))
         return ((t_ent - t_dev) * 1e3, (time.monotonic() - t_ent) * 1e3)
 
-    def _run_decode(self, batch, bucket, device: int) -> Tuple[float, float]:
+    def _run_decode(self, batch, bucket, device: int,
+                    bundle) -> Tuple[float, float]:
         """Serialized decode (entropy_workers=0): entropy then device,
         inline on the worker thread. Returns (device_ms, entropy_ms)."""
         bh, bw = bucket
@@ -1496,7 +1723,7 @@ class CompressionService:
                 self.metrics.counter("serve_integrity_errors").inc()
 
         self._decode_batch_lanes(
-            batch, sym, lambda p: self._decode_with(self.codec, p),
+            batch, sym, lambda p: self._decode_with(bundle.codec, p),
             _fail)
         entropy_ms = (time.monotonic() - t_ent) * 1e3
         if len(per_item_exc) == len(batch):
@@ -1507,7 +1734,7 @@ class CompressionService:
                 r.future.set_exception(per_item_exc[i])
             self.metrics.counter("serve_device_skipped_batches").inc()
             return (0.0, entropy_ms)
-        params, bs = self._device_state[device]
+        params, bs = bundle.device_state[device]
         t_dev = time.monotonic()
         imgs = np.asarray(self._decode_fn(
             params, bs, self.placement.put_batch(device, sym)))

@@ -1,0 +1,322 @@
+"""Live model hot-swap state machine: versioned bundles, instant rollback.
+
+A running CompressionService (serve/service.py) must adopt a retrained
+checkpoint without dropping a request and roll back in milliseconds when
+the new model misbehaves (ROADMAP "Live operations"; the deployment-
+mechanics argument of PAPERS.md arXiv 2207.14524). The hard part is not
+the pointer swap — it is that "the model" is FOUR coupled things the
+dataplane reads at different moments: per-device replicated params for
+the jitted stages, the host-side params the codec's context model codes
+entropy with, the per-thread codec clones of the entropy pool, and (for
+the process entropy backend) a pool of worker-resident codecs in child
+processes. A swap that changes them non-atomically produces TORN
+batches: device stage on model A, entropy stage on model B, emitting a
+stream no model can decode.
+
+This module makes the whole set one value:
+
+* **ModelBundle** — an immutable snapshot of one model version: host
+  state, codec, per-device replicas, digest, and (process backend) its
+  OWN worker pool built from its own CodecSpec. A worker captures ONE
+  bundle reference at batch start and threads it through every stage,
+  so a batch is coherent by construction no matter when the swap lands;
+  in-flight batches simply finish on the bundle they started with.
+
+* **SwapCoordinator** — the three-slot state machine under the ranked
+  `serve.model` lock (rank 17): `current` (serving), `staged` (prepared
+  by a background load+warm, waiting for commit), `prev` (the last
+  served bundle, kept WARM for instant rollback). Transitions are
+  pointer swaps — O(1) under the lock, nothing blocking — and every
+  displaced bundle is handed back to the caller for retirement OUTSIDE
+  the lock (a process pool shutdown must never run under a ranked
+  lock). Counters/gauge: `serve_swaps`, `serve_rollbacks`,
+  `serve_swap_errors`, `serve_swap_state` (0 idle / 1 preparing /
+  2 staged), and the `serve_model_digest` info entry (current/prev/
+  staged digests + checkpoint paths) every scrape carries.
+
+The coordinator never builds or warms bundles — the service owns model
+construction and the census warm (and runs them on the CALLER's thread,
+concurrent with serving traffic; "background" means background to the
+dataplane, not async). Two-phase FLEET swaps (serve/router.py) compose
+these primitives: prepare = stage on every replica, commit = unanimous
+pointer swap, abort = discard staged, rollback = swap back to prev.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from dsin_tpu.utils import locks as locks_lib
+
+#: serve_swap_state gauge values
+SWAP_IDLE = 0
+SWAP_PREPARING = 1
+SWAP_STAGED = 2
+
+
+class SwapError(RuntimeError):
+    """A hot-swap transition was refused (no staged bundle to commit,
+    nothing to roll back to, digest disagreement at commit, a second
+    swap while one is in flight). The service keeps serving its current
+    bundle — a refused swap is an operator error, never an outage."""
+
+
+class ModelBundle:
+    """One model version, whole: everything any dataplane stage reads.
+
+    Immutable after construction except the process-backend pool slot,
+    which the child-death rebuild swaps under the shared
+    `serve.entropy_proc` rank (same discipline as the pre-swap service;
+    instances share that rung's ledger). `epoch` increases monotonically
+    across bundles in one service — rollback re-instates an OLD epoch
+    rather than minting a new one, so "which model produced this" stays
+    answerable from the epoch alone.
+    """
+
+    __slots__ = ("epoch", "digest", "ckpt", "state", "codec",
+                 "device_state", "proc_initargs", "manifest", "_proc",
+                 "_proc_lock")
+
+    def __init__(self, epoch: int, digest: str, state, codec, device_state,
+                 *, ckpt: Optional[str] = None, proc_initargs=None,
+                 manifest: Optional[Dict[str, Any]] = None):
+        self.epoch = int(epoch)
+        self.digest = digest
+        self.ckpt = ckpt
+        self.state = state
+        self.codec = codec
+        self.device_state = device_state
+        self.proc_initargs = proc_initargs
+        self.manifest = manifest
+        self._proc_lock = locks_lib.RankedLock("serve.entropy_proc")
+        self._proc = None              # guarded-by: self._proc_lock
+
+    # -- process-backend pool slot -------------------------------------------
+
+    def proc(self):
+        with self._proc_lock:
+            return self._proc
+
+    def set_proc(self, pool) -> None:
+        with self._proc_lock:
+            self._proc = pool
+
+    def swap_proc_if(self, seen, factory) -> bool:
+        """Child-death rebuild: the first bridge thread to report `seen`
+        swaps in `factory()`; later reporters find it already replaced.
+        The factory runs UNDER the slot lock — it only constructs an
+        executor object (spawns are lazy), the same cost profile as the
+        pre-swap service's rebuild path."""
+        with self._proc_lock:
+            if self._proc is not seen:
+                return False
+            self._proc = factory()
+        return True
+
+    def retire(self) -> None:
+        """Release what this bundle exclusively owns (its process pool,
+        if any). Idempotent; called OUTSIDE any ranked lock. In-flight
+        tasks already submitted to the pool run to completion —
+        shutdown(wait=False) only refuses new work — so a batch that
+        captured this bundle still resolves."""
+        with self._proc_lock:
+            pool, self._proc = self._proc, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __repr__(self) -> str:
+        return (f"ModelBundle(epoch={self.epoch}, digest={self.digest!r}, "
+                f"ckpt={self.ckpt!r})")
+
+
+class SwapCoordinator:
+    """current/staged/prev bundle slots + the transition rules.
+
+    All methods are O(pointer swap) under `serve.model`; displaced
+    bundles come back in the returned list for the caller to retire
+    outside the lock. Exactly one prepare may be in flight (`begin_
+    prepare` claims, `stage`/`abandon_prepare` releases) — a second
+    swapper is refused typed, mirroring the rebalance claim flag.
+    """
+
+    def __init__(self, current: ModelBundle, metrics):
+        self._lock = locks_lib.RankedLock("serve.model")
+        self._current = current            # guarded-by: self._lock
+        self._prev: Optional[ModelBundle] = None     # guarded-by: self._lock
+        self._staged: Optional[ModelBundle] = None   # guarded-by: self._lock
+        self._preparing = False            # guarded-by: self._lock
+        self._next_epoch = current.epoch + 1         # guarded-by: self._lock
+        # abort() during an IN-FLIGHT prepare cannot release the claim
+        # (the preparing thread owns it) — it instead cancels every
+        # epoch claimed so far; that prepare's stage() is then refused
+        # typed and its own cleanup releases the claim. Without this, a
+        # fleet abort racing a slow replica prepare would let the late
+        # stage park a bundle nobody will ever commit or abort again.
+        self._cancelled_before = 0         # guarded-by: self._lock
+        self.metrics = metrics
+        with self._lock:
+            snap = self._snapshot_locked()
+        self._publish_locked_out(snap)
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def current(self) -> ModelBundle:
+        with self._lock:
+            return self._current
+
+    def live_epochs(self) -> List[int]:
+        """Epochs a dataplane thread may still legitimately touch —
+        the thread-local codec-clone caches prune against this."""
+        with self._lock:
+            return [b.epoch for b in (self._current, self._prev,
+                                      self._staged) if b is not None]
+
+    def all_bundles(self) -> List[ModelBundle]:
+        with self._lock:
+            return [b for b in (self._current, self._prev, self._staged)
+                    if b is not None]
+
+    def _snapshot_locked(self) -> Dict[str, Any]:
+        swap_state = (SWAP_STAGED if self._staged is not None
+                      else SWAP_PREPARING if self._preparing else SWAP_IDLE)
+        return {
+            "digest": self._current.digest,
+            "epoch": self._current.epoch,
+            "ckpt": self._current.ckpt,
+            "prev_digest": self._prev.digest if self._prev else None,
+            "staged_digest": self._staged.digest if self._staged else None,
+            "swap_state": swap_state,
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _publish_locked_out(self, snap: Dict[str, Any]) -> None:
+        """Export the transition to /metrics — called with the snapshot
+        already taken, AFTER the lock is released (metric locks are leaf
+        rungs, but keeping the swap lock's hold time at pointer-swap
+        cost is the contract)."""
+        self.metrics.gauge("serve_swap_state").set(snap["swap_state"])
+        self.metrics.set_info("serve_model_digest", snap)
+
+    def _publish(self) -> None:
+        with self._lock:
+            snap = self._snapshot_locked()
+        self._publish_locked_out(snap)
+
+    # -- transitions ---------------------------------------------------------
+
+    def begin_prepare(self) -> int:
+        """Claim the single prepare slot; returns the epoch the incoming
+        bundle must carry. Refused typed while another prepare runs or a
+        staged bundle awaits its commit/abort."""
+        with self._lock:
+            if self._preparing:
+                raise SwapError("a model swap is already preparing — one "
+                                "swap at a time")
+            if self._staged is not None:
+                raise SwapError(
+                    f"a prepared bundle (digest "
+                    f"{self._staged.digest!r}) is already staged — "
+                    f"commit or abort it before preparing another")
+            self._preparing = True
+            epoch = self._next_epoch
+            self._next_epoch += 1
+        self._publish()
+        return epoch
+
+    def abandon_prepare(self) -> None:
+        """Release the prepare claim after a failed load/warm (the
+        error path; the bundle never staged)."""
+        with self._lock:
+            self._preparing = False
+        self.metrics.counter("serve_swap_errors").inc()
+        self._publish()
+
+    def stage(self, bundle: ModelBundle) -> None:
+        """Prepared bundle parked, awaiting commit. The prepare claim
+        converts into the staged slot — unless an abort() landed while
+        the prepare was loading, in which case staging is refused typed
+        (the preparer's cleanup retires the bundle and releases the
+        claim)."""
+        with self._lock:
+            if not self._preparing:
+                raise SwapError("stage() without begin_prepare()")
+            if bundle.epoch < self._cancelled_before:
+                raise SwapError(
+                    f"swap prepare (epoch {bundle.epoch}) was aborted "
+                    f"while it was still loading — not staging it")
+            self._preparing = False
+            self._staged = bundle
+        self._publish()
+
+    def commit(self, expect_digest: Optional[str] = None
+               ) -> List[ModelBundle]:
+        """staged -> current, current -> prev; returns displaced bundles
+        (the old prev) for retirement. Instant: every expensive thing
+        happened at prepare. `expect_digest` pins WHICH model the caller
+        believes it is committing (the fleet two-phase contract)."""
+        with self._lock:
+            staged = self._staged
+            if staged is None:
+                raise SwapError("no staged bundle to commit — prepare "
+                                "first")
+            if expect_digest is not None and staged.digest != expect_digest:
+                raise SwapError(
+                    f"staged bundle digest {staged.digest!r} is not the "
+                    f"expected {expect_digest!r} — refusing to commit a "
+                    f"model the caller did not verify")
+            displaced = [b for b in (self._prev,) if b is not None]
+            self._staged = None
+            self._prev = self._current
+            self._current = staged
+            snap = self._snapshot_locked()
+        self.metrics.counter("serve_swaps").inc()
+        self._publish_locked_out(snap)
+        return displaced
+
+    def abort(self) -> List[ModelBundle]:
+        """Discard the staged bundle (prepare failed fleet-wide, digest
+        disagreement, operator abort). No-op when nothing is staged —
+        abort must be safe to broadcast. An abort that lands while a
+        prepare is still LOADING cancels it: the late stage() is
+        refused and the preparer cleans itself up (the claim is never
+        force-released here, so a racing second prepare cannot
+        interleave with the dying one)."""
+        with self._lock:
+            staged, self._staged = self._staged, None
+            if self._preparing:
+                self._cancelled_before = self._next_epoch
+            snap = self._snapshot_locked()
+        if staged is not None:
+            self.metrics.counter("serve_swap_errors").inc()
+        self._publish_locked_out(snap)
+        return [staged] if staged is not None else []
+
+    def rollback(self, expect_current: Optional[str] = None
+                 ) -> List[ModelBundle]:
+        """current <-> prev: instant, both bundles warm. Symmetric — a
+        second rollback re-instates the rolled-away model (operator
+        ping-pong is safe); nothing is displaced. `expect_current`
+        guards a CONDITIONAL rollback (the fleet commit-failure
+        recovery): it only runs if the serving digest IS the one being
+        rolled away — a replica whose commit never landed refuses
+        typed instead of blindly re-instating some older model."""
+        with self._lock:
+            if self._prev is None:
+                raise SwapError("nothing to roll back to (no previous "
+                                "model bundle is retained)")
+            if expect_current is not None \
+                    and self._current.digest != expect_current:
+                raise SwapError(
+                    f"conditional rollback refused: serving digest "
+                    f"{self._current.digest!r} is not the expected "
+                    f"{expect_current!r} (this replica never committed "
+                    f"the model being rolled back)")
+            self._current, self._prev = self._prev, self._current
+            snap = self._snapshot_locked()
+        self.metrics.counter("serve_rollbacks").inc()
+        self._publish_locked_out(snap)
+        return []

@@ -30,6 +30,25 @@ the staging writes retry with the shared bounded policy (utils/retry.py);
 `ckpt.write` (every staged file write) and `ckpt.swap` (the window
 between the two renames) let the chaos tests kill a save at every
 crash point (utils/faults.py).
+
+Versioned manifests (ISSUE 9): every save also writes a `manifest.json`
+through the same staged-atomic path (before `meta.json`, so a dir with
+meta always has its manifest): format version, per-partition content
+digests + a whole-tree `params_digest` (coding/loader.py's digest — the
+multi-replica fleet handshake compares the same value), per-file CRC32s
+and sizes, and whatever identity the caller threads through
+`manifest_extra` (canonical pc-config hash, init seed, serve bucket
+ladder). Loaders verify the manifest against what they actually
+restored and refuse mismatches with a typed `ManifestMismatch`;
+checkpoints from before the manifest era load with a recorded
+`UserWarning`. A corrupt/truncated manifest (or `meta.json`) raises a
+typed `IntegrityError` instead of a raw JSONDecodeError from deep
+inside restore. `replicate_checkpoint` copies the resolved latest
+checkpoint to a peer-visible destination with every byte CRC-verified
+against the manifest on BOTH sides of the copy, so a second host can
+adopt the exact versioned checkpoint (the `.prev-*` follow-up from
+ISSUE 3). The `ckpt.manifest` fault site corrupts manifest bytes as a
+loader reads them — the chaos corrupt-incoming-manifest scenario.
 """
 
 from __future__ import annotations
@@ -44,9 +63,24 @@ import jax
 import numpy as np
 
 from dsin_tpu.utils import faults
+from dsin_tpu.utils.integrity import IntegrityError, frame_crc
 from dsin_tpu.utils.retry import RetryPolicy, call_with_retry
 
 AE_PARTITIONS = ("encoder", "decoder", "centers", "probclass")
+
+MANIFEST_NAME = "manifest.json"
+#: bump when the manifest SCHEMA changes incompatibly; loaders refuse a
+#: manifest from a future version (they cannot know what it promises)
+MANIFEST_VERSION = 1
+
+
+class ManifestMismatch(ValueError):
+    """A checkpoint's manifest disagrees with what a loader built or
+    restored (wrong params bytes, different pc config, different bucket
+    ladder, future format). ValueError subclass so generic bad-input
+    handlers route it; typed so swap/serve paths can refuse it
+    specifically — the whole point is refusing a mismatched model
+    BEFORE it serves a single request."""
 
 #: bounded retry for transient write failures (EIO on flaky NFS, EAGAIN);
 #: persistent failures still propagate after the third attempt
@@ -87,11 +121,54 @@ def _write_bytes_durable(path: str, data: bytes) -> None:
     call_with_retry(_attempt, WRITE_RETRY, retry_on=(OSError,))
 
 
-def _write_msgpack(path: str, tree) -> None:
+def _write_msgpack(path: str, tree) -> Dict[str, int]:
     # to_state_dict first: opt_state holds optax NamedTuple/dataclass nodes
     # (e.g. multi_transform's PartitionState) that msgpack can't serialize raw
     state = flax.serialization.to_state_dict(_to_host(tree))
-    _write_bytes_durable(path, flax.serialization.msgpack_serialize(state))
+    data = flax.serialization.msgpack_serialize(state)
+    _write_bytes_durable(path, data)
+    return {"bytes": len(data), "crc32": frame_crc(data)}
+
+
+def _tree_digest(tree) -> str:
+    """The repo's ONE parameter digest (coding/loader.py): the manifest
+    records the same value the serve fleet handshake and the hot-swap
+    two-phase commit compare, so 'this checkpoint' means the same 16 hex
+    chars everywhere. Imported lazily: coding.loader pulls jax/numpy
+    only at module level, but keeping train/ import-light matters for
+    the one-shot CLI."""
+    from dsin_tpu.coding.loader import params_digest
+    return params_digest(tree)
+
+
+def config_sha256(config) -> str:
+    """Canonical-text hash of a Config (str() round-trips through
+    config.parse_config, so equal semantics hash equal)."""
+    import hashlib
+    return hashlib.sha256(str(config).encode()).hexdigest()[:16]
+
+
+def build_manifest(state, files: Optional[Dict[str, Dict[str, int]]] = None,
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The identity a checkpoint carries: format version, per-partition
+    content digests (a loader restoring a SUBSET can still verify what
+    it took), the whole-tree `params_digest`, and per-file CRC32s for
+    byte-level replication checks. `extra` threads caller identity in —
+    the trainer's pc-config hash + seed, a serve-side bucket ladder."""
+    manifest: Dict[str, Any] = {
+        "manifest_version": MANIFEST_VERSION,
+        "step": int(state.step),
+        "partitions": sorted(state.params.keys()),
+        "partition_digests": {part: _tree_digest(sub)
+                              for part, sub in state.params.items()},
+        "batch_stats_digest": _tree_digest(state.batch_stats),
+        "params_digest": _tree_digest((state.params, state.batch_stats)),
+    }
+    if files is not None:
+        manifest["files"] = dict(sorted(files.items()))
+    if extra:
+        manifest.update(extra)
+    return manifest
 
 
 def _read_msgpack(path: str):
@@ -140,6 +217,7 @@ def _rescue_nested_dirs(src_dir: str, live_dir: str) -> None:
 
 def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
                     extra_meta: Optional[Dict[str, Any]] = None,
+                    manifest_extra: Optional[Dict[str, Any]] = None,
                     keep_last: int = 1) -> None:
     """Save a TrainState (params/batch_stats/opt_state/step) partitioned,
     durably: the live dir is replaced only by a complete, fsynced copy.
@@ -170,12 +248,20 @@ def save_checkpoint(ckpt_dir: str, state, *, best_val: Optional[float] = None,
 
     tmp = os.path.join(parent, f"{name}.tmp-{os.getpid()}")
     os.makedirs(tmp)
+    files: Dict[str, Dict[str, int]] = {}
     for part, sub in state.params.items():
-        _write_msgpack(os.path.join(tmp, f"params_{part}.msgpack"), sub)
-    _write_msgpack(os.path.join(tmp, "batch_stats.msgpack"),
-                   state.batch_stats)
-    _write_msgpack(os.path.join(tmp, "opt_state.msgpack"),
-                   state.opt_state)
+        fname = f"params_{part}.msgpack"
+        files[fname] = _write_msgpack(os.path.join(tmp, fname), sub)
+    files["batch_stats.msgpack"] = _write_msgpack(
+        os.path.join(tmp, "batch_stats.msgpack"), state.batch_stats)
+    files["opt_state.msgpack"] = _write_msgpack(
+        os.path.join(tmp, "opt_state.msgpack"), state.opt_state)
+    # manifest BEFORE meta: meta.json is the completeness marker
+    # (latest_checkpoint resolves on it), so any dir with meta is
+    # guaranteed to carry its manifest too
+    manifest = build_manifest(state, files=files, extra=manifest_extra)
+    _write_bytes_durable(os.path.join(tmp, MANIFEST_NAME),
+                         json.dumps(manifest, indent=2).encode())
     meta = {"step": int(state.step),
             "partitions": sorted(state.params.keys())}
     if best_val is not None:
@@ -237,8 +323,214 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 
 
 def load_meta(ckpt_dir: str) -> Dict[str, Any]:
-    with open(os.path.join(ckpt_dir, "meta.json")) as f:
-        return json.load(f)
+    """Parse `meta.json`; corruption/truncation raises a typed
+    `IntegrityError` (a ValueError, so every existing skip-this-
+    candidate handler keeps working) instead of a raw JSONDecodeError
+    surfacing from deep inside a restore."""
+    path = os.path.join(ckpt_dir, "meta.json")
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        return json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise IntegrityError(
+            f"checkpoint meta {path} is corrupt or truncated "
+            f"({len(raw)} bytes): {e} — the save was torn or the file "
+            f"rotted; resolve a complete checkpoint via "
+            f"latest_checkpoint() instead") from e
+
+
+def load_manifest(ckpt_dir: str) -> Optional[Dict[str, Any]]:
+    """Parse `manifest.json`, or None for a pre-manifest checkpoint.
+    The bytes pass through the `ckpt.manifest` fault site (the chaos
+    corrupt-incoming-manifest scenario); a manifest that does not parse
+    raises typed IntegrityError — never a raw JSONDecodeError."""
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return None
+    raw = faults.corrupt("ckpt.manifest", raw)
+    try:
+        manifest = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise IntegrityError(
+            f"checkpoint manifest {path} is corrupt or truncated "
+            f"({len(raw)} bytes): {e} — refusing to trust this "
+            f"checkpoint's identity") from e
+    if not isinstance(manifest, dict):
+        raise IntegrityError(
+            f"checkpoint manifest {path} is not a JSON object "
+            f"({type(manifest).__name__})")
+    return manifest
+
+
+def verify_manifest(ckpt_dir: str, state, partitions: Iterable[str], *,
+                    batch_stats_loaded: bool = True,
+                    pc_config=None,
+                    buckets=None) -> Dict[str, Any]:
+    """Check a RESTORED state against the checkpoint's manifest.
+
+    Verifies manifest format version, the content digest of every
+    partition in `partitions` (computed over the restored values — a
+    msgpack roundtrip is bit-exact, so any difference is real), the
+    batch_stats digest when it was loaded, and — when BOTH sides state
+    them — the canonical pc-config hash and the serve bucket ladder.
+    Returns {"status": "verified", "manifest": {...}} or
+    {"status": "legacy", "manifest": None} for a pre-manifest
+    checkpoint (the caller records the warning); any disagreement
+    raises typed ManifestMismatch."""
+    manifest = load_manifest(ckpt_dir)
+    if manifest is None:
+        return {"status": "legacy", "manifest": None}
+    version = manifest.get("manifest_version")
+    if not isinstance(version, int) or version < 1 \
+            or version > MANIFEST_VERSION:
+        raise ManifestMismatch(
+            f"checkpoint {ckpt_dir} has manifest_version {version!r}; "
+            f"this loader understands 1..{MANIFEST_VERSION} — refusing "
+            f"to guess what a different format promises")
+    part_digests = manifest.get("partition_digests", {})
+    for part in partitions:
+        want = part_digests.get(part)
+        if want is None:
+            raise ManifestMismatch(
+                f"checkpoint {ckpt_dir} manifest records no digest for "
+                f"restored partition {part!r} (has: "
+                f"{sorted(part_digests)})")
+        got = _tree_digest(state.params[part])
+        if got != want:
+            raise ManifestMismatch(
+                f"checkpoint {ckpt_dir} partition {part!r} digest "
+                f"mismatch: manifest {want}, restored {got} — the "
+                f"restored bytes are not the bytes this manifest "
+                f"describes")
+    if batch_stats_loaded and "batch_stats_digest" in manifest:
+        got = _tree_digest(state.batch_stats)
+        if got != manifest["batch_stats_digest"]:
+            raise ManifestMismatch(
+                f"checkpoint {ckpt_dir} batch_stats digest mismatch: "
+                f"manifest {manifest['batch_stats_digest']}, restored "
+                f"{got}")
+    if pc_config is not None and "pc_config_sha256" in manifest:
+        got = config_sha256(pc_config)
+        if got != manifest["pc_config_sha256"]:
+            raise ManifestMismatch(
+                f"checkpoint {ckpt_dir} was trained with a different "
+                f"probability-model config (manifest pc hash "
+                f"{manifest['pc_config_sha256']}, loader built {got}) — "
+                f"its entropy streams would not decode against this "
+                f"model")
+    if buckets is not None and manifest.get("buckets") is not None:
+        want_b = [list(b) for b in manifest["buckets"]]
+        got_b = [list(b) for b in buckets]
+        if want_b != got_b:
+            raise ManifestMismatch(
+                f"checkpoint {ckpt_dir} was published for bucket ladder "
+                f"{want_b}, this service runs {got_b} — a swapped-in "
+                f"model must serve the SAME ladder or routed streams "
+                f"break")
+    return {"status": "verified", "manifest": manifest}
+
+
+def verify_files(ckpt_dir: str,
+                 manifest: Dict[str, Any]) -> Dict[str, int]:
+    """CRC-check every payload file the manifest lists against the bytes
+    on disk at `ckpt_dir`. Returns {"files": n, "bytes": total}; any
+    size/CRC disagreement raises typed IntegrityError."""
+    files = manifest.get("files") or {}
+    total = 0
+    for fname, want in files.items():
+        path = os.path.join(ckpt_dir, fname)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise IntegrityError(
+                f"checkpoint {ckpt_dir} is missing {fname!r} that its "
+                f"manifest lists") from None
+        if len(data) != want.get("bytes") or \
+                frame_crc(data) != want.get("crc32"):
+            raise IntegrityError(
+                f"checkpoint file {path} does not match its manifest "
+                f"entry (got {len(data)} bytes crc 0x{frame_crc(data):08x}, "
+                f"manifest says {want}) — rotted or torn; refusing it")
+        total += len(data)
+    return {"files": len(files), "bytes": total}
+
+
+def replicate_checkpoint(ckpt_dir: str, dest_dir: str, *,
+                         keep_last: int = 1) -> Dict[str, Any]:
+    """Copy the resolved latest checkpoint for `ckpt_dir` (the live dir,
+    or the newest complete `.prev-*` after a kill in the swap window) to
+    `dest_dir` — a peer-visible path (NFS mount, object-store fuse) a
+    second host adopts the SAME versioned model from.
+
+    Every payload byte is CRC-verified against the manifest on BOTH
+    sides: the source read (bit rot on the origin) and a read-back of
+    the staged copy (corruption in transit / on the destination
+    filesystem). The staged dir swaps in with the same rotate+rename
+    protocol as save_checkpoint, so a kill mid-replication never leaves
+    a torn destination. A manifest-less source is refused typed — an
+    unversioned replica defeats the point of replicating."""
+    src = latest_checkpoint(ckpt_dir)
+    if src is None:
+        raise FileNotFoundError(
+            f"no complete checkpoint to replicate at {ckpt_dir}")
+    manifest = load_manifest(src)
+    if manifest is None:
+        raise ManifestMismatch(
+            f"checkpoint {src} has no manifest — refusing to replicate "
+            f"an unversioned checkpoint (a peer host could never verify "
+            f"what it adopted)")
+    verify_files(src, manifest)
+
+    dest_dir = os.path.abspath(dest_dir)
+    parent, name = os.path.split(dest_dir)
+    os.makedirs(parent or ".", exist_ok=True)
+    for entry in os.listdir(parent):
+        if entry.startswith(f"{name}.tmp-"):
+            shutil.rmtree(os.path.join(parent, entry), ignore_errors=True)
+    tmp = os.path.join(parent, f"{name}.tmp-{os.getpid()}")
+    os.makedirs(tmp)
+    total = 0
+    for fname, want in (manifest.get("files") or {}).items():
+        with open(os.path.join(src, fname), "rb") as f:
+            data = f.read()
+        if frame_crc(data) != want.get("crc32"):
+            raise IntegrityError(
+                f"source file {os.path.join(src, fname)} changed under "
+                f"the replication (crc mismatch vs manifest)")
+        dst_path = os.path.join(tmp, fname)
+        _write_bytes_durable(dst_path, data)
+        with open(dst_path, "rb") as f:
+            back = f.read()
+        if frame_crc(back) != want.get("crc32"):
+            raise IntegrityError(
+                f"replicated file {dst_path} failed its read-back CRC — "
+                f"the copy corrupted in transit")
+        total += len(data)
+    # manifest then meta last, mirroring save_checkpoint's completeness
+    # ordering (meta present => everything it names present)
+    for fname in (MANIFEST_NAME, "meta.json"):
+        with open(os.path.join(src, fname), "rb") as f:
+            _write_bytes_durable(os.path.join(tmp, fname), f.read())
+    _fsync_dir(tmp)
+    if os.path.isdir(dest_dir):
+        prevs = _prev_dirs(parent, name)
+        next_idx = (int(os.path.basename(prevs[-1]).rsplit("-", 1)[1]) + 1
+                    if prevs else 1)
+        os.rename(dest_dir,
+                  os.path.join(parent, f"{name}.prev-{next_idx:06d}"))
+        faults.inject("ckpt.swap")
+    os.rename(tmp, dest_dir)
+    _fsync_dir(parent)
+    for old in _prev_dirs(parent, name)[:-keep_last if keep_last else None]:
+        shutil.rmtree(old, ignore_errors=True)
+    return {"src": src, "dest": dest_dir,
+            "files": len(manifest.get("files") or {}), "bytes": total,
+            "params_digest": manifest.get("params_digest")}
 
 
 def restore_partitions(ckpt_dir: str, state, partitions: Iterable[str],

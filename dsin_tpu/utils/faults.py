@@ -13,8 +13,13 @@ Canonical sites (free-form strings; these are the ones wired in):
 
     serve.worker.batch   top of a serve worker's batch processing
     serve.rans           decode-side entropy payload bytes (worker-side)
+    serve.swap           the model hot-swap windows (after the incoming
+                         params load in prepare, and the commit window
+                         right before the atomic bundle swap)
     ckpt.write           each durable checkpoint file write
     ckpt.swap            the window between the checkpoint swap renames
+    ckpt.manifest        manifest.json bytes as a loader reads them
+                         (corrupt = the torn/rotted-manifest scenario)
     io.read              CLI stream-file reads
 
 Hot-path cost: `inject(site)` / `corrupt(site, data)` are a single
@@ -36,8 +41,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from dsin_tpu.utils import locks as locks_lib
 
-SITES = ("serve.worker.batch", "serve.rans", "ckpt.write", "ckpt.swap",
-         "io.read")
+SITES = ("serve.worker.batch", "serve.rans", "serve.swap", "ckpt.write",
+         "ckpt.swap", "ckpt.manifest", "io.read")
 
 ACTIONS = ("raise", "crash", "delay", "corrupt")
 

@@ -110,8 +110,11 @@ def test_kill_during_staging_leaves_live_checkpoint_untouched(tmp_path):
                        opt_state=tx.init(_params(seed=9)),
                        step=jnp.asarray(0, jnp.int32))
     state2, _ = _make_state(step=8, seed=1)
-    # 8 staged writes per save: 5 params + batch_stats + opt_state + meta
-    for visit in range(8):
+    # 9 staged writes per save: 5 params + batch_stats + opt_state +
+    # manifest + meta — the manifest.json write (ISSUE 9) is one more
+    # kill window, and every window must leave the live checkpoint
+    # intact WITH a valid, file-CRC-consistent manifest
+    for visit in range(9):
         plan = faults.FaultPlan([faults.FaultSpec(
             site="ckpt.write", after=visit, times=None)], seed=0)
         with faults.installed(plan):
@@ -121,9 +124,13 @@ def test_kill_during_staging_leaves_live_checkpoint_untouched(tmp_path):
         _assert_restorable(d, fresh, state.params, want_step=7)
         assert ckpt_lib.load_meta(d)["best_val"] == 1.5
         assert ckpt_lib.latest_checkpoint(d) == os.path.abspath(d)
+        manifest = ckpt_lib.load_manifest(d)
+        assert manifest is not None and manifest["step"] == 7
+        ckpt_lib.verify_files(d, manifest)
     # and with the plan gone, the same save goes through cleanly
     ckpt_lib.save_checkpoint(d, state2)
     _assert_restorable(d, fresh, state2.params, want_step=8)
+    assert ckpt_lib.load_manifest(d)["step"] == 8
 
 
 def test_kill_between_swap_renames_previous_still_restorable(tmp_path):

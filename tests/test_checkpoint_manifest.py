@@ -1,0 +1,263 @@
+"""Versioned checkpoint manifests (ISSUE 9): round-trip write/verify,
+the every-field tamper refusal matrix, typed corruption errors, and
+CRC-verified cross-host replication (`replicate_checkpoint`).
+
+Model-free: the tamper matrix and replication contracts pin against the
+tiny synthetic TrainState from test_checkpoint_durability; the
+loader-level verify (real model build) lives in test_serve_hotswap.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from dsin_tpu.train import checkpoint as ckpt_lib
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.integrity import IntegrityError
+from test_checkpoint_durability import _cfgs, _make_state, _params
+
+pytestmark = pytest.mark.chaos
+
+BUCKETS = [[24, 32], [32, 48]]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _save(d, step=7, seed=0, **extra):
+    state, _ = _make_state(step=step, seed=seed)
+    _, pc = _cfgs()
+    ckpt_lib.save_checkpoint(d, state, manifest_extra={
+        "pc_config_sha256": ckpt_lib.config_sha256(pc),
+        "seed": seed, "buckets": BUCKETS, **extra})
+    return state
+
+
+def _restored(d, seed=9):
+    """A fresh template restored from `d` — what a loader verifies."""
+    import jax.numpy as jnp
+
+    from dsin_tpu.train.step import TrainState
+    state, tx = _make_state(step=0, seed=seed)
+    fresh = TrainState(params=_params(seed=seed),
+                       batch_stats={"encoder": {}, "decoder": {}},
+                       opt_state=state.opt_state,
+                       step=jnp.asarray(0, jnp.int32))
+    parts = list(ckpt_lib.AE_PARTITIONS) + ["sinet"]
+    return ckpt_lib.restore_partitions(d, fresh, parts), parts
+
+
+# -- round trip ----------------------------------------------------------------
+
+def test_manifest_roundtrip_write_then_verify(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    manifest = ckpt_lib.load_manifest(d)
+    assert manifest["manifest_version"] == ckpt_lib.MANIFEST_VERSION
+    assert manifest["step"] == 7
+    assert sorted(manifest["partition_digests"]) == sorted(
+        manifest["partitions"])
+    assert manifest["buckets"] == BUCKETS and manifest["seed"] == 0
+    # every payload file is listed with its size + CRC and checks out
+    assert set(manifest["files"]) == {
+        "params_encoder.msgpack", "params_decoder.msgpack",
+        "params_centers.msgpack", "params_probclass.msgpack",
+        "params_sinet.msgpack", "batch_stats.msgpack",
+        "opt_state.msgpack"}
+    ckpt_lib.verify_files(d, manifest)
+    restored, parts = _restored(d)
+    _, pc = _cfgs()
+    info = ckpt_lib.verify_manifest(d, restored, parts,
+                                    pc_config=pc, buckets=BUCKETS)
+    assert info["status"] == "verified"
+    assert info["manifest"]["params_digest"] == manifest["params_digest"]
+
+
+def test_manifest_written_before_meta_marker(tmp_path):
+    """meta.json is the completeness marker, so manifest must land
+    first: a dir with meta ALWAYS carries its manifest."""
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    assert os.path.exists(os.path.join(d, ckpt_lib.MANIFEST_NAME))
+    assert os.path.exists(os.path.join(d, "meta.json"))
+
+
+def test_legacy_manifestless_checkpoint_reports_legacy(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    os.remove(os.path.join(d, ckpt_lib.MANIFEST_NAME))
+    assert ckpt_lib.load_manifest(d) is None
+    restored, parts = _restored(d)
+    info = ckpt_lib.verify_manifest(d, restored, parts)
+    assert info == {"status": "legacy", "manifest": None}
+
+
+# -- the tamper refusal matrix -------------------------------------------------
+
+def _rewrite_manifest(d, mutate):
+    path = os.path.join(d, ckpt_lib.MANIFEST_NAME)
+    with open(path) as f:
+        manifest = json.load(f)
+    mutate(manifest)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+
+
+@pytest.mark.parametrize("field,mutate", [
+    ("partition_digest", lambda m: m["partition_digests"].update(
+        {"encoder": "0" * 16})),
+    ("missing_partition_digest",
+     lambda m: m["partition_digests"].pop("encoder")),
+    ("batch_stats_digest",
+     lambda m: m.update({"batch_stats_digest": "f" * 16})),
+    ("pc_config_sha256",
+     lambda m: m.update({"pc_config_sha256": "d" * 16})),
+    ("buckets", lambda m: m.update({"buckets": [[8, 8]]})),
+    ("future_version", lambda m: m.update(
+        {"manifest_version": ckpt_lib.MANIFEST_VERSION + 1})),
+    ("nonsense_version", lambda m: m.update({"manifest_version": "v9"})),
+])
+def test_every_field_tamper_is_refused_typed(tmp_path, field, mutate):
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    _rewrite_manifest(d, mutate)
+    restored, parts = _restored(d)
+    _, pc = _cfgs()
+    with pytest.raises(ckpt_lib.ManifestMismatch):
+        ckpt_lib.verify_manifest(d, restored, parts,
+                                 pc_config=pc, buckets=BUCKETS)
+
+
+def test_tampered_payload_file_fails_digest_verify(tmp_path):
+    """The params BYTES changing under an intact manifest is the rotted/
+    swapped-file case: the restored-content digest refuses it."""
+    d = str(tmp_path / "a")
+    d2 = str(tmp_path / "b")
+    _save(d, seed=0)
+    _save(d2, seed=1)
+    # transplant a different model's encoder partition under d's manifest
+    os.replace(os.path.join(d2, "params_encoder.msgpack"),
+               os.path.join(d, "params_encoder.msgpack"))
+    restored, parts = _restored(d)
+    with pytest.raises(ckpt_lib.ManifestMismatch, match="encoder"):
+        ckpt_lib.verify_manifest(d, restored, parts)
+    # and the file-level CRC check catches it without any restore
+    with pytest.raises(IntegrityError):
+        ckpt_lib.verify_files(d, ckpt_lib.load_manifest(d))
+
+
+# -- typed corruption ----------------------------------------------------------
+
+def test_corrupt_meta_raises_typed_integrity_error(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        f.write('{"step": 7, "partiti')     # torn mid-write
+    with pytest.raises(IntegrityError, match="corrupt or truncated"):
+        ckpt_lib.load_meta(d)
+    # IntegrityError IS a ValueError: every existing skip-candidate
+    # handler (restore_best_for_test, _latest_resumable) keeps working
+    assert issubclass(IntegrityError, ValueError)
+
+
+def test_corrupt_manifest_raises_typed_integrity_error(tmp_path):
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    with open(os.path.join(d, ckpt_lib.MANIFEST_NAME), "wb") as f:
+        f.write(b"\x00\xff not json")
+    with pytest.raises(IntegrityError, match="manifest"):
+        ckpt_lib.load_manifest(d)
+
+
+def test_manifest_fault_site_corruption_detected(tmp_path):
+    """The chaos corrupt-incoming-manifest path: the ckpt.manifest site
+    flips bits in the bytes a LOADER reads — detection must be typed
+    (IntegrityError for unparseable, ManifestMismatch for a parsed
+    lie), never a silent adoption."""
+    d = str(tmp_path / "ckpt")
+    _save(d)
+    restored, parts = _restored(d)
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="ckpt.manifest", action="corrupt", flips=64)], seed=3)
+    with faults.installed(plan):
+        with pytest.raises(ValueError):
+            ckpt_lib.verify_manifest(d, restored, parts)
+    assert plan.activations["ckpt.manifest"] == 1
+
+
+# -- cross-host replication ----------------------------------------------------
+
+def test_replicate_checkpoint_crc_verified_copy(tmp_path):
+    src = str(tmp_path / "ckpt")
+    dest = str(tmp_path / "peer" / "ckpt")
+    state = _save(src)
+    rep = ckpt_lib.replicate_checkpoint(src, dest)
+    assert rep["files"] == 7 and rep["bytes"] > 0
+    assert rep["params_digest"] == \
+        ckpt_lib.load_manifest(src)["params_digest"]
+    # the replica is a complete, verifiable checkpoint a peer adopts
+    manifest = ckpt_lib.load_manifest(dest)
+    assert manifest == ckpt_lib.load_manifest(src)
+    ckpt_lib.verify_files(dest, manifest)
+    restored, parts = _restored(dest)
+    assert ckpt_lib.verify_manifest(dest, restored, parts)["status"] \
+        == "verified"
+    import jax
+    import numpy as np
+    src_restored, _ = _restored(src)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_replicate_resolves_rotated_prev_after_swap_kill(tmp_path):
+    """The `.prev-*` follow-up: after a kill between the swap renames
+    the only complete checkpoint is the rotated prev — replication must
+    adopt THAT, not fail on the absent live dir."""
+    src = str(tmp_path / "ckpt")
+    dest = str(tmp_path / "peer" / "ckpt")
+    _save(src)
+    os.rename(src, src + ".prev-000001")     # the kill-window state
+    rep = ckpt_lib.replicate_checkpoint(src, dest)
+    assert ".prev-" in rep["src"]
+    assert ckpt_lib.load_manifest(dest)["step"] == 7
+
+
+def test_replicate_refuses_manifestless_source(tmp_path):
+    src = str(tmp_path / "ckpt")
+    _save(src)
+    os.remove(os.path.join(src, ckpt_lib.MANIFEST_NAME))
+    with pytest.raises(ckpt_lib.ManifestMismatch, match="no manifest"):
+        ckpt_lib.replicate_checkpoint(src, str(tmp_path / "peer"))
+
+
+def test_replicate_detects_source_rot(tmp_path):
+    src = str(tmp_path / "ckpt")
+    _save(src)
+    path = os.path.join(src, "params_encoder.msgpack")
+    with open(path, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0x40]))
+    with pytest.raises(IntegrityError):
+        ckpt_lib.replicate_checkpoint(src, str(tmp_path / "peer"))
+    assert not os.path.exists(str(tmp_path / "peer"))
+
+
+def test_replicate_rotates_existing_destination(tmp_path):
+    src = str(tmp_path / "ckpt")
+    dest = str(tmp_path / "peer" / "ckpt")
+    _save(src, step=7)
+    ckpt_lib.replicate_checkpoint(src, dest)
+    _save(src, step=8, seed=1)
+    ckpt_lib.replicate_checkpoint(src, dest)
+    assert ckpt_lib.load_manifest(dest)["step"] == 8
+    prevs = ckpt_lib._prev_dirs(str(tmp_path / "peer"), "ckpt")
+    assert len(prevs) == 1
+    assert ckpt_lib.load_manifest(prevs[0])["step"] == 7

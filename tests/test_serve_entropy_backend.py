@@ -307,7 +307,8 @@ def test_process_pool_swapped_after_hung_child(tiny_cfg_files):
         frame = svc.encode(img, timeout=60).stream
         before = svc.metrics.counter("serve_entropy_proc_rebuilds").value
         with pytest.raises(TimeoutError, match="stuck"):
-            svc._proc_call(time.sleep, 5)        # a child that hangs
+            # a child that hangs, against the live bundle's pool
+            svc._proc_call(svc._swap.current, time.sleep, 5)
         after = svc.metrics.counter("serve_entropy_proc_rebuilds").value
         assert after == before + 1, "wedged pool was never swapped"
         # the task timeout covers the whole future, including the fresh
@@ -334,7 +335,8 @@ def test_proc_call_survives_racing_pool_swap(tiny_cfg_files):
         img = _img(rng)
         frame = svc.encode(img, timeout=60).stream
         # simulate losing the race: "another thread" shut our pool down
-        svc._entropy_proc.shutdown(wait=False)
+        # (the pool lives in the current ModelBundle since ISSUE 9)
+        svc._swap.current.proc().shutdown(wait=False)
         assert svc.encode(img, timeout=120).stream == frame, \
             "retry on the fresh pool diverged"
         rebuilds = svc.metrics.counter(

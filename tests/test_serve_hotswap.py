@@ -1,0 +1,355 @@
+"""Zero-downtime model hot-swap on a LIVE service (ISSUE 9).
+
+The acceptance contract, end to end: requests issued before, during,
+and after `swap_model` all succeed; every encode stream is
+byte-identical to the OLD model's output or the NEW model's (a torn
+batch mixing params would match neither); `CompilationSentinel(
+budget=0)` holds through prepare + commit + post-swap traffic; and
+`rollback()` restores old-model bit-identity with ZERO new compiles.
+Plus the refusal matrix at the service door: manifest mismatch, wrong
+bucket ladder, legacy manifest-less checkpoint, double prepare.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import (CompressionService, ManifestMismatch,
+                            ServiceConfig, SwapError)
+from dsin_tpu.train import checkpoint as ckpt_lib
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.recompile import CompilationSentinel
+
+BUCKETS = ((16, 24),)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(scope="module")
+def cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("hotswap_cfg")
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(tiny_ae_cfg(crop_size=(16, 24), batch_size=1)))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def _save_model_ckpt(cfg_files, out_dir, seed):
+    """A swap-eligible checkpoint: a real (tiny) model at `seed`, saved
+    with the full manifest identity the service verifies."""
+    from dsin_tpu.coding.loader import load_model_state
+    ae_p, pc_p = cfg_files
+    model, state = load_model_state(ae_p, pc_p, None, BUCKETS[-1],
+                                    need_sinet=False, seed=seed)
+    ckpt_lib.save_checkpoint(out_dir, state, manifest_extra={
+        "pc_config_sha256": ckpt_lib.config_sha256(model.pc_config),
+        "seed": seed, "buckets": [list(b) for b in BUCKETS]})
+    return out_dir
+
+
+@pytest.fixture(scope="module")
+def swap_rig(cfg_files, tmp_path_factory):
+    """One warmed service + a second-model checkpoint, shared across
+    the module (model builds dominate test wall time); every test must
+    leave the service back on the ORIGINAL bundle."""
+    ae_p, pc_p = cfg_files
+    d = tmp_path_factory.mktemp("hotswap")
+    ckpt_b = _save_model_ckpt(cfg_files, str(d / "ckpt_b"), seed=1)
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS,
+        max_batch=2, max_wait_ms=2.0, max_queue=64, workers=1)).start()
+    svc.warmup()
+    yield svc, ckpt_b, str(d)
+    svc.drain()
+
+
+def _imgs(n=2):
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, 255, (16, 24, 3), dtype=np.uint8)
+            for _ in range(n)]
+
+
+def _await_backlog(svc, timeout_s=60.0):
+    """Let the queue left by a load phase drain before reference
+    encodes — a full queue sheds them at the door (typed, but not what
+    these tests measure)."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while svc._batcher.depth > 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+
+
+def test_hot_swap_under_load_bit_identity_and_rollback(swap_rig):
+    svc, ckpt_b, _ = swap_rig
+    imgs = _imgs()
+    digest_a = svc.model_digest
+    a_streams = [svc.encode(img).stream for img in imgs]
+
+    with CompilationSentinel(budget=0, label="hot swap"):
+        # load DURING the swap: a submitter thread keeps the service
+        # busy while prepare warms and commit lands
+        futures, stop = [], threading.Event()
+
+        def _submit():
+            import time
+
+            from dsin_tpu.serve import ServeError
+            i = 0
+            while not stop.is_set():
+                try:
+                    futures.append((i % len(imgs), svc.submit_encode(
+                        imgs[i % len(imgs)])))
+                except ServeError:
+                    time.sleep(0.002)    # backpressure: typed shed, retry
+                i += 1
+
+        t = threading.Thread(target=_submit, name="hotswap-load")
+        t.start()
+        try:
+            info = svc.swap_model(ckpt_b)
+        finally:
+            stop.set()
+            t.join(30)
+        digest_b = info["digest"]
+        assert digest_b != digest_a
+        assert svc.model_digest == digest_b
+        # post-swap reference + tail traffic, still inside the sentinel
+        _await_backlog(svc)
+        b_streams = [svc.encode(img).stream for img in imgs]
+        for i, img in enumerate(imgs):
+            futures.append((i, svc.submit_encode(img)))
+
+        old = new = 0
+        for idx, f in futures:
+            res = f.result(timeout=60)    # every request SUCCEEDS
+            if res.model_digest == digest_a:
+                assert res.stream == a_streams[idx]   # no torn batch
+                old += 1
+            else:
+                assert res.model_digest == digest_b
+                assert res.stream == b_streams[idx]
+                new += 1
+        assert new > 0, "no response ever came from the new model"
+        assert b_streams[0] != a_streams[0]
+
+        # instant rollback: bit-identity back, zero compiles (the
+        # sentinel is still open)
+        svc.rollback()
+        assert svc.model_digest == digest_a
+        for i, img in enumerate(imgs):
+            assert svc.encode(img).stream == a_streams[i]
+
+    counters = svc.metrics.snapshot()["counters"]
+    assert counters["serve_swaps"] >= 1
+    assert counters["serve_rollbacks"] >= 1
+
+
+def test_swap_metrics_and_health_surface(swap_rig):
+    svc, ckpt_b, _ = swap_rig
+    digest_a = svc.model_digest
+    svc.swap_model(ckpt_b)
+    try:
+        snap = svc.metrics.snapshot()
+        model = snap["info"]["serve_model_digest"]
+        assert model["digest"] == svc.model_digest != digest_a
+        assert model["prev_digest"] == digest_a
+        assert model["swap_state"] == 0 and model["ckpt"] == ckpt_b
+        assert snap["gauges"]["serve_swap_state"] == 0
+        health = svc.health()["model"]
+        assert health["digest"] == svc.model_digest
+    finally:
+        svc.rollback()
+    assert svc.health()["model"]["digest"] == digest_a
+
+
+def test_swap_refuses_wrong_pc_config_hash(swap_rig, tmp_path):
+    svc, _, _ = swap_rig
+    import json
+    ckpt = _save_model_ckpt(
+        (svc.config.ae_config, svc.config.pc_config),
+        str(tmp_path / "bad_pc"), seed=2)
+    path = os.path.join(ckpt, ckpt_lib.MANIFEST_NAME)
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["pc_config_sha256"] = "0" * 16
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    digest_a = svc.model_digest
+    with pytest.raises(ManifestMismatch, match="probability-model"):
+        svc.swap_model(ckpt)
+    assert svc.model_digest == digest_a
+    assert svc.health()["model"]["swap_state"] == 0
+
+
+def test_swap_refuses_wrong_bucket_ladder(swap_rig, tmp_path):
+    svc, _, _ = swap_rig
+    import json
+    ckpt = _save_model_ckpt(
+        (svc.config.ae_config, svc.config.pc_config),
+        str(tmp_path / "bad_buckets"), seed=2)
+    path = os.path.join(ckpt, ckpt_lib.MANIFEST_NAME)
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["buckets"] = [[64, 64]]
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ManifestMismatch, match="bucket ladder"):
+        svc.swap_model(ckpt)
+    assert svc.health()["model"]["swap_state"] == 0
+
+
+def test_swap_refuses_legacy_manifestless_checkpoint(swap_rig, tmp_path):
+    svc, _, _ = swap_rig
+    ckpt = _save_model_ckpt(
+        (svc.config.ae_config, svc.config.pc_config),
+        str(tmp_path / "legacy"), seed=2)
+    os.remove(os.path.join(ckpt, ckpt_lib.MANIFEST_NAME))
+    errors_before = svc.metrics.counter("serve_swap_errors").value
+    with pytest.raises(ManifestMismatch, match="no manifest"):
+        svc.swap_model(ckpt)
+    assert svc.metrics.counter("serve_swap_errors").value > errors_before
+
+
+def test_cold_start_warns_on_legacy_checkpoint(cfg_files, tmp_path):
+    """Cold START (unlike hot swap) still accepts a pre-manifest
+    checkpoint, with a recorded warning — the migration path for
+    checkpoints saved before ISSUE 9."""
+    from dsin_tpu.coding.loader import load_model_state
+    ae_p, pc_p = cfg_files
+    ckpt = _save_model_ckpt(cfg_files, str(tmp_path / "legacy"), seed=1)
+    os.remove(os.path.join(ckpt, ckpt_lib.MANIFEST_NAME))
+    with pytest.warns(UserWarning, match="predates manifest"):
+        load_model_state(ae_p, pc_p, ckpt, BUCKETS[-1],
+                         need_sinet=False, seed=0)
+
+
+def test_cold_start_verifies_manifest_and_refuses_mismatch(
+        cfg_files, tmp_path):
+    import json
+
+    from dsin_tpu.coding.loader import load_model_state
+    ae_p, pc_p = cfg_files
+    ckpt = _save_model_ckpt(cfg_files, str(tmp_path / "ok"), seed=1)
+    # clean load verifies silently
+    load_model_state(ae_p, pc_p, ckpt, BUCKETS[-1],
+                     need_sinet=False, seed=0)
+    path = os.path.join(ckpt, ckpt_lib.MANIFEST_NAME)
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["partition_digests"]["encoder"] = "0" * 16
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ManifestMismatch, match="encoder"):
+        load_model_state(ae_p, pc_p, ckpt, BUCKETS[-1],
+                         need_sinet=False, seed=0)
+
+
+def test_double_prepare_refused_and_abort_recovers(swap_rig):
+    svc, ckpt_b, _ = swap_rig
+    digest_a = svc.model_digest
+    info = svc.prepare_swap(ckpt_b)
+    try:
+        assert svc.health()["model"]["swap_state"] == 2    # staged
+        with pytest.raises(SwapError, match="already staged"):
+            svc.prepare_swap(ckpt_b)
+        # staged does NOT serve: traffic still answers with the old model
+        assert svc.encode(_imgs(1)[0]).model_digest == digest_a
+    finally:
+        svc.abort_swap()
+    assert svc.health()["model"]["swap_state"] == 0
+    assert svc.model_digest == digest_a
+    # commit without a staged bundle is typed
+    with pytest.raises(SwapError, match="no staged bundle"):
+        svc.commit_swap()
+    # and a commit pinned to the WRONG digest refuses + keeps staging
+    svc.prepare_swap(ckpt_b)
+    try:
+        with pytest.raises(SwapError, match="not the expected"):
+            svc.commit_swap(expect_digest="beef" * 4)
+    finally:
+        svc.abort_swap()
+    assert svc.model_digest == digest_a
+    del info
+
+
+def test_conditional_rollback_refuses_wrong_current(swap_rig):
+    """The fleet commit-failure recovery sends rollback CONDITIONED on
+    the digest being rolled away — a replica that never committed must
+    refuse instead of re-instating some older model."""
+    svc, ckpt_b, _ = swap_rig
+    digest_a = svc.model_digest
+    info = svc.swap_model(ckpt_b)
+    try:
+        with pytest.raises(SwapError, match="conditional rollback"):
+            svc.rollback(expect_current="not-the-digest")
+        assert svc.model_digest == info["digest"]    # untouched
+        svc.rollback(expect_current=info["digest"])  # guard matches
+    finally:
+        if svc.model_digest != digest_a:
+            svc.rollback()
+    assert svc.model_digest == digest_a
+
+
+def test_abort_cancels_in_flight_prepare():
+    """An abort landing while a prepare is still LOADING (the fleet
+    abort racing a slow replica) must refuse the late stage() — a
+    parked bundle nobody will ever commit would wedge every future
+    swap."""
+    from dsin_tpu.serve import (MetricsRegistry, ModelBundle,
+                                SwapCoordinator)
+    coord = SwapCoordinator(ModelBundle(0, "d0", None, None, []),
+                            MetricsRegistry())
+    epoch = coord.begin_prepare()
+    late = ModelBundle(epoch, "d1", None, None, [])
+    assert coord.abort() == []          # lands mid-prepare: cancels it
+    with pytest.raises(SwapError, match="aborted while"):
+        coord.stage(late)
+    # the preparer's own cleanup path releases the claim...
+    coord.abandon_prepare()
+    # ...after which a fresh prepare/stage/commit cycle works
+    epoch2 = coord.begin_prepare()
+    fresh = ModelBundle(epoch2, "d2", None, None, [])
+    coord.stage(fresh)
+    coord.commit(expect_digest="d2")
+    assert coord.current.digest == "d2"
+
+
+def test_rollback_with_no_prev_is_typed(cfg_files):
+    ae_p, pc_p = cfg_files
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS,
+        max_batch=1, max_wait_ms=1.0, max_queue=8, workers=1)).start()
+    try:
+        with pytest.raises(SwapError, match="roll back"):
+            svc.rollback()
+    finally:
+        svc.drain()
+
+
+def test_kill_in_commit_window_keeps_old_model(swap_rig):
+    """The serve.swap fault site, commit window: the crash escapes to
+    the operator, the staged bundle is discarded, and the service keeps
+    serving the old params bit-identically."""
+    svc, ckpt_b, _ = swap_rig
+    imgs = _imgs(1)
+    digest_a = svc.model_digest
+    ref = svc.encode(imgs[0]).stream
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site="serve.swap", action="crash", after=1, times=1)], seed=0)
+    with faults.installed(plan):
+        with pytest.raises(faults.InjectedCrash):
+            svc.swap_model(ckpt_b)
+    assert plan.activations["serve.swap"] == 1
+    assert svc.model_digest == digest_a
+    assert svc.health()["model"]["swap_state"] == 0
+    assert svc.encode(imgs[0]).stream == ref

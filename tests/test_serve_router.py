@@ -106,6 +106,16 @@ class _Fakes:
         self.respond = {i: True for i in range(n)}
         self.dead = {i: threading.Event() for i in range(n)}
         self.threads = {}
+        # fleet hot-swap controls (ISSUE 9): what each replica reports
+        # at prepare, whether a phase fails, and the op ledger
+        self.prepare_digests = {i: "dnew" for i in range(n)}
+        self.fail_prepare = {i: None for i in range(n)}
+        self.fail_commit = {i: None for i in range(n)}
+        self.hang_prepare = {i: False for i in range(n)}
+        self.got_prepare = {i: threading.Event() for i in range(n)}
+        self.committed = {i: [] for i in range(n)}
+        self.aborted = {i: 0 for i in range(n)}
+        self.rolled_back = {i: 0 for i in range(n)}
 
     def launcher(self, config, idx, ctx):
         parent, child = multiprocessing.Pipe(duplex=True)
@@ -139,6 +149,32 @@ class _Fakes:
                     pass
                 return
             op, rid, payload, priority, deadline_ms = msg
+            if op == "swap_prepare":
+                self.got_prepare[idx].set()
+                if self.hang_prepare[idx]:
+                    continue              # never answers (death races)
+                if self.fail_prepare[idx] is not None:
+                    conn.send(("err", rid, self.fail_prepare[idx]))
+                else:
+                    conn.send(("ok", rid,
+                               {"digest": self.prepare_digests[idx],
+                                "epoch": 1, "ckpt": payload}))
+                continue
+            if op == "swap_commit":
+                if self.fail_commit[idx] is not None:
+                    conn.send(("err", rid, self.fail_commit[idx]))
+                else:
+                    self.committed[idx].append(payload)
+                    conn.send(("ok", rid, {"digest": payload}))
+                continue
+            if op == "swap_abort":
+                self.aborted[idx] += 1
+                conn.send(("ok", rid, {"swap_state": 0}))
+                continue
+            if op == "rollback":
+                self.rolled_back[idx] += 1
+                conn.send(("ok", rid, {"digest": self.digests[idx]}))
+                continue
             self.received[idx].append((op, rid, priority))
             self.deadlines[idx].append(deadline_ms)
             self.got_request[idx].set()
@@ -383,6 +419,265 @@ def test_healthz_eviction_and_readmission():
         server.stop()
 
 
+# -- fleet-coordinated hot swap (ISSUE 9) -------------------------------------
+
+def test_fleet_swap_commits_only_on_unanimous_digest():
+    from dsin_tpu.serve.router import FleetSwapError
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        out = r.swap_model("/ckpt/new")
+        assert out["digest"] == "dnew"
+        assert out["replicas"] == [0, 1]
+        # every replica committed EXACTLY the unanimous digest
+        assert fakes.committed == {0: ["dnew"], 1: ["dnew"]}
+        assert fakes.aborted == {0: 0, 1: 0}
+        assert r.params_digest == "dnew"
+        assert r.metrics.counter("serve_router_swaps").value == 1
+        # traffic still flows after the swap
+        assert r.encode("img", timeout=5)[1] in (0, 1)
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_fleet_swap_aborts_on_digest_disagreement():
+    """Two replicas building DIFFERENT models from one checkpoint path
+    is the split-fleet hazard: NOTHING commits, both staged bundles
+    abort, the old model keeps serving."""
+    from dsin_tpu.serve.router import FleetSwapError
+    fakes = _Fakes(2)
+    fakes.prepare_digests = {0: "aaaa", 1: "bbbb"}
+    r = _router(fakes).start()
+    try:
+        with pytest.raises(FleetSwapError, match="did not converge") as ei:
+            r.swap_model("/ckpt/new")
+        assert sorted(ei.value.per_replica) == [0, 1]
+        assert fakes.committed == {0: [], 1: []}
+        assert fakes.aborted == {0: 1, 1: 1}
+        assert r.params_digest == "d0"          # unchanged
+        assert r.metrics.counter(
+            "serve_router_swap_aborts").value == 1
+        assert r.encode("img", timeout=5)[1] in (0, 1)
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_fleet_swap_prepare_failure_aborts_whole_fleet():
+    """One replica's typed refusal (e.g. ManifestMismatch) aborts every
+    OTHER replica's staged bundle too — all-or-nothing."""
+    from dsin_tpu.serve.router import FleetSwapError
+    from dsin_tpu.train.checkpoint import ManifestMismatch
+    fakes = _Fakes(2)
+    fakes.fail_prepare[1] = ManifestMismatch("pc hash mismatch")
+    r = _router(fakes).start()
+    try:
+        with pytest.raises(FleetSwapError) as ei:
+            r.swap_model("/ckpt/new")
+        assert isinstance(ei.value.per_replica[1], ManifestMismatch)
+        assert fakes.committed == {0: [], 1: []}
+        assert fakes.aborted[0] == 1            # the healthy one aborts
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_fleet_swap_replica_death_mid_prepare_aborts_cleanly():
+    """The kill-during-hot-swap contract at fleet level: a replica
+    dying while it prepares fails ITS phase typed (control ops are
+    never rerouted), the fleet aborts, and the survivor keeps serving
+    the old model."""
+    from dsin_tpu.serve.batcher import ServiceUnavailable
+    from dsin_tpu.serve.router import FleetSwapError
+    fakes = _Fakes(2)
+    fakes.hang_prepare[0] = True
+    r = _router(fakes).start()
+    try:
+        out = {}
+        t = threading.Thread(target=lambda: out.update(
+            _run_swap(r, "/ckpt/new")))
+        t.start()
+        assert fakes.got_prepare[0].wait(5)
+        fakes.kill(0)                 # dies holding its prepare
+        t.join(10)
+        assert not t.is_alive()
+        exc = out["exc"]
+        assert isinstance(exc, FleetSwapError)
+        assert isinstance(exc.per_replica[0], ServiceUnavailable)
+        assert fakes.committed[1] == [] and fakes.aborted[1] == 1
+        # the survivor still serves, old model
+        assert r.encode("img", timeout=5)[1] == 1
+        assert r.params_digest == "d0"
+    finally:
+        r.drain(timeout_s=5)
+
+
+def _run_swap(router, ckpt):
+    try:
+        return {"res": router.swap_model(ckpt), "exc": None}
+    except BaseException as e:  # noqa: BLE001 — the test inspects it
+        return {"res": None, "exc": e}
+
+
+def test_fleet_commit_failure_rolls_back_committed_replicas():
+    """Partial commit is the worst case: whoever committed must roll
+    BACK so the fleet converges on the old model, never a split."""
+    from dsin_tpu.serve.router import FleetSwapError
+    fakes = _Fakes(2)
+    fakes.fail_commit[1] = RuntimeError("commit wedged")
+    r = _router(fakes).start()
+    try:
+        with pytest.raises(FleetSwapError, match="rolled back"):
+            r.swap_model("/ckpt/new")
+        assert fakes.committed[0] == ["dnew"]
+        assert fakes.rolled_back[0] == 1        # converged back down
+        assert fakes.aborted[1] == 1            # staged bundle discarded
+        assert r.params_digest == "d0"
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_fleet_rollback_fans_out_and_reports_digest():
+    fakes = _Fakes(2)
+    r = _router(fakes).start()
+    try:
+        out = r.rollback()
+        assert out["digest"] == "d0" and out["replicas"] == [0, 1]
+        assert fakes.rolled_back == {0: 1, 1: 1}
+        assert r.metrics.counter("serve_router_rollbacks").value == 1
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_concurrent_fleet_swaps_refused_typed():
+    from dsin_tpu.serve.router import FleetSwapError
+    fakes = _Fakes(1)
+    fakes.hang_prepare[0] = True
+    r = _router(fakes, replicas=1).start()
+    try:
+        out = {}
+        t = threading.Thread(target=lambda: out.update(
+            _run_swap(r, "/ckpt/new")))
+        t.start()
+        assert fakes.got_prepare[0].wait(5)
+        with pytest.raises(FleetSwapError, match="already in flight"):
+            r.swap_model("/ckpt/other")
+        fakes.kill(0)                # release the hung prepare
+        t.join(10)
+    finally:
+        r.drain(timeout_s=5)
+
+
+def test_readmission_refused_while_digest_disagrees_with_fleet():
+    """A replica that sat out a fleet swap evicted must NOT be
+    readmitted while it still serves the old model — that would split
+    the fleet. One healthy poll with the matching digest readmits."""
+    state = {"status": "ok", "model": {"digest": "dold"}}
+    server = MetricsServer(MetricsRegistry(), lambda: dict(state),
+                           port=0).start()
+    try:
+        fakes = _Fakes(2, health_ports=[server.port, None])
+        r = _router(fakes, poll_every_s=0.05, evict_after=2,
+                    health_timeout_s=1.0).start()
+        try:
+            r.params_digest = "dold"
+            state["status"] = "unhealthy"
+            deadline = time.monotonic() + 5
+            while r.health()["replicas"]["0"] != "evicted":
+                assert time.monotonic() < deadline, r.health()
+                time.sleep(0.02)
+            # the fleet swaps while replica 0 is out
+            r.params_digest = "dnew"
+            state["status"] = "ok"            # healthy again, OLD model
+            deadline = time.monotonic() + 2
+            while r.metrics.counter(
+                    "serve_router_digest_skew").value == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            assert r.health()["replicas"]["0"] == "evicted"   # kept out
+            state["model"] = {"digest": "dnew"}   # re-swapped/restarted
+            deadline = time.monotonic() + 5
+            while r.health()["replicas"]["0"] != "live":
+                assert time.monotonic() < deadline, r.health()
+                time.sleep(0.02)
+        finally:
+            r.drain(timeout_s=5)
+    finally:
+        server.stop()
+
+
+# -- router-level /metrics aggregation (ISSUE 9 satellite) --------------------
+
+def test_aggregated_metrics_merges_replica_snapshots():
+    """The one-endpoint operator view: counters/gauges/accumulators
+    sum across replicas, histograms merge (count-weighted mean, max
+    p99), per-replica model digests land in the info section."""
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    servers = []
+    for i, reg in enumerate(regs):
+        reg.counter("serve_completed").inc(10 * (i + 1))
+        reg.gauge("serve_queue_depth").set(3 * (i + 1))
+        reg.accumulator("serve_device_ms_total").add(100.0 * (i + 1))
+        for v in ([5.0] * 4 if i == 0 else [50.0] * 6):
+            reg.histogram("serve_latency_ms").observe(v)
+        reg.set_info("serve_model_digest",
+                     {"digest": f"m{i}", "epoch": i})
+        servers.append(MetricsServer(reg, lambda: {"status": "ok"},
+                                     port=0).start())
+    try:
+        fakes = _Fakes(2, health_ports=[s.port for s in servers])
+        r = _router(fakes).start()
+        try:
+            r.metrics.counter("serve_completed").inc(1)  # router's own
+            snap = r.aggregate.snapshot()
+            assert snap["counters"]["serve_completed"] == 31
+            assert snap["gauges"]["serve_queue_depth"] == 9.0
+            assert snap["accumulators"]["serve_device_ms_total"] == 300.0
+            lat = snap["histograms"]["serve_latency_ms"]
+            assert lat["count"] == 10
+            assert lat["mean"] == pytest.approx((4 * 5 + 6 * 50) / 10)
+            assert lat["p99"] == 50.0                 # fleet-wide max
+            info = snap["info"]
+            assert info["replica_digests"] == {"0": "m0", "1": "m1"}
+            assert info["replicas_scraped"] == 2
+            assert info["replicas_unreachable"] == []
+            # renders through the shared text formatter
+            text = r.aggregate.render_text()
+            assert "serve_completed_total 31" in text
+            assert "# replica_digests" in text
+        finally:
+            r.drain(timeout_s=5)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_aggregated_metrics_served_over_http_and_survives_dead_scrape():
+    import urllib.request
+    reg = MetricsRegistry()
+    reg.counter("serve_completed").inc(5)
+    server = MetricsServer(reg, lambda: {"status": "ok"}, port=0).start()
+    try:
+        # replica 1 advertises a port nobody listens on -> unreachable,
+        # reported as data instead of failing the scrape
+        fakes = _Fakes(2, health_ports=[server.port, 1])
+        r = _router(fakes, metrics_port=0).start()
+        try:
+            port = r._metrics_server.port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=json",
+                    timeout=5) as resp:
+                snap = __import__("json").loads(resp.read())
+            assert snap["counters"]["serve_completed"] == 5
+            assert snap["info"]["replicas_unreachable"] == [1]
+            assert snap["info"]["replicas_scraped"] == 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            r.drain(timeout_s=5)
+    finally:
+        server.stop()
+
+
 # -- real shared-nothing replicas (spawn) -------------------------------------
 
 @pytest.fixture(scope="module")
@@ -434,6 +729,31 @@ def test_spawned_replicas_bit_identical_to_single_process(tiny_cfg_files):
             streams[i] = a.stream
         decoded = router.decode(streams[1], timeout=120.0)
         assert decoded.shape == (10, 17, 3)
+        # fleet hot swap over REAL replica processes (ISSUE 9): both
+        # replicas prepare the same manifested checkpoint, report one
+        # digest, commit unanimously — and stay bit-identical to each
+        # other on the NEW model; rollback restores the old streams
+        import tempfile
+
+        from dsin_tpu.coding.loader import load_model_state
+        from dsin_tpu.train import checkpoint as ckpt_lib
+        model_b, state_b = load_model_state(ae_p, pc_p, None, (16, 24),
+                                            need_sinet=False, seed=11)
+        ckpt_b = tempfile.mkdtemp(prefix="router_swap_") + "/ckpt"
+        ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra={
+            "pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+            "seed": 11, "buckets": [[16, 24]]})
+        old_digest = router.params_digest
+        out = router.swap_model(ckpt_b)
+        assert out["digest"] != old_digest
+        assert router.params_digest == out["digest"]
+        x = router.encode(imgs[0], timeout=120.0)       # replica A
+        y = router.encode(imgs[0], timeout=120.0)       # replica B
+        assert x.stream == y.stream != streams[0]
+        assert x.model_digest == out["digest"]
+        back = router.rollback()
+        assert back["digest"] == old_digest
+        assert router.encode(imgs[0], timeout=120.0).stream == streams[0]
         snap = router.metrics.snapshot()["counters"]
         assert snap.get("serve_router_routed_r0", 0) > 0
         assert snap.get("serve_router_routed_r1", 0) > 0

@@ -245,6 +245,30 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
         "worker recovery recompiled instead of reusing executables")
     assert report["load"]["completed_ok"] > 0
     assert report["clean_decodes_after_chaos"] > 0
+    # ISSUE 9: the live-model-operations battery rides every chaos run —
+    # pin the hotswap section's shape so a silent scenario removal
+    # cannot pass the suite
+    hs = report["hotswap"]
+    assert hs["violations"] == []
+    sc = hs["scenarios"]
+    assert sc["kill_prepare"]["killed"] is True
+    assert sc["kill_commit"]["killed"] is True
+    assert sc["corrupt_manifest"]["detected"] is True
+    sw = sc["swap_under_load"]
+    assert sw["hung_futures"] == 0
+    assert sw["untyped_errors"] == 0
+    assert sw["wrong_digest_responses"] == 0, (
+        "a torn batch mixed params across the swap")
+    assert sw["new_model_responses"] > 0
+    assert sw["digest_a"] != sw["digest_b"]
+    assert sc["rollback"]["bit_identical_to_pre_swap"] is True
+    assert hs["steady_compiles"] == 0, (
+        "the hot swap compiled in steady state — the census warm "
+        "must reuse every executable")
+    assert hs["lock_order_inversions"] == 0
+    assert hs["replication"]["files"] > 0
+    assert hs["swap_counters"]["serve_swaps"] >= 1
+    assert hs["swap_counters"]["serve_rollbacks"] >= 1
 
 
 def test_cache_dir_keyed_by_host_fingerprint(monkeypatch, tmp_path):

@@ -36,6 +36,22 @@ satellite, the PR 7 follow-up) runs the whole battery over the spawn
 process pool of worker-resident codecs — the committed
 CHAOS_BENCH.json soaks that path.
 
+Hot-swap battery (ISSUE 9): every run also soaks the LIVE MODEL
+OPERATIONS path — a second model checkpoint is saved (manifest +
+per-file CRCs), replicated cross-host-style via
+`replicate_checkpoint` (CRC-verified copy, manifest check), and then
+adopted by a running service through `swap_model` under four
+scenarios: a kill injected in the PREPARE window (`serve.swap` crash),
+a kill in the COMMIT window, a corrupted incoming `manifest.json`
+(`ckpt.manifest` corrupt — the swap must refuse typed), and a clean
+swap UNDER LOAD followed by an instant `rollback()`. Invariants: zero
+hung futures, zero WRONG-DIGEST responses (every encode during the
+swap is byte-identical to the old model's stream or the new model's —
+no torn batch mixes params), the service still serves the OLD params
+after every abort, and zero steady-state compiles across swap +
+rollback. `--hotswap_only` runs just this battery (the fail-fast
+`hotswap-chaos` tpu_session.sh stage).
+
 Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
 (tests/test_tools_smoke.py) and the `chaos-smoke` stage of
 tools/tpu_session.sh.
@@ -43,6 +59,7 @@ tools/tpu_session.sh.
 Usage:
     python tools/chaos_bench.py                        # committed artifact
     python tools/chaos_bench.py --smoke --out /tmp/c.json   # tier-1 CI
+    python tools/chaos_bench.py --smoke --hotswap_only      # swap battery
 """
 
 import argparse
@@ -306,6 +323,252 @@ def run_chaos(args) -> dict:
     return report
 
 
+def run_hotswap(args) -> dict:
+    """The live-model-operations battery (see module docstring)."""
+    import tempfile
+    import threading
+
+    from dsin_tpu.coding.loader import load_model_state
+    from dsin_tpu.serve import (CompressionService, ServeError,
+                                ServiceConfig)
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.utils import faults, locks
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    from tools.serve_bench import _parse_shapes
+
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled — the swap soak needs them"
+
+    shapes = _parse_shapes(args.shapes)
+    buckets = _parse_shapes(args.buckets)
+    cfg = ServiceConfig(
+        ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
+        seed=args.seed, buckets=buckets, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        workers=args.workers, entropy_workers=args.entropy_workers,
+        entropy_backend=args.entropy_backend,
+        pipeline_depth=args.pipeline_depth)
+    service = CompressionService(cfg).start()
+    warm = service.warmup()
+    rng = np.random.default_rng(args.seed + 7)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    violations = []
+    t0 = time.monotonic()
+
+    # a SECOND model (different seed -> different params), saved with a
+    # full manifest, then adopted from its CRC-verified cross-host
+    # replica — the swap source is the replicated copy on purpose
+    model_b, state_b = load_model_state(
+        args.ae_config, args.pc_config, None, tuple(buckets[-1]),
+        need_sinet=False, seed=args.seed + 1)
+    tmpd = tempfile.mkdtemp(prefix="chaos_hotswap_")
+    ckpt_b = os.path.join(tmpd, "ckpt_b")
+    ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra={
+        "pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+        "seed": args.seed + 1,
+        "buckets": [list(b) for b in buckets]})
+    replica_dir = os.path.join(tmpd, "peer_host", "ckpt_b")
+    replication = ckpt_lib.replicate_checkpoint(ckpt_b, replica_dir)
+
+    digest_a = service.model_digest
+    scenarios = {}
+    inversions_before = locks.inversion_count()
+    with CompilationSentinel(budget=0, label="hotswap steady state",
+                             raise_on_exceed=False) as sentinel:
+        a_streams = [service.encode(img, timeout=args.timeout_s).stream
+                     for img in images]
+
+        def _still_old(tag):
+            """After an abort the service must keep serving the OLD
+            params, bit-identically, with the swap machinery idle."""
+            if service.model_digest != digest_a:
+                violations.append(f"{tag}: service digest moved off the "
+                                  f"old model after an abort")
+            snap = service.health()["model"]
+            if snap["swap_state"] != 0 or snap["staged_digest"]:
+                violations.append(f"{tag}: swap not idle after abort: "
+                                  f"{snap}")
+            if service.encode(images[0],
+                              timeout=args.timeout_s).stream \
+                    != a_streams[0]:
+                violations.append(f"{tag}: old-model stream changed "
+                                  f"after abort")
+
+        # -- kill in the PREPARE window -----------------------------------
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.swap", action="crash", times=1)], seed=args.seed)
+        killed = False
+        with faults.installed(plan):
+            try:
+                service.swap_model(replica_dir)
+            except faults.InjectedCrash:
+                killed = True
+        if not killed:
+            violations.append("kill_prepare: the injected crash never "
+                              "fired (vacuous scenario)")
+        _still_old("kill_prepare")
+        scenarios["kill_prepare"] = {"killed": killed,
+                                     "serving_old_params": True}
+
+        # -- kill in the COMMIT window (visit 2 of serve.swap) ------------
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.swap", action="crash", after=1, times=1)],
+            seed=args.seed)
+        killed = False
+        with faults.installed(plan):
+            try:
+                service.swap_model(replica_dir)
+            except faults.InjectedCrash:
+                killed = True
+        if not killed:
+            violations.append("kill_commit: the injected crash never "
+                              "fired (vacuous scenario)")
+        _still_old("kill_commit")
+        scenarios["kill_commit"] = {"killed": killed,
+                                    "serving_old_params": True}
+
+        # -- corrupt incoming manifest ------------------------------------
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="ckpt.manifest", action="corrupt", flips=64, times=1)],
+            seed=args.seed)
+        detected = False
+        with faults.installed(plan):
+            try:
+                service.swap_model(replica_dir)
+            except ValueError:
+                # IntegrityError (unparseable) or ManifestMismatch
+                # (parsed but lying) — both are typed refusals
+                detected = True
+        if not detected:
+            violations.append("corrupt_manifest: a corrupted manifest "
+                              "was ADOPTED (integrity false negative)")
+        _still_old("corrupt_manifest")
+        scenarios["corrupt_manifest"] = {"detected": detected}
+
+        # -- clean swap UNDER LOAD + wrong-digest audit -------------------
+        futures, door_rejects = [], 0
+        stop = threading.Event()
+        swap_result = {}
+
+        def _swapper():
+            swap_result["info"] = service.swap_model(replica_dir)
+            stop.set()
+
+        swapper = threading.Thread(target=_swapper, name="chaos-swapper")
+        swapper.start()
+        i = 0
+        while not stop.is_set() and i < 100000:   # backstop: a wedged
+            #                      swap must not hang the bench
+            try:
+                futures.append((i % len(images), service.submit_encode(
+                    images[i % len(images)])))
+            except ServeError:
+                door_rejects += 1
+            i += 1
+            time.sleep(args.submit_gap_s)
+        swapper.join(timeout=args.timeout_s)
+        digest_b = swap_result.get("info", {}).get("digest")
+        if swapper.is_alive() or digest_b is None:
+            violations.append("swap_under_load: swap_model did not "
+                              "complete")
+        # resolve the mid-swap load FIRST (drains the backlog), then
+        # take the new model's reference streams on the idle service,
+        # then a synchronous post-commit tail so the audit always sees
+        # the NEW model answer live traffic
+        resolved = []
+        hung = 0
+        deadline = time.monotonic() + args.timeout_s
+        for idx, f in futures:
+            try:
+                exc = f.exception(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except TimeoutError:
+                hung += 1
+                continue
+            resolved.append((idx, exc,
+                             None if exc is not None
+                             else f.result(timeout=0)))
+        b_streams = [service.encode(img, timeout=args.timeout_s).stream
+                     for img in images]
+        for k in range(2 * len(images)):
+            idx = k % len(images)
+            resolved.append((idx, None,
+                             service.encode(images[idx],
+                                            timeout=args.timeout_s)))
+        wrong_digest = old_model = new_model = typed = untyped = 0
+        for idx, exc, res in resolved:
+            if exc is not None:
+                if isinstance(exc, (ServeError, ValueError)):
+                    typed += 1
+                else:
+                    untyped += 1    # an unexpected crash class is a
+                    #                 violation, never silently dropped
+                continue
+            # THE no-torn-batch check: every stream must be byte-
+            # identical to the old model's or the new model's output
+            # for that image, and agree with its own digest tag
+            if res.model_digest == digest_a \
+                    and res.stream == a_streams[idx]:
+                old_model += 1
+            elif res.model_digest == digest_b \
+                    and res.stream == b_streams[idx]:
+                new_model += 1
+            else:
+                wrong_digest += 1
+        if hung:
+            violations.append(f"swap_under_load: {hung} hung futures")
+        if untyped:
+            violations.append(f"swap_under_load: {untyped} untyped "
+                              f"errors on mid-swap requests")
+        if wrong_digest:
+            violations.append(f"swap_under_load: {wrong_digest} "
+                              f"WRONG-DIGEST responses (torn batches)")
+        if new_model == 0:
+            violations.append("swap_under_load: no response ever came "
+                              "from the new model (swap vacuous?)")
+        scenarios["swap_under_load"] = {
+            "submitted": len(futures), "door_rejects": door_rejects,
+            "old_model_responses": old_model,
+            "new_model_responses": new_model,
+            "typed_errors": typed, "untyped_errors": untyped,
+            "hung_futures": hung,
+            "wrong_digest_responses": wrong_digest,
+            "digest_a": digest_a, "digest_b": digest_b,
+        }
+
+        # -- instant rollback ---------------------------------------------
+        service.rollback()
+        roll = service.encode(images[0], timeout=args.timeout_s)
+        if roll.stream != a_streams[0] or roll.model_digest != digest_a:
+            violations.append("rollback: old-model bit-identity lost")
+        scenarios["rollback"] = {
+            "digest": service.model_digest,
+            "bit_identical_to_pre_swap": roll.stream == a_streams[0]}
+
+    if sentinel.compilations:
+        violations.append(f"{sentinel.compilations} steady-state XLA "
+                          f"compiles across swap+rollback")
+    swap_inversions = locks.inversion_count() - inversions_before
+    if swap_inversions:
+        violations.append(f"{swap_inversions} lock-order inversions "
+                          f"during the swap battery")
+    counters = service.metrics.snapshot()["counters"]
+    service.drain()
+    return {
+        "warmup": warm,
+        "replication": replication,
+        "scenarios": scenarios,
+        "swap_counters": {k: v for k, v in counters.items()
+                          if "swap" in k or "rollback" in k},
+        "steady_compiles": sentinel.compilations,
+        "lock_order_inversions": swap_inversions,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="seeded chaos soak for dsin_tpu/serve")
@@ -351,6 +614,11 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="CHAOS_BENCH.json")
     p.add_argument("--smoke", action="store_true",
                    help="tiny model + short run for tier-1 CI")
+    p.add_argument("--hotswap_only", action="store_true",
+                   help="run ONLY the live-model-operations battery "
+                        "(kill-during-swap, corrupt manifest, swap "
+                        "under load, rollback) — the fail-fast "
+                        "hotswap-chaos tpu_session.sh stage")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -367,15 +635,29 @@ def main(argv=None) -> int:
         args.crash_probability = 1.0
         args.corrupt_streams = 6
 
-    report = run_chaos(args)
+    if args.hotswap_only:
+        report = {"config": {"smoke": args.smoke, "seed": args.seed},
+                  "hotswap": run_hotswap(args),
+                  "violations": []}
+    else:
+        report = run_chaos(args)
+        report["hotswap"] = run_hotswap(args)
+    # the hotswap battery's violations gate the exit code like the
+    # soak's own
+    report["violations"] = (report["violations"]
+                            + report["hotswap"]["violations"])
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
-    print(json.dumps({k: report[k] for k in
-                      ("load", "supervision", "integrity", "invariants",
-                       "lock_discipline", "steady_compiles",
-                       "violations")}, indent=1))
+    summary_keys = ("load", "supervision", "integrity", "invariants",
+                    "lock_discipline", "steady_compiles")
+    print(json.dumps(
+        {**{k: report[k] for k in summary_keys if k in report},
+         "hotswap": {k: report["hotswap"][k]
+                     for k in ("scenarios", "swap_counters",
+                               "steady_compiles", "violations")},
+         "violations": report["violations"]}, indent=1))
     if report["violations"]:
         print(f"CHAOS_BENCH_FAILED: {report['violations']}",
               file=sys.stderr)

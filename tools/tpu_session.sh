@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -58,6 +58,23 @@ chaos-smoke)
   if [ "$rc" -ne 0 ]; then
     cat artifacts/chaos_smoke.log
     echo "TPU_SESSION_FAILED: chaos-smoke (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
+hotswap-chaos)
+  # fail fast (ISSUE 9): the live-model-operations battery — a kill
+  # injected in the swap's prepare AND commit windows, a corrupted
+  # incoming manifest.json, a clean swap under load, and an instant
+  # rollback — must show zero hung futures, zero wrong-digest (torn-
+  # batch) responses, the service still on the OLD params after every
+  # abort, and zero steady-state compiles. Seconds on CPU; a swap
+  # regression caught here never reaches a relay window.
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke --hotswap_only \
+    --out artifacts/hotswap_chaos.json > artifacts/hotswap_chaos.log 2>&1 \
+    || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/hotswap_chaos.log
+    echo "TPU_SESSION_FAILED: hotswap-chaos (queue aborted before chip stages)"
     exit 1
   fi
   ;;
