@@ -52,6 +52,11 @@ def load_model_state(ae_config_path: str, pc_config_path: str,
     ae_cfg = parse_config_file(ae_config_path)
     if not need_sinet:
         ae_cfg = ae_cfg.replace(AE_only=True)
+    else:
+        # symmetric override: a caller that NEEDS the SI path (the
+        # enable_si service, ISSUE 10) gets siNet built even from a
+        # config snapshot whose training phase set AE_only=True
+        ae_cfg = ae_cfg.replace(AE_only=False)
     pc_cfg = parse_config_file(pc_config_path)
     model = DSIN(ae_cfg, pc_cfg)
     variables = model.init_variables(jax.random.PRNGKey(seed),

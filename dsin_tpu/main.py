@@ -63,11 +63,17 @@ class Experiment:
 
     def __init__(self, ae_config: Config, pc_config: Config,
                  out_root: str = ".", seed: int = 0,
-                 use_mesh: Optional[bool] = None):
+                 use_mesh: Optional[bool] = None,
+                 replicate_to: Optional[str] = None):
         self.ae_config = ae_config
         self.pc_config = pc_config
         self.out_root = out_root
         self.seed = seed
+        #: peer-visible root for cross-host checkpoint replication
+        #: (train/checkpoint.replicate_checkpoint, ISSUE 9 follow-up):
+        #: every best-val save is CRC-verified-both-sides copied to
+        #: <replicate_to>/<model_name>; None = off
+        self.replicate_to = replicate_to
         self.model = DSIN(ae_config, pc_config)
 
         train_manifest = os.path.join(ae_config.root_data,
@@ -263,6 +269,13 @@ class Experiment:
                 self.weights_root, self.model_name, cfg, self.pc_config,
                 iteration=i + 1, total_iterations=iterations,
                 best_val=best_val)
+            if self.replicate_to:
+                # cross-host replica of the just-saved best-val ckpt
+                # (manifest-CRC-verified on both sides) — the peer a
+                # serving fleet hot-swaps from (ISSUE 9 follow-up)
+                ckpt_lib.replicate_checkpoint(
+                    self.ckpt_dir,
+                    os.path.join(self.replicate_to, self.model_name))
         return best_val
 
     def train(self, max_steps: Optional[int] = None,
@@ -613,9 +626,11 @@ def run(ae_config: Config, pc_config: Config, out_root: str = ".",
         max_val_batches: Optional[int] = None,
         max_test_images: Optional[int] = None,
         profile_dir: Optional[str] = None,
-        real_bpp: bool = False) -> Dict[str, float]:
+        real_bpp: bool = False,
+        replicate_to: Optional[str] = None) -> Dict[str, float]:
     """Config-driven orchestration (reference main.py:21-126)."""
-    exp = Experiment(ae_config, pc_config, out_root=out_root)
+    exp = Experiment(ae_config, pc_config, out_root=out_root,
+                     replicate_to=replicate_to)
     exp.maybe_restore()
     results: Dict[str, float] = {}
     if ae_config.train_model:
@@ -648,6 +663,12 @@ def parse_args(argv=None):
                         "reference's vestigial --real_bpp, working)")
     p.add_argument("--profile_dir", default=None,
                    help="capture an XLA trace of a few warm train steps")
+    p.add_argument("--replicate_to", default=None,
+                   help="peer-visible root (NFS mount, object-store "
+                        "fuse) to replicate every best-val checkpoint "
+                        "to via train/checkpoint.replicate_checkpoint "
+                        "(manifest-CRC-verified both sides); the copy "
+                        "lands at <replicate_to>/<model_name>")
     p.add_argument("--distributed", action="store_true",
                    help="multi-host: call jax.distributed.initialize() "
                         "(coordinator/host env per JAX docs); each host "
@@ -667,7 +688,8 @@ def main(argv=None) -> None:
                   max_steps=args.max_steps,
                   max_test_images=args.max_test_images,
                   profile_dir=args.profile_dir,
-                  real_bpp=args.real_bpp)
+                  real_bpp=args.real_bpp,
+                  replicate_to=args.replicate_to)
     color_print(f"done: {results}", "green", bold=True)
 
 

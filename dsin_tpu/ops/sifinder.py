@@ -27,6 +27,13 @@ TPU-first formulation (not a transliteration):
 * The whole search lives under stop_gradient at the call site: argmax and
   gather are non-differentiable, as in the reference where only the gathered
   pixels flow (through siNet) into the loss.
+* The search is split into a request-invariant SIDE half and a per-request
+  QUERY half (ISSUE 10): `build_side_prep` computes everything derived from
+  y alone (transform, window statistics, prior factors) into a `SidePrep`,
+  and every search entry accepts one — the from-scratch call builds a prep
+  and runs the identical prepped search, so the serving session cache
+  (serve/session.py) reuses preps with bit-identical results by
+  construction.
 """
 
 from __future__ import annotations
@@ -50,6 +57,91 @@ class SearchResult(NamedTuple):
     best_flat: jnp.ndarray   # (P,) argmax/argmin of the flattened map
     row: jnp.ndarray         # (P,) match rows
     col: jnp.ndarray         # (P,) match cols
+
+
+class SidePrep(NamedTuple):
+    """The request-invariant half of the search: everything that depends
+    only on the side image y (and the static bucket/patch geometry),
+    computed ONCE and reused for every x̂ against the same y — the
+    session-cached serving contract (serve/session.py). Passing a prep
+    into `search_single`/`search_single_tiled`/the Pallas entry is
+    bit-identical to the from-scratch call by construction: the scratch
+    path itself builds a SidePrep and runs the identical prepped search.
+
+    All leaves are arrays (a clean jit pytree; patch geometry stays a
+    static argument of the search functions). `None` marks a half that
+    was not built: Pearson preps carry `inv_window_std`, L2 preps carry
+    `sum_y2`, and the Pallas-kernel half (`y_t_pad`..`gw_t_pad`, the
+    padded device-resident side tensor the fused kernel slices) exists
+    only when built with `for_pallas=True`."""
+    y_img: jnp.ndarray                    # (H, W, 3) original y — gather source
+    r_img: jnp.ndarray                    # (H, W, C) search_transform(ŷ)
+    inv_window_std: Optional[jnp.ndarray]  # (Hc, Wc) Pearson 1/√(var+eps)
+    sum_y2: Optional[jnp.ndarray]         # (Hc, Wc) L2 window Σŷ² term
+    gh: Optional[jnp.ndarray]             # (Hc, P) separable prior factor
+    gw: Optional[jnp.ndarray]             # (Wc, P) (None = no position prior)
+    # Pallas-kernel half (ops/sifinder_pallas.py), pre-padded to the
+    # kernel grid so a warm session pays zero per-request prep:
+    y_t_pad: Optional[jnp.ndarray] = None     # (C, Hpad, Wpad) compute dtype
+    inv_denom_pad: Optional[jnp.ndarray] = None  # (Hg, Wt) f32 rsqrt form
+    gh_pad: Optional[jnp.ndarray] = None      # (Hg, P) f32
+    gw_t_pad: Optional[jnp.ndarray] = None    # (P, Wt) f32
+
+
+def _pearson_inv_std(sum_y: jnp.ndarray, sum_y2: jnp.ndarray,
+                     patch_size: int, eps: float) -> jnp.ndarray:
+    """Reciprocal Pearson denominator from the window sums — the ONE
+    definition `match_scores`, `build_side_prep`, and the prepped paths
+    share, so cached and from-scratch scores agree bit for bit."""
+    var_y = sum_y2 - (sum_y * sum_y) / patch_size
+    return 1.0 / jnp.sqrt(jnp.maximum(var_y, 0.0) + eps)
+
+
+def _normalized_patches(x_patches: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Mean-center + L2-normalize each patch (the request-side half of
+    the Pearson score), shared by the materialized and chunked paths."""
+    mean_x = jnp.mean(x_patches, axis=(1, 2, 3), keepdims=True)
+    xc = x_patches - mean_x
+    norm_x = jnp.sqrt(jnp.sum(xc * xc, axis=(1, 2, 3), keepdims=True) + eps)
+    return xc / norm_x
+
+
+def build_side_prep(y_img: jnp.ndarray, y_dec: jnp.ndarray, patch_h: int,
+                    patch_w: int, *, use_l2: bool = False,
+                    mask_factors=None, eps: float = 1e-12,
+                    for_pallas: bool = False,
+                    pallas_dtype=jnp.float32,
+                    tile_w: int = 512) -> SidePrep:
+    """Compute a SidePrep for one side image (all tensors HWC).
+
+    `mask_factors` is the separable Gaussian prior (gh, gw) from
+    `gaussian_position_mask_factors` — or None for no prior. Multiplying
+    the factors factors-first is bit-equal to multiplying the combined
+    `gaussian_position_mask` (that mask IS f32(gh)*f32(gw)).
+    `for_pallas=True` additionally builds the fused kernel's padded
+    operands (Pearson only) so a cached session skips even the pad."""
+    r_img = color_lib.search_transform(y_dec, use_l2)
+    sum_y, sum_y2 = _window_sums(r_img, patch_h, patch_w)
+    gh = gw = None
+    if mask_factors is not None:
+        gh, gw = (jnp.asarray(m) for m in mask_factors)
+    if use_l2:
+        if for_pallas:
+            raise ValueError("the fused Pallas kernel is Pearson-only; "
+                             "build_side_prep(for_pallas=True) cannot "
+                             "serve use_l2")
+        return SidePrep(y_img=y_img, r_img=r_img, inv_window_std=None,
+                        sum_y2=sum_y2, gh=gh, gw=gw)
+    patch_size = patch_h * patch_w * r_img.shape[-1]
+    inv_std = _pearson_inv_std(sum_y, sum_y2, patch_size, eps)
+    prep = SidePrep(y_img=y_img, r_img=r_img, inv_window_std=inv_std,
+                    sum_y2=None, gh=gh, gw=gw)
+    if for_pallas:
+        from dsin_tpu.ops import sifinder_pallas
+        prep = sifinder_pallas.attach_kernel_prep(
+            prep, patch_h, patch_w, compute_dtype=pallas_dtype,
+            tile_w=tile_w, eps=eps)
+    return prep
 
 
 def _gaussian_mask_factors_f64(img_h: int, img_w: int, patch_h: int,
@@ -191,15 +283,14 @@ def match_scores(x_patches: jnp.ndarray, y_image: jnp.ndarray,
         sum_x2 = jnp.sum(x_patches * x_patches, axis=(1, 2, 3))  # (P,)
         return sum_x2[None, None, :] - 2.0 * xy + (sum_y2 - 0.0)[..., None]
 
-    # Pearson: center+normalize each patch once, then one conv.
-    mean_x = jnp.mean(x_patches, axis=(1, 2, 3), keepdims=True)
-    xc = x_patches - mean_x
-    norm_x = jnp.sqrt(jnp.sum(xc * xc, axis=(1, 2, 3), keepdims=True) + eps)
-    xn = xc / norm_x                                         # (P, ph, pw, C)
+    # Pearson: center+normalize each patch once, then one conv. The
+    # denominator multiplies as a precomputed reciprocal — the SAME form
+    # a SidePrep caches — so cached and from-scratch scores are the same
+    # arithmetic, not merely close.
+    xn = _normalized_patches(x_patches, eps)                 # (P, ph, pw, C)
     num = _correlate(xn, y_image, conv_dtype)                # <y_w, x̂>
-    var_y = sum_y2 - (sum_y * sum_y) / patch_size            # ||y_w - mean||^2
-    denom = jnp.sqrt(jnp.maximum(var_y, 0.0) + eps)
-    return num / denom[..., None]
+    inv_std = _pearson_inv_std(sum_y, sum_y2, patch_size, eps)
+    return num * inv_std[..., None]
 
 
 def sifinder_conv_dtype(config, default=None):
@@ -221,7 +312,8 @@ def sifinder_row_chunk(config, default: int = 32) -> int:
 
 def chunked_score_argmax(q: jnp.ndarray, r_padded: jnp.ndarray, hc: int,
                          width: int, row_chunk: int, mask_chunk_fn,
-                         patch_h: int, conv_dtype=None, eps: float = 1e-12):
+                         patch_h: int, conv_dtype=None, eps: float = 1e-12,
+                         inv_std_padded: Optional[jnp.ndarray] = None):
     """Row-chunked Pearson score-map arg-max — the ONE scan body shared by
     `search_single_tiled` and the spatial shard-local search, so the
     bit-parity tie-break contract lives in exactly one place.
@@ -236,12 +328,23 @@ def chunked_score_argmax(q: jnp.ndarray, r_padded: jnp.ndarray, hc: int,
     jnp.argmax picks the first maximum, which together reproduce
     jnp.argmax's lowest-flat-index rule on the full (hc, width) map.
 
+    With `inv_std_padded` (num_chunks*row_chunk, width) — a SidePrep's
+    precomputed Pearson reciprocal denominator, row-padded — each chunk
+    skips the per-chunk window statistics: one conv against the row
+    slice, then the sliced reciprocal multiplies. The values are the
+    ones match_scores derives from the same sums, so both bodies emit
+    identical scores; the prepped body just never recomputes them.
+
     Returns (best_val (P,), best_flat (P,)) with best_flat a row-major
     flat index over (hc, width)."""
     p_count = q.shape[0]
     num_chunks = -(-hc // row_chunk)
     assert r_padded.shape[0] == num_chunks * row_chunk + patch_h - 1, (
         r_padded.shape, num_chunks, row_chunk, patch_h)
+    if inv_std_padded is not None:
+        assert inv_std_padded.shape == (num_chunks * row_chunk, width), (
+            inv_std_padded.shape, num_chunks, row_chunk, width)
+        xn = _normalized_patches(q, eps)
 
     def body(carry, k):
         best_val, best_flat = carry
@@ -249,8 +352,14 @@ def chunked_score_argmax(q: jnp.ndarray, r_padded: jnp.ndarray, hc: int,
         y_slice = jax.lax.dynamic_slice(
             r_padded, (r0, 0, 0), (row_chunk + patch_h - 1,
                                    r_padded.shape[1], r_padded.shape[2]))
-        scores = match_scores(q, y_slice, use_l2=False, eps=eps,
-                              conv_dtype=conv_dtype)  # (row_chunk, width, P)
+        if inv_std_padded is None:
+            scores = match_scores(q, y_slice, use_l2=False, eps=eps,
+                                  conv_dtype=conv_dtype)
+        else:
+            num = _correlate(xn, y_slice, conv_dtype)
+            inv = jax.lax.dynamic_slice(inv_std_padded, (r0, 0),
+                                        (row_chunk, width))
+            scores = num * inv[..., None]     # (row_chunk, width, P)
         scores = mask_chunk_fn(scores, r0)
         valid = (r0 + jnp.arange(row_chunk)) < hc
         scores = jnp.where(valid[:, None, None], scores, -jnp.inf)
@@ -289,19 +398,45 @@ def gather_patches(y_image: jnp.ndarray, rows: jnp.ndarray, cols: jnp.ndarray,
 
 def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
                   mask: Optional[jnp.ndarray], patch_h: int, patch_w: int,
-                  use_l2: bool, conv_dtype=None) -> SearchResult:
-    """Full search for one image pair (all tensors HWC)."""
+                  use_l2: bool, conv_dtype=None, eps: float = 1e-12,
+                  prep: Optional[SidePrep] = None) -> SearchResult:
+    """Full search for one image pair (all tensors HWC).
+
+    The from-scratch call builds a `SidePrep` from (y_img, y_dec) and
+    runs the prepped search; passing `prep` skips exactly that build —
+    the two are the same arithmetic, so cached results are bit-identical
+    to scratch (the serving session-cache contract). With a prep whose
+    `gh`/`gw` factors are set, the standard prior is applied factors-
+    first (bit-equal to multiplying the combined mask); `mask` must then
+    be None — a prep prior and an explicit mask cannot both apply."""
     h, w, _ = x_dec.shape
+    if prep is None:
+        prep = build_side_prep(y_img, y_dec, patch_h, patch_w,
+                               use_l2=use_l2, eps=eps)
     x_patches = extract_patches(x_dec, patch_h, patch_w)   # (P, ph, pw, 3)
     q = color_lib.search_transform(x_patches, use_l2)
-    r = color_lib.search_transform(y_dec, use_l2)
 
-    scores = match_scores(q, r, use_l2, conv_dtype=conv_dtype)
+    if prep.gh is not None:
+        assert mask is None, \
+            "pass the prior as prep factors OR as mask, not both"
+        mask = prep.gh[:, None, :] * prep.gw[None, :, :]
+
     if use_l2:
+        assert prep.sum_y2 is not None, "prep was built for Pearson mode"
+        xy = _correlate(q, prep.r_img, None)
+        sum_x2 = jnp.sum(q * q, axis=(1, 2, 3))             # (P,)
+        scores = (sum_x2[None, None, :] - 2.0 * xy
+                  + (prep.sum_y2 - 0.0)[..., None])
         # the conv-form distance |x|^2 - 2<x,y> + |y|^2 cancels
         # catastrophically in float32 at near-matches (terms ~1e9, true
         # distance ~0): clamp to the mathematical lower bound
         scores = jnp.maximum(scores, 0.0)
+    else:
+        assert prep.inv_window_std is not None, \
+            "prep was built for L2 mode"
+        xn = _normalized_patches(q, eps)
+        num = _correlate(xn, prep.r_img, conv_dtype)
+        scores = num * prep.inv_window_std[..., None]
     if mask is not None:
         if use_l2:
             # L2 (argmin): additive discount that grows with the prior —
@@ -316,17 +451,19 @@ def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
             # Pearson (argmax): multiply — distant positions are damped
             scores = scores * mask
     best, rows, cols = find_matches(scores, use_l2)
-    y_patches = gather_patches(y_img, rows, cols, patch_h, patch_w)
+    y_patches = gather_patches(prep.y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)
     return SearchResult(y_syn=y_syn, score_map=scores, best_flat=best,
                         row=rows, col=cols)
 
 
-def search_single_tiled(x_dec: jnp.ndarray, y_img: jnp.ndarray,
-                        y_dec: jnp.ndarray, patch_h: int, patch_w: int,
-                        *, mask_factors=None, mask: Optional[jnp.ndarray] =
-                        None, row_chunk: int = 32,
-                        conv_dtype=None) -> SearchResult:
+def search_single_tiled(x_dec: jnp.ndarray, y_img: Optional[jnp.ndarray],
+                        y_dec: Optional[jnp.ndarray], patch_h: int,
+                        patch_w: int, *, mask_factors=None,
+                        mask: Optional[jnp.ndarray] = None,
+                        row_chunk: int = 32, conv_dtype=None,
+                        eps: float = 1e-12,
+                        prep: Optional[SidePrep] = None) -> SearchResult:
     """Pearson search that never materializes the (Hc, Wc, P) score map.
 
     A `lax.scan` over row-chunks of the correlation map computes each chunk
@@ -345,17 +482,31 @@ def search_single_tiled(x_dec: jnp.ndarray, y_img: jnp.ndarray,
     like `gaussian_position_mask` builds its product) or as a full `mask`
     array that is row-sliced per chunk. Pearson only: the L2 mode needs a
     global score mean for its additive discount (see search_single).
+
+    From-scratch calls build a `SidePrep` (full-map window statistics,
+    computed once instead of per chunk) and scan with it; passing `prep`
+    skips that build — bit-identical by construction, same as
+    `search_single`. A prep carrying `gh`/`gw` supplies the prior itself
+    (`mask_factors`/`mask` must then be None).
     """
     h, w, _ = x_dec.shape
     hc, wc = h - patch_h + 1, w - patch_w + 1
+    if prep is None:
+        prep = build_side_prep(y_img, y_dec, patch_h, patch_w,
+                               use_l2=False, eps=eps)
+    if prep.gh is not None:
+        assert mask_factors is None and mask is None, \
+            "pass the prior in the prep OR as mask_factors/mask, not both"
+        mask_factors = (prep.gh, prep.gw)
     x_patches = extract_patches(x_dec, patch_h, patch_w)
     q = color_lib.search_transform(x_patches, False)
-    r = color_lib.search_transform(y_dec, False)
     p_count = q.shape[0]
 
     num_chunks = -(-hc // row_chunk)
-    pad_rows = num_chunks * row_chunk + patch_h - 1 - r.shape[0]
-    r_pad = jnp.pad(r, ((0, pad_rows), (0, 0), (0, 0)))
+    pad_rows = num_chunks * row_chunk + patch_h - 1 - prep.r_img.shape[0]
+    r_pad = jnp.pad(prep.r_img, ((0, pad_rows), (0, 0), (0, 0)))
+    inv_pad = jnp.pad(prep.inv_window_std,
+                      ((0, num_chunks * row_chunk - hc), (0, 0)))
     if mask_factors is not None:
         gh, gw = (jnp.asarray(m) for m in mask_factors)
         gh_pad = jnp.pad(gh, ((0, num_chunks * row_chunk - hc), (0, 0)))
@@ -377,9 +528,10 @@ def search_single_tiled(x_dec: jnp.ndarray, y_img: jnp.ndarray,
 
     _, best_flat = chunked_score_argmax(q, r_pad, hc, wc, row_chunk,
                                         mask_chunk, patch_h,
-                                        conv_dtype=conv_dtype)
+                                        conv_dtype=conv_dtype, eps=eps,
+                                        inv_std_padded=inv_pad)
     rows, cols = best_flat // wc, best_flat % wc
-    y_patches = gather_patches(y_img, rows, cols, patch_h, patch_w)
+    y_patches = gather_patches(prep.y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)
     return SearchResult(y_syn=y_syn, score_map=None, best_flat=best_flat,
                         row=rows, col=cols)
@@ -491,3 +643,59 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
                  use_l2=use_l2,
                  conv_dtype=sifinder_conv_dtype(config))
     return jax.vmap(lambda a, b, c: fn(a, b, c).y_syn)(x_dec, y_img, y_dec)
+
+
+def synthesize_side_image_prepped(x_dec: jnp.ndarray, prep: SidePrep,
+                                  patch_h: int, patch_w: int,
+                                  config) -> jnp.ndarray:
+    """Batched y_syn (N, H, W, 3) against ONE cached SidePrep — the
+    serving hot path (serve/session.py): every request of a session
+    shares the side image, so the prep enters ONCE and only the
+    x̂-dependent half runs per request. The prior comes from the prep's
+    own factors (None = no prior).
+
+    Dispatch mirrors `synthesize_side_image`'s `sifinder_impl` knob:
+      * 'pallas'/'pallas_interpret' need a prep built `for_pallas=True`
+        (the padded kernel operands ride in the prep);
+      * 'auto' — 'pallas' on TPU when the prep carries the kernel half,
+        else 'xla';
+      * 'xla' / 'xla_tiled' run the prepped XLA searches.
+    Pearson-mode preps only on the pallas paths; an L2 prep (sum_y2 set)
+    runs the XLA paths exactly like `search_single(use_l2=True)`.
+    """
+    use_l2 = prep.sum_y2 is not None
+    impl = getattr(config, "sifinder_impl", "auto")
+    if impl not in ("auto", "xla", "xla_tiled", "pallas", "pallas_interpret"):
+        raise ValueError(
+            f"sifinder_impl={impl!r}: expected one of "
+            "'auto', 'xla', 'xla_tiled', 'pallas', 'pallas_interpret'")
+    if impl == "auto":
+        impl = ("pallas" if (not use_l2 and prep.y_t_pad is not None
+                             and jax.default_backend() == "tpu")
+                else "xla")
+    if impl in ("pallas", "pallas_interpret"):
+        if use_l2:
+            raise ValueError(f"sifinder_impl={impl!r} is Pearson-only")
+        if prep.y_t_pad is None:
+            raise ValueError(
+                f"sifinder_impl={impl!r} needs a SidePrep built with "
+                "for_pallas=True (the kernel's padded operands live in "
+                "the prep)")
+        from dsin_tpu.ops import sifinder_pallas
+        return sifinder_pallas.fused_synthesize_side_image_prepped(
+            x_dec, prep, patch_h, patch_w,
+            compute_dtype=sifinder_conv_dtype(config, jnp.dtype("float32")),
+            interpret=(impl == "pallas_interpret"))
+    if impl == "xla_tiled":
+        if use_l2:
+            raise ValueError("sifinder_impl='xla_tiled' is Pearson-only; "
+                             "use 'xla' for an L2 prep")
+        fn = partial(search_single_tiled, y_img=None, y_dec=None,
+                     patch_h=patch_h, patch_w=patch_w, prep=prep,
+                     row_chunk=sifinder_row_chunk(config),
+                     conv_dtype=sifinder_conv_dtype(config))
+        return jax.vmap(lambda a: fn(a).y_syn)(x_dec)
+    fn = partial(search_single, y_img=None, y_dec=None, mask=None,
+                 patch_h=patch_h, patch_w=patch_w, use_l2=use_l2,
+                 conv_dtype=sifinder_conv_dtype(config), prep=prep)
+    return jax.vmap(lambda a: fn(a).y_syn)(x_dec)

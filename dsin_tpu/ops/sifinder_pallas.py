@@ -132,17 +132,10 @@ def fused_pearson_argmax(y_t: jnp.ndarray, patches_mat: jnp.ndarray,
     assert k == ph * pw * chans, (k, ph, pw, chans)
     assert hc == h - ph + 1 and wc == w - pw + 1, (hc, wc, h, w, ph, pw)
 
-    tile_w = min(tile_w, _round_up(wc, _LANE))
-    n_tiles = -(-wc // tile_w)
-    n_groups = -(-hc // _GROUP)
-
-    hpad = (n_groups - 1) * _GROUP + _GROUP + ph - 1
-    wpad = n_tiles * tile_w + _LANE
+    (_hc, _wc, tile_w, n_tiles, n_groups, hpad, wpad, hg,
+     wt) = kernel_pad_geometry(h, w, ph, pw, tile_w)
     y_t = jnp.pad(y_t, ((0, 0), (0, 0), (0, max(0, hpad - h)),
                         (0, max(0, wpad - w))))
-
-    hg = n_groups * _GROUP
-    wt = n_tiles * tile_w
     inv_denom = jnp.pad(inv_denom, ((0, 0), (0, hg - hc), (0, wt - wc)))
     gh = jnp.pad(gh, ((0, hg - hc), (0, 0)))
     gw_t = jnp.pad(gw_t, ((0, 0), (0, wt - wc)))
@@ -186,27 +179,94 @@ def fused_pearson_argmax(y_t: jnp.ndarray, patches_mat: jnp.ndarray,
     return out_val[:, 0], out_idx[:, 0]
 
 
-def _prepare_single(x_dec, y_dec, ph: int, pw: int, eps: float):
-    """Host-of-kernel prep for one pair: transforms, patch normalization in
-    the kernel's (dc, ch, dr) k-order, and the Pearson denominator map."""
+def _prepare_query(x_dec, ph: int, pw: int, eps: float):
+    """Request-side half of the kernel prep: transform + patch
+    normalization in the kernel's (dc, ch, dr) k-order."""
     x_patches = extract_patches(x_dec, ph, pw)                 # (P, ph, pw, C)
     q = color_lib.search_transform(x_patches, False)
-    r_img = color_lib.search_transform(y_dec, False)           # (H, W, C)
 
     mean_x = jnp.mean(q, axis=(1, 2, 3), keepdims=True)
     xc = q - mean_x
     norm_x = jnp.sqrt(jnp.sum(xc * xc, axis=(1, 2, 3), keepdims=True) + eps)
     xn = xc / norm_x
     p_count = xn.shape[0]
-    pk = jnp.transpose(xn, (0, 2, 3, 1)).reshape(p_count, -1)  # (P, pw*C*ph)
+    return jnp.transpose(xn, (0, 2, 3, 1)).reshape(p_count, -1)  # (P, pw*C*ph)
 
+
+def _side_from_transformed(r_img, ph: int, pw: int, eps: float):
+    """Kernel-layout side tensors from an ALREADY-transformed side image
+    — the ONE derivation `_prepare_side` (scratch path) and
+    `attach_kernel_prep` (session cache) share, so the cached-vs-scratch
+    bit-parity contract cannot drift between two copies. Note the rsqrt
+    form: the kernel multiplies `lax.rsqrt`, NOT the XLA path's
+    1/sqrt."""
     sum_y, sum_y2 = sifinder_lib._window_sums(r_img, ph, pw)
     patch_size = ph * pw * r_img.shape[-1]
     var_y = sum_y2 - (sum_y * sum_y) / patch_size
     inv_denom = jax.lax.rsqrt(jnp.maximum(var_y, 0.0) + eps)   # (Hc, Wc)
-
     y_t = jnp.transpose(r_img, (2, 0, 1))                      # (C, H, W)
+    return y_t, inv_denom
+
+
+def _prepare_side(y_dec, ph: int, pw: int, eps: float):
+    """Side half of the kernel prep: the y-only tensors the kernel reads
+    (shared across every request of a session — serve/session.py)."""
+    r_img = color_lib.search_transform(y_dec, False)           # (H, W, C)
+    return _side_from_transformed(r_img, ph, pw, eps)
+
+
+def _prepare_single(x_dec, y_dec, ph: int, pw: int, eps: float):
+    """Host-of-kernel prep for one pair: transforms, patch normalization in
+    the kernel's (dc, ch, dr) k-order, and the Pearson denominator map."""
+    pk = _prepare_query(x_dec, ph, pw, eps)
+    y_t, inv_denom = _prepare_side(y_dec, ph, pw, eps)
     return y_t, pk, inv_denom
+
+
+def kernel_pad_geometry(h: int, w: int, ph: int, pw: int,
+                        tile_w: int = 512):
+    """The kernel's padded operand extents for one (h, w) image — ONE
+    derivation shared by `fused_pearson_argmax` (which pads per call),
+    `attach_kernel_prep` (which pads once per session), and
+    `fused_pearson_argmax_shared` (which verifies a prepadded prep).
+    Returns (hc, wc, tile_w, n_tiles, n_groups, hpad, wpad, hg, wt)."""
+    hc, wc = h - ph + 1, w - pw + 1
+    tile_w = min(tile_w, _round_up(wc, _LANE))
+    n_tiles = -(-wc // tile_w)
+    n_groups = -(-hc // _GROUP)
+    hpad = (n_groups - 1) * _GROUP + _GROUP + ph - 1
+    wpad = n_tiles * tile_w + _LANE
+    hg = n_groups * _GROUP
+    wt = n_tiles * tile_w
+    return hc, wc, tile_w, n_tiles, n_groups, hpad, wpad, hg, wt
+
+
+def attach_kernel_prep(prep, ph: int, pw: int, *,
+                       compute_dtype=jnp.float32, tile_w: int = 512,
+                       eps: float = 1e-12):
+    """Fill a SidePrep's Pallas half: the padded side tensor the kernel
+    slices, the rsqrt-form denominator (the kernel multiplies rsqrt, as
+    `_prepare_side` computes it — NOT the XLA path's 1/sqrt), and the
+    padded prior factors. Everything here is y-only: a warm session's
+    requests run the kernel with zero per-request side work."""
+    h, w, _ = prep.y_img.shape
+    (hc, wc, tile_w, _n_tiles, _n_groups, hpad, wpad, hg,
+     wt) = kernel_pad_geometry(h, w, ph, pw, tile_w)
+    y_t, inv_denom = _side_from_transformed(prep.r_img, ph, pw, eps)
+    y_t_pad = jnp.pad(y_t.astype(compute_dtype),
+                      ((0, 0), (0, max(0, hpad - h)), (0, max(0, wpad - w))))
+    inv_pad = jnp.pad(inv_denom, ((0, hg - hc), (0, wt - wc)))
+    if prep.gh is not None:
+        gh, gw = prep.gh, prep.gw
+    else:
+        p_count = (h // ph) * (w // pw)
+        gh = jnp.ones((hc, p_count), jnp.float32)
+        gw = jnp.ones((wc, p_count), jnp.float32)
+    gh_pad = jnp.pad(gh.astype(jnp.float32), ((0, hg - hc), (0, 0)))
+    gw_t_pad = jnp.pad(jnp.transpose(gw, (1, 0)).astype(jnp.float32),
+                       ((0, 0), (0, wt - wc)))
+    return prep._replace(y_t_pad=y_t_pad, inv_denom_pad=inv_pad,
+                         gh_pad=gh_pad, gw_t_pad=gw_t_pad)
 
 
 def fused_synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
@@ -241,3 +301,111 @@ def fused_synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
         return assemble_patches(pats, h, w)
 
     return jax.vmap(gather_one)(y_img, rows, cols)
+
+
+@partial(jax.jit, static_argnames=("ph", "pw", "hc", "wc", "tile_w",
+                                   "interpret"))
+def fused_pearson_argmax_shared(y_t_pad: jnp.ndarray, pk: jnp.ndarray,
+                                inv_denom_pad: jnp.ndarray,
+                                gh_pad: jnp.ndarray, gw_t_pad: jnp.ndarray,
+                                *, ph: int, pw: int, hc: int, wc: int,
+                                tile_w: int = 512, interpret: bool = False):
+    """`fused_pearson_argmax` for a batch that SHARES one side image —
+    the session-cached serving case. The side operands arrive PREPADDED
+    (attach_kernel_prep) and un-batched; their block index maps ignore
+    the batch coordinate, so N requests stream one VMEM-resident copy of
+    y instead of N. Same `_kernel` body, same blocks, same dtypes as the
+    per-image entry — identical y inputs produce bit-identical outputs.
+
+    pk: (B, P, pw*C*ph) normalized patches; returns (best_val (B, P) f32,
+    best_idx (B, P) int32), flat row-major over the TRUE (hc, wc) map
+    (the static `hc`/`wc` cannot come from the padded shapes)."""
+    require_pallas()
+    chans, hpad, wpad = y_t_pad.shape
+    b, p_count, k = pk.shape
+    assert k == ph * pw * chans, (k, ph, pw, chans)
+    (g_hc, g_wc, g_tile_w, n_tiles, n_groups, g_hpad, g_wpad, hg,
+     wt) = kernel_pad_geometry(hc + ph - 1, wc + pw - 1, ph, pw, tile_w)
+    assert (g_hc, g_wc) == (hc, wc)
+    tile_w = g_tile_w
+    assert (hpad, wpad) == (g_hpad, g_wpad), \
+        (y_t_pad.shape, g_hpad, g_wpad)
+    assert inv_denom_pad.shape == (hg, wt), (inv_denom_pad.shape, hg, wt)
+    assert gh_pad.shape == (hg, p_count), (gh_pad.shape, hg, p_count)
+    assert gw_t_pad.shape == (p_count, wt), (gw_t_pad.shape, p_count, wt)
+
+    grid = (b, n_groups, n_tiles)
+    kernel = partial(_kernel, ph=ph, pw=pw, chans=chans, tile_w=tile_w,
+                     wc=wc, hc=hc)
+    out_val, out_idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            # batch-invariant side blocks: index maps pin coordinate 0
+            pl.BlockSpec((1, chans, hpad, wpad),
+                         lambda b_, q, j: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p_count, k), lambda b_, q, j: (b_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _GROUP, tile_w), lambda b_, q, j: (0, q, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_GROUP, p_count), lambda b_, q, j: (q, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((p_count, tile_w), lambda b_, q, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, p_count), lambda b_, q, j: (b_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, p_count), lambda b_, q, j: (b_, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, p_count), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1, p_count), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, tile_w), y_t_pad.dtype),
+            pltpu.VMEM((1, p_count), jnp.float32),
+            pltpu.VMEM((1, p_count), jnp.int32),
+        ],
+        interpret=interpret,
+    )(y_t_pad[None], pk, inv_denom_pad[None], gh_pad, gw_t_pad)
+    return out_val[:, 0], out_idx[:, 0]
+
+
+def fused_synthesize_side_image_prepped(x_dec: jnp.ndarray, prep,
+                                        patch_h: int, patch_w: int, *,
+                                        compute_dtype=jnp.float32,
+                                        tile_w: int = 512,
+                                        interpret: bool = False,
+                                        eps: float = 1e-12) -> jnp.ndarray:
+    """Batched y_syn via the fused kernel against ONE cached SidePrep
+    (built with for_pallas=True): only the x̂-side prep (`_prepare_query`)
+    runs per request; every y-side operand comes prepadded from the
+    prep. Results are bit-identical to `fused_synthesize_side_image`
+    with the same y replicated per image — the kernel body and block
+    shapes are the same, only the index maps stop re-reading y per
+    batch lane."""
+    n, h, w, _ = x_dec.shape
+    hc, wc = h - patch_h + 1, w - patch_w + 1
+    assert prep.y_t_pad is not None, \
+        "prep lacks the Pallas half — build_side_prep(for_pallas=True)"
+    assert prep.y_t_pad.dtype == jnp.dtype(compute_dtype), \
+        (prep.y_t_pad.dtype, compute_dtype)
+
+    pk = jax.vmap(lambda a: _prepare_query(a, patch_h, patch_w, eps))(x_dec)
+    _, best = fused_pearson_argmax_shared(
+        prep.y_t_pad, pk.astype(compute_dtype), prep.inv_denom_pad,
+        prep.gh_pad, prep.gw_t_pad, ph=patch_h, pw=patch_w, hc=hc, wc=wc,
+        tile_w=tile_w, interpret=interpret)
+
+    rows = best // wc
+    cols = best % wc
+
+    def gather_one(r_one, c_one):
+        pats = sifinder_lib.gather_patches(prep.y_img, r_one, c_one,
+                                           patch_h, patch_w)
+        return assemble_patches(pats, h, w)
+
+    return jax.vmap(gather_one)(rows, cols)

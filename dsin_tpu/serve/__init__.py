@@ -10,6 +10,8 @@ Layering (each module stands alone below the next):
                    service processes, /healthz-fed eviction)
     placement.py — bucket ladder -> device mesh assignment (replica
                    policy + per-device shardings via parallel/mesh.py)
+    session.py   — side-information session cache: LRU/TTL/byte-bounded
+                   store of device-resident SidePrep bundles (ISSUE 10)
     metrics.py   — lock-guarded counters/gauges/histograms + http.server
                    /healthz + /metrics endpoint
     service.py   — device-affine executor threads over the batched
@@ -35,6 +37,9 @@ from dsin_tpu.serve.router import (AdmissionController, AggregatedMetrics,
                                    FleetSwapError, FrontDoorRouter)
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
+from dsin_tpu.serve.session import (SessionEntry, SessionError,
+                                    SessionExpired, SessionOverCapacity,
+                                    SessionStore)
 from dsin_tpu.serve.swap import ModelBundle, SwapCoordinator, SwapError
 from dsin_tpu.train.checkpoint import ManifestMismatch
 from dsin_tpu.utils.integrity import IntegrityError
@@ -49,6 +54,8 @@ __all__ = [
     "PlacementError", "PlacementPlan", "PriorityClass",
     "RebalanceTrigger", "Request", "ServeError", "ServiceConfig",
     "ServiceDraining", "ServiceOverloaded", "ServiceUnavailable",
-    "SwapCoordinator", "SwapError", "crop_from_bucket",
-    "default_priority_classes", "pad_to_bucket", "plan_placement",
+    "SessionEntry", "SessionError", "SessionExpired",
+    "SessionOverCapacity", "SessionStore", "SwapCoordinator", "SwapError",
+    "crop_from_bucket", "default_priority_classes", "pad_to_bucket",
+    "plan_placement",
 ]

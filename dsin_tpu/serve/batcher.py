@@ -62,7 +62,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import (AbstractSet, Any, Callable, Dict, Hashable, List,
-                    Optional, Sequence, Tuple)
+                    NamedTuple, Optional, Sequence, Tuple)
 
 from dsin_tpu.utils import locks as locks_lib
 
@@ -206,19 +206,33 @@ class Future:
         return self._result
 
 
+class SessionKey(NamedTuple):
+    """Internal queue key for session-affine requests (ISSUE 10): the
+    routing half (`route` — the caller's `Request.key`, what `accept`
+    filters and executables are keyed by) plus the session id. Two
+    requests coalesce only when BOTH halves match, so a batch never
+    mixes side images — one session, one device-resident SidePrep, one
+    executable call."""
+    route: Hashable
+    session: str
+
+
 @dataclass
 class Request:
     """One unit of work. `payload` is opaque to the batcher; `key`
     decides what it may be batched with; `deadline` is absolute
     time.monotonic(); `priority` names a configured class (None = the
     batcher's first/most-latency-sensitive class, filled in at
-    submit)."""
+    submit). `session` (ISSUE 10) narrows coalescing: requests sharing
+    a key still only batch together when they also share the session —
+    consumers' `accept` sets keep filtering on the key alone."""
     key: Hashable
     payload: Any
     deadline: Optional[float] = None
     future: Future = field(default_factory=Future)
     arrival: float = field(default_factory=time.monotonic)
     priority: Optional[str] = None
+    session: Optional[str] = None
 
 
 class MicroBatcher:
@@ -357,10 +371,12 @@ class MicroBatcher:
                     f"lower-priority victim to shed — {cls!r} request at "
                     f"key {request.key!r} shed at the door",
                     priority=cls, depth=self._depth)
-            q = self._queues[cls].get(request.key)
+            qkey = (request.key if request.session is None
+                    else SessionKey(request.key, request.session))
+            q = self._queues[cls].get(qkey)
             if q is None:
-                q = self._queues[cls][request.key] = deque()
-                self._order[cls].append(request.key)
+                q = self._queues[cls][qkey] = deque()
+                self._order[cls].append(qkey)
             q.append(request)
             self._class_depth[cls] += 1
             self._depth += 1
@@ -445,7 +461,11 @@ class MicroBatcher:
             for i in range(n):
                 idx = (start + i) % n
                 key = order[idx]
-                if accept is not None and key not in accept:
+                # accept filters on the ROUTE half only: a device-affine
+                # executor accepts (kind, bucket); which session rides
+                # that bucket is batching policy, not placement
+                route = key.route if isinstance(key, SessionKey) else key
+                if accept is not None and route not in accept:
                     continue
                 if self._queues[cls].get(key):
                     self._rr[cls] = idx + 1

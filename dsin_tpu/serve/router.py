@@ -65,6 +65,18 @@ fan-outs the instant per-replica rollback the same way. Router-side
 evidence: `serve_router_swaps` / `serve_router_swap_aborts` /
 `serve_router_rollbacks` counters and the refreshed fleet digest.
 
+Session pinning (ISSUE 10): SI sessions are REPLICA-LOCAL state — the
+device-resident SidePrep lives in exactly one replica's store
+(serve/session.py), so the router PINS each session at open:
+`open_session` round-robins the open onto a live replica and records
+sid -> replica; every `submit_decode_si` for that sid dispatches to its
+pinned replica only. A dead pinned replica cannot be rerouted around
+(no other replica holds the prep): its in-flight SI work and all later
+submits for its sessions fail typed `SessionExpired` — the client's
+one recovery everywhere — and the pins are dropped so the slots never
+hang. `serve_router_sessions_pinned` gauges the live pin table;
+`serve_router_session_orphans` counts pins lost to replica death.
+
 Router-level /metrics aggregation (the PR 8 follow-up): pass
 `metrics_port` and the router serves ONE endpoint merging every
 replica's snapshot — counters/gauges/accumulators summed, histograms
@@ -94,6 +106,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future,
                                     ServiceOverloaded, ServiceUnavailable)
+from dsin_tpu.serve.session import SessionExpired
 from dsin_tpu.utils import locks as locks_lib
 
 #: pipe ops that drive the two-phase hot swap instead of carrying a
@@ -309,6 +322,32 @@ def _replica_main(conn, config, replica_id: int) -> None:
                     # inline keeps them ordered with request intake
                     _run_control(op, rid, payload)
                 continue
+            if op in ("session_open", "session_close"):
+                # session control (ISSUE 10). close is an O(1) store
+                # evict — inline. open runs the per-bucket prep
+                # executable (AE reconstruction of the side image +
+                # device upload — real device time at big buckets), so
+                # it runs OFF the recv loop like swap_prepare: request
+                # intake must not head-of-line block behind a session
+                # registration. A failure (over-capacity, bad shape)
+                # crosses the pipe typed like any response.
+                def _session_ctl(op_=op, rid_=rid, payload_=payload):
+                    try:
+                        res = (service.open_session(payload_)
+                               if op_ == "session_open"
+                               else service.close_session(payload_))
+                    except BaseException as e:  # noqa: BLE001 — typed
+                        outq.put(("err", rid_, _picklable_exc(e)))
+                    else:
+                        outq.put(("ok", rid_, res))
+                if op == "session_open":
+                    threading.Thread(
+                        target=_session_ctl,
+                        name=f"replica-{replica_id}-session",
+                        daemon=True).start()
+                else:
+                    _session_ctl()
+                continue
             try:
                 if op == "encode":
                     fut = service.submit_encode(
@@ -316,6 +355,10 @@ def _replica_main(conn, config, replica_id: int) -> None:
                 elif op == "decode":
                     fut = service.submit_decode(
                         payload, deadline_ms=deadline_ms, priority=priority)
+                elif op == "decode_si":
+                    fut = service.submit_decode_si(
+                        payload[0], payload[1], deadline_ms=deadline_ms,
+                        priority=priority)
                 else:
                     raise ValueError(f"unknown replica op {op!r}")
             except BaseException as e:  # noqa: BLE001 — typed door rejects
@@ -449,6 +492,8 @@ class FrontDoorRouter:
         self._fails: Dict[int, int] = {}   # guarded-by: self._lock
         self._rr: Dict[str, int] = {}      # guarded-by: self._lock
         self._rid = 0                      # guarded-by: self._lock
+        # sid -> replica idx: the session-affinity pin table (ISSUE 10)
+        self._sessions: Dict[str, int] = {}  # guarded-by: self._lock
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
         self._started = False
@@ -599,6 +644,141 @@ class FrontDoorRouter:
         self.metrics.counter(f"serve_router_routed_{cls}").inc()
         return pending.future
 
+    # -- side-information sessions (ISSUE 10) --------------------------------
+
+    def _send_pinned(self, rep: _Replica, op: str,
+                     pending: _Pending) -> bool:
+        """Targeted send to a SPECIFIC replica (no re-pick on failure —
+        session state lives only there). Returns False when the pipe is
+        already gone; the caller owns the typed answer."""
+        with self._lock:
+            rid = self._next_rid_locked()
+        with rep.lock:
+            rep.inflight[rid] = pending
+            try:
+                rep.conn.send((op, rid, pending.payload, pending.priority,
+                               pending.remaining_ms()))
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                del rep.inflight[rid]
+        return False
+
+    def _publish_pins(self) -> None:
+        with self._lock:
+            n = len(self._sessions)
+        self.metrics.gauge("serve_router_sessions_pinned").set(n)
+
+    def _drop_all_pins(self, reason: str) -> None:
+        """Flush the whole pin table — every replica just invalidated
+        its session store (a fleet swap commit or rollback), so every
+        pin is stale: answering SessionExpired at the door beats paying
+        a replica round trip to learn the same thing, and a long-lived
+        router must not leak pins across model versions."""
+        with self._lock:
+            n = len(self._sessions)
+            self._sessions.clear()
+        if n:
+            self.metrics.counter(
+                f"serve_router_sessions_dropped_{reason}").inc(n)
+        self._publish_pins()
+
+    def open_session(self, side_img,
+                     timeout: Optional[float] = 120.0) -> str:
+        """Register a side image on ONE replica and pin the session to
+        it: round-robin over live replicas at open time, then every
+        decode_si for the returned sid routes there. A replica-side
+        refusal (SessionOverCapacity, bad shape) raises typed here.
+
+        A reply that times out AFTER the replica registered the prep
+        leaves that prep unpinned on the replica (the router never
+        learned its sid). That slot is not leaked forever — the store's
+        LRU bound reclaims it under pressure and `session_ttl_s` ages it
+        out — but deployments relying on opens-under-timeout should run
+        with a TTL configured."""
+        assert self._started, "start() the router before opening sessions"
+        for _ in range(self.num_replicas):
+            picked = self._pick("_session")
+            if picked is None:
+                break
+            rep, _rid = picked
+            pending = _Pending("session_open", side_img, "control",
+                               None, 0)
+            if not self._send_pinned(rep, "session_open", pending):
+                self._on_disconnect(rep)
+                continue
+            sid = pending.future.result(timeout)
+            with self._lock:
+                self._sessions[sid] = rep.idx
+            self.metrics.counter("serve_router_sessions_opened").inc()
+            self._publish_pins()
+            return sid
+        raise ServiceUnavailable(
+            f"no live replica to open a session on "
+            f"({self.num_replicas} configured) — retry shortly")
+
+    def close_session(self, session_id: str,
+                      timeout: Optional[float] = 30.0) -> bool:
+        """Unpin + free a session; False if it was already gone."""
+        assert self._started, "start() the router first"
+        with self._lock:
+            idx = self._sessions.pop(session_id, None)
+        self._publish_pins()
+        if idx is None:
+            return False
+        rep = self._replicas[idx]
+        pending = _Pending("session_close", session_id, "control", None, 0)
+        if not self._send_pinned(rep, "session_close", pending):
+            self._on_disconnect(rep)
+            return False    # replica gone: its store died with it
+        try:
+            return bool(pending.future.result(timeout))
+        except Exception:   # noqa: BLE001 — the pin is dropped either way
+            return False
+
+    def submit_decode_si(self, blob: bytes, session_id: str,
+                         deadline_ms: Optional[float] = None,
+                         priority: Optional[str] = None) -> Future:
+        """SI decode against a pinned session. An unknown pin, an
+        evicted/dead pinned replica, or the replica dying mid-flight
+        all answer typed `SessionExpired` — the prep existed in exactly
+        one process, so 're-open the session' is the only recovery."""
+        assert self._started, "start() the router before submitting"
+        with self._lock:
+            idx = self._sessions.get(session_id)
+            state = None if idx is None else self._state.get(idx)
+        if idx is None or state != "live":
+            raise SessionExpired(
+                f"session {session_id!r} is not pinned to a live replica "
+                f"(never opened, closed, or its replica "
+                f"{'died' if idx is not None else 'is unknown'}) — "
+                f"re-open it")
+        cls = priority or self._class_names[0]
+        self.admission.admit(cls)   # sheds HERE, before any enqueue
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms.get(cls)
+        pending = _Pending("decode_si", (blob, session_id), cls,
+                           deadline_ms, 0)
+        self.admission.attach(cls, pending.future)
+        self._swap_gate.wait(_SWAP_GATE_TIMEOUT_S)
+        rep = self._replicas[idx]
+        if not self._send_pinned(rep, "decode_si", pending):
+            self._on_disconnect(rep)
+            exc = SessionExpired(
+                f"session {session_id!r}'s replica {idx} is gone — "
+                f"its prep died with it; re-open the session")
+            pending.future.set_exception(exc)
+            raise exc
+        self.metrics.counter(f"serve_router_routed_{cls}").inc()
+        self.metrics.counter(f"serve_router_routed_r{rep.idx}").inc()
+        return pending.future
+
+    def decode_si(self, blob: bytes, session_id: str,
+                  deadline_ms: Optional[float] = None,
+                  timeout: Optional[float] = 120.0,
+                  priority: Optional[str] = None):
+        return self.submit_decode_si(blob, session_id, deadline_ms,
+                                     priority=priority).result(timeout)
+
     # -- routing ------------------------------------------------------------
 
     def _next_rid_locked(self) -> int:
@@ -689,11 +869,32 @@ class FrontDoorRouter:
         draining = self._stop.is_set()
         if not draining:
             self.metrics.counter("serve_router_replica_deaths").inc()
+        # drop the dead replica's session pins FIRST: a submit racing
+        # this death must find no pin (typed SessionExpired at the
+        # door), never a pin pointing at a corpse
+        with self._lock:
+            orphan_sids = [sid for sid, i in self._sessions.items()
+                           if i == rep.idx]
+            for sid in orphan_sids:
+                del self._sessions[sid]
+        if orphan_sids and not draining:
+            self.metrics.counter("serve_router_session_orphans").inc(
+                len(orphan_sids))
+        self._publish_pins()
         with rep.lock:
             orphans = list(rep.inflight.items())
             rep.inflight.clear()
         for _rid, pending in orphans:
             if pending.future.done():
+                continue
+            if pending.op == "decode_si":
+                # the session's prep lived only in the dead replica —
+                # rerouting would hit a store that never heard of it;
+                # fail typed with the one recovery that works
+                pending.future.set_exception(SessionExpired(
+                    f"replica {rep.idx} died holding this SI request — "
+                    f"its session's prep died with it; re-open the "
+                    f"session"))
                 continue
             if pending.op in CONTROL_OPS:
                 # a swap phase is pinned to ITS replica — rerouting a
@@ -830,6 +1031,10 @@ class FrontDoorRouter:
                     reps, "swap_commit", digest, commit_timeout_s)
             finally:
                 self._swap_gate.set()
+            if not commit_errors:
+                # every replica committed: their session stores were
+                # invalidated by commit_swap, so the pins are all stale
+                self._drop_all_pins("swap")
             if commit_errors:
                 # converge DOWN. A commit that merely TIMED OUT may
                 # still land later (the pipe is FIFO), so recovery for
@@ -846,6 +1051,11 @@ class FrontDoorRouter:
                 self._broadcast(reps, "rollback", digest,
                                 commit_timeout_s)
                 self.metrics.counter("serve_router_swap_aborts").inc()
+                # committed-then-rolled-back replicas cleared their
+                # stores; conservatively drop EVERY pin (re-open is the
+                # one client recovery anyway) rather than track which
+                # replica kept its sessions through the partial commit
+                self._drop_all_pins("swap")
                 outcome = {i: "committed, rolled back" for i in committed}
                 outcome.update({i: e for i, e in commit_errors.items()})
                 raise FleetSwapError(
@@ -876,6 +1086,8 @@ class FrontDoorRouter:
                                               timeout_s)
         finally:
             self._swap_gate.set()
+        # every replica that rolled back invalidated its session store
+        self._drop_all_pins("rollback")
         digests = {info["digest"] for info in results.values()}
         if errors or len(digests) != 1:
             self.metrics.counter("serve_router_swap_aborts").inc()

@@ -116,6 +116,28 @@ Per-device observability: `serve_devices`, `serve_device_batches_d<i>`,
 `serve_device_busy_ms_d<i>`, `serve_placement_rebalances`, and the
 `serve_device_assignments` census in the /metrics info section.
 
+Side-information serving (ISSUE 10): `enable_si=True` loads the FULL
+DSIN (siNet included) and opens the session dataplane — the paper's
+actual product behind the front door. A client registers a side image
+once (`open_session`): the service runs the jitted per-bucket prep
+executable (AE-reconstruct y, color-transform, window statistics,
+Gaussian prior factors, and on TPU the padded tensor the fused Pallas
+kernel slices) into an immutable `ops.sifinder.SidePrep`, cached
+device-resident in the LRU/TTL/byte-bounded `serve/session.py` store.
+`submit_decode_si(stream, session_id)` then decodes THROUGH the SI
+path: one jitted executable per bucket runs decode → siFinder (against
+the cached prep, passed as traced arguments — executables stay
+shape-keyed, so sessions churn with ZERO steady-state compiles) →
+siNet. Requests sharing a session coalesce into one micro-batch
+(`Request.session` narrows the batcher key), so a burst against one
+side image rides one executable call and one VMEM-resident y. Sessions
+are model-versioned: a hot swap or rollback invalidates the store
+(`SessionExpired` — the prep embeds the OLD params' ŷ), and every miss
+mode (evicted, TTL, swap, dead replica) answers the same typed error.
+Observability: `serve_sessions_live`, `serve_session_bytes`,
+`serve_session_evictions[_<reason>]`, `serve_si_prep_ms`,
+`serve_si_search_ms`; fault site `serve.session` on every lookup.
+
 Live model operations (ISSUE 9): the model is no longer frozen at
 start(). Everything a batch reads about "the model" — per-device
 replicated params, the host codec, per-thread codec clones, the
@@ -158,6 +180,7 @@ from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve import placement as placement_lib
 from dsin_tpu.serve import router as router_lib
 from dsin_tpu.serve import swap as swap_lib
+from dsin_tpu.serve import session as session_lib
 from dsin_tpu.serve.batcher import (Future, MicroBatcher, PriorityClass,
                                     Request, ServiceDraining,
                                     ServiceUnavailable)
@@ -173,6 +196,7 @@ _FRAME_LEN = 21     # v2: + I(4) CRC
 
 ENCODE = "encode"
 DECODE = "decode"
+DECODE_SI = "decode_si"   # session-affine SI decode (ISSUE 10)
 
 
 @dataclass
@@ -250,6 +274,18 @@ class ServiceConfig:
     rebalance_skew_threshold: float = 2.0
     rebalance_hysteresis_checks: int = 2
     rebalance_cooldown_s: float = 60.0
+    #: side-information serving (ISSUE 10): load the full DSIN (siNet
+    #: included), open the session API (open_session/submit_decode_si),
+    #: and compile the per-bucket SI executables at warmup. Requires
+    #: every bucket edge divisible by the config's y_patch_size, and is
+    #: single-device per replica for now (scale OUT through the
+    #: session-pinning router, serve/router.py).
+    enable_si: bool = False
+    #: session store bounds (serve/session.py): max live sessions, max
+    #: per-session device bytes in total, and an optional idle TTL.
+    session_max: int = 8
+    session_max_bytes: int = 64 * 1024 * 1024
+    session_ttl_s: Optional[float] = None
     #: persistent XLA compilation cache (utils/cache.py) at start(), so
     #: a restarted service re-warms from disk instead of recompiling
     persistent_cache: bool = True
@@ -340,6 +376,51 @@ def _make_batched_fns(model):
     return jax.jit(encode_fn), jax.jit(decode_fn)
 
 
+def _make_si_fns(model, for_pallas: bool):
+    """The SI dataplane's two jitted functions (enable_si, ISSUE 10).
+    Same contract as `_make_batched_fns`: params/batch_stats AND the
+    SidePrep enter as traced arguments (`model` is the static module
+    bundle), so executables are keyed by bucket shapes only — sessions
+    come and go without a compile.
+
+    * `si_prep_fn(params, batch_stats, y, mask_factors)` — the
+      y-invariant half, run ONCE per session: AE-reconstruct y in eval
+      mode (the same ŷ the train step searches, train/step.py), then
+      `ops.sifinder.build_side_prep` (transform, window statistics,
+      prior factors, and with `for_pallas` the fused kernel's padded
+      side operands).
+    * `si_decode_fn(params, batch_stats, symbols, prep)` — the per-
+      request path: decode → prepped siFinder → siNet, one fused
+      executable per bucket."""
+    from dsin_tpu.ops import sifinder as sifinder_lib
+    cfg = model.ae_config
+    ph, pw = (int(v) for v in cfg.y_patch_size)
+    use_l2 = bool(cfg.use_L2andLAB)
+    pallas_dtype = sifinder_lib.sifinder_conv_dtype(
+        cfg, jnp.dtype("float32"))
+
+    def si_prep_fn(params, batch_stats, y, mask_factors):
+        enc_out, _ = model.encode(params, batch_stats, y[None],
+                                  train=False)
+        y_dec, _ = model.decode(params, batch_stats, enc_out.qbar,
+                                train=False)
+        return sifinder_lib.build_side_prep(
+            y, y_dec[0], ph, pw, use_l2=use_l2,
+            mask_factors=mask_factors, for_pallas=for_pallas,
+            pallas_dtype=pallas_dtype)
+
+    def si_decode_fn(params, batch_stats, symbols, prep):
+        from dsin_tpu.models.quantizer import centers_lookup
+        q = centers_lookup(params["centers"], symbols)
+        x_dec, _ = model.decode(params, batch_stats, q, train=False)
+        y_syn = sifinder_lib.synthesize_side_image_prepped(
+            x_dec, prep, ph, pw, cfg)
+        x_si = model.apply_sinet(params, x_dec, y_syn)
+        return jnp.clip(x_si, 0.0, 255.0)
+
+    return jax.jit(si_prep_fn), jax.jit(si_decode_fn)
+
+
 class _DeviceBatch:
     """One dispatched jitted batch. The device computes while the worker
     thread moves on to the next batch; the FIRST entropy task to need
@@ -383,7 +464,8 @@ class _Inflight:
     per-batch ledger the stage metrics come from."""
 
     __slots__ = ("kind", "batch", "bucket", "t0", "device", "bundle",
-                 "tasks", "handle", "sym", "per_item_exc", "crash")
+                 "tasks", "handle", "sym", "per_item_exc", "crash",
+                 "si_entry")
 
     def __init__(self, kind, batch, bucket, t0, device, bundle):
         self.kind = kind
@@ -399,6 +481,10 @@ class _Inflight:
         self.sym: Optional[np.ndarray] = None        # decode gather
         self.per_item_exc = {}
         self.crash: Optional[BaseException] = None
+        #: DECODE_SI: the SessionEntry captured at batch start — the
+        #: device stage reads ITS prep, so an eviction mid-batch cannot
+        #: tear the search (the entry is immutable)
+        self.si_entry = None
 
 
 class CompressionService:
@@ -464,6 +550,14 @@ class CompressionService:
         # would raise mid-iteration — snapshot with one attribute read
         self._warmed_pairs = frozenset()
         self._warm_shapes = []      # per-bucket (D, H, W) volume shapes
+        # side-information dataplane (ISSUE 10); populated at start()
+        # when enable_si
+        self._si_enabled = False
+        self._sessions: Optional[session_lib.SessionStore] = None
+        self._si_prep_jit = None
+        self._si_decode_jit = None
+        self._si_factors = {}       # bucket -> (gh, gw) device arrays|None
+        self._si_warmed = frozenset()   # copy-on-write, like _warmed_pairs
         self.model = None
         #: the hot-swap state machine; current/prev/staged ModelBundles
         self._swap: Optional[swap_lib.SwapCoordinator] = None
@@ -505,6 +599,32 @@ class CompressionService:
         if self.config.entropy_proc_timeout_s <= 0:
             raise ValueError(f"entropy_proc_timeout_s must be > 0, got "
                              f"{self.config.entropy_proc_timeout_s}")
+        # SI-serving knobs (ISSUE 10), validated BEFORE the model build
+        # like everything above: a config typo costs milliseconds
+        self._si_enabled = bool(self.config.enable_si)
+        if self._si_enabled:
+            if self.config.devices not in (None, 1):
+                raise ValueError(
+                    f"enable_si serves on a single device per replica "
+                    f"(got devices={self.config.devices}); scale out "
+                    f"through FrontDoorRouter's session pinning "
+                    f"(serve/router.py) — a session's device-resident "
+                    f"prep cannot chase batches across a mesh")
+            from dsin_tpu.config import parse_config_file
+            _si_probe_cfg = parse_config_file(self.config.ae_config)
+            ph, pw = (int(v) for v in _si_probe_cfg.y_patch_size)
+            bad = [b for b in self.policy.buckets
+                   if b[0] % ph or b[1] % pw]
+            if bad:
+                raise ValueError(
+                    f"enable_si needs every bucket edge divisible by "
+                    f"y_patch_size ({ph}, {pw}) — the siFinder patch "
+                    f"grid must tile the bucket exactly; offending "
+                    f"buckets: {bad}")
+            # the store's own __init__ validates the bounds
+            self._sessions = session_lib.SessionStore(
+                self.config.session_max, self.config.session_max_bytes,
+                self.config.session_ttl_s, metrics=self.metrics)
         # load-aware auto-rebalance (ISSUE 8 satellite) knobs, validated
         # up front with the rest: a bad value must not leave spawned
         # worker threads behind when start() raises
@@ -526,10 +646,35 @@ class CompressionService:
         init_shape = self.policy.buckets[-1]
         self.model, state = load_model_state(
             self.config.ae_config, self.config.pc_config, self.config.ckpt,
-            init_shape, need_sinet=False, seed=self.config.seed,
+            init_shape, need_sinet=self._si_enabled, seed=self.config.seed,
             persistent_cache=self.config.persistent_cache)
         codec = make_codec(self.model, state)
         self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
+        if self._si_enabled:
+            from dsin_tpu.ops import sifinder as sifinder_lib
+            ph, pw = (int(v) for v in self.model.ae_config.y_patch_size)
+            # build the kernel half of every prep whenever the SI
+            # executable will dispatch to the fused kernel: explicit
+            # 'pallas'/'pallas_interpret' (the interpreter runs on any
+            # backend — tests exercise it on CPU), or 'auto' on TPU
+            si_impl = getattr(self.model.ae_config, "sifinder_impl",
+                              "auto")
+            si_for_pallas = (
+                not bool(self.model.ae_config.use_L2andLAB)
+                and (si_impl in ("pallas", "pallas_interpret")
+                     or (si_impl == "auto"
+                         and jax.default_backend() == "tpu")))
+            self._si_prep_jit, self._si_decode_jit = _make_si_fns(
+                self.model, si_for_pallas)
+            # the prior factors are y-independent, bucket-static: one
+            # device upload per bucket, shared by every session
+            use_prior = bool(self.model.ae_config.use_gauss_mask)
+            for bh, bw in self.policy.buckets:
+                self._si_factors[(bh, bw)] = (
+                    tuple(jnp.asarray(m) for m in
+                          sifinder_lib.gaussian_position_mask_factors(
+                              bh, bw, ph, pw))
+                    if use_prior else None)
         self._bn_channels = int(self.model.ae_config.num_chan_bn)
         sub = buckets_lib.SUBSAMPLING
         self._warm_shapes = [(self._bn_channels, bh // sub, bw // sub)
@@ -640,6 +785,11 @@ class CompressionService:
 
             for f in [self._entropy_pool.submit(_prime) for _ in range(n)]:
                 f.result(timeout=120)
+        if self._si_enabled:
+            # compile the SI dataplane's per-bucket executables (prep +
+            # fused decode->search->siNet) now, so sessions churn with
+            # zero steady-state compiles — the ISSUE 10 acceptance pin
+            self._warm_si()
         proc = self._swap.current.proc()
         if proc is not None:
             # spin every pool process up now (spawn + codec rebuild +
@@ -655,10 +805,37 @@ class CompressionService:
         self.metrics.gauge("serve_warmup_compiles").set(compiles)
         self.metrics.gauge("serve_buckets").set(len(self.policy.buckets))
         self.metrics.gauge("serve_executable_census").set(
-            2 * len(self._warmed_pairs))
+            self._census_size())
         return {"compiles": compiles,
                 "cache_hits": cache_hits,
                 "seconds": time.monotonic() - t0}
+
+    def _census_size(self) -> int:
+        """Executable count the warm covers: encode+decode per (bucket,
+        device) pair, plus prep+SI-decode per bucket when SI is on."""
+        return 2 * len(self._warmed_pairs) + 2 * len(self._si_warmed)
+
+    def _warm_si(self, bundle: Optional[swap_lib.ModelBundle] = None
+                 ) -> None:
+        """Compile/prime the SI executables for every bucket (or, with
+        `bundle`, drive the already-compiled ones with an incoming
+        model's replicas — the hot-swap warm, zero new compiles)."""
+        if bundle is None:
+            bundle = self._swap.current
+        params, bs = bundle.device_state[0]
+        sub = buckets_lib.SUBSAMPLING
+        for bh, bw in self.policy.buckets:
+            y0 = jnp.zeros((bh, bw, 3), jnp.float32)
+            prep = self._si_prep_jit(params, bs, y0,
+                                     self._si_factors[(bh, bw)])
+            # the sym batch must carry the SAME placement sharding the
+            # dataplane's put_batch commits, or the warm compiles a
+            # different executable than the one requests hit
+            sym = self.placement.put_batch(
+                0, np.zeros((self.config.max_batch, bh // sub, bw // sub,
+                             self._bn_channels), np.int32))
+            np.asarray(self._si_decode_jit(params, bs, sym, prep))
+            self._si_warmed = self._si_warmed | {(bh, bw)}
 
     def _warm_pair(self, bucket: Tuple[int, int], device: int,
                    bundle: Optional[swap_lib.ModelBundle] = None
@@ -738,7 +915,7 @@ class CompressionService:
         changed = self.placement.set_plan(plan)
         self.metrics.counter("serve_placement_rebalances").inc()
         self.metrics.gauge("serve_executable_census").set(
-            2 * len(self._warmed_pairs))
+            self._census_size())
         self._publish_placement()
         return {"changed": changed, "warmed_pairs": len(new_pairs),
                 "assignments": plan.as_dict()}
@@ -766,7 +943,8 @@ class CompressionService:
             new_state, info = loader_lib.load_swap_state(
                 ckpt_dir, self.state,
                 pc_config=self.model.pc_config,
-                buckets=self.policy.buckets)
+                buckets=self.policy.buckets,
+                need_sinet=self._si_enabled)
             # the prepare window: a kill here must leave the service
             # serving the old params with the claim released
             faults.inject("serve.swap")
@@ -813,6 +991,10 @@ class CompressionService:
         for bucket, device in sorted(self._warmed_pairs):
             symbols_by_bucket[bucket] = self._warm_pair(bucket, device,
                                                         bundle=bundle)
+        if self._si_enabled and self._si_warmed:
+            # drive the SI executables with the incoming replicas too
+            # (same shape-keyed programs — zero new compiles)
+            self._warm_si(bundle=bundle)
         for symbols in symbols_by_bucket.values():
             stream = bundle.codec.encode(np.transpose(symbols[0], (2, 0, 1)))
             bundle.codec.decode(stream)
@@ -839,6 +1021,9 @@ class CompressionService:
         faults.inject("serve.swap")
         for b in self._swap.commit(expect_digest):
             b.retire()
+        # sessions are model-versioned: their preps embed the OLD
+        # params' ŷ reconstruction — invalidate, clients re-open
+        self._invalidate_sessions("swap")
         return self._swap.snapshot()
 
     def abort_swap(self) -> dict:
@@ -875,7 +1060,15 @@ class CompressionService:
         assert self._started, "start() before rollback()"
         for b in self._swap.rollback(expect_current=expect_current):
             b.retire()
+        self._invalidate_sessions("rollback")
         return self._swap.snapshot()
+
+    def _invalidate_sessions(self, reason: str) -> None:
+        """Drop every cached SidePrep (the serving params changed — a
+        stale prep would search against the wrong ŷ). Clients see typed
+        SessionExpired and re-open."""
+        if self._sessions is not None and self._sessions.live:
+            self._sessions.clear(reason)
 
     @property
     def draining(self) -> bool:
@@ -923,6 +1116,10 @@ class CompressionService:
                 # process pool; workers joined, so the pools are idle
                 for b in self._swap.all_bundles():
                     b.retire()
+            if self._sessions is not None:
+                # no hung session slots: drained services hold no
+                # device-resident preps
+                self._sessions.clear("drain")
             if self._metrics_server is not None:
                 self._metrics_server.stop()
                 self._metrics_server = None
@@ -974,7 +1171,11 @@ class CompressionService:
                     self.metrics.counter("serve_worker_restarts").value,
                 # which model is serving + where a swap stands (ISSUE 9)
                 "model": (self._swap.snapshot()
-                          if self._swap is not None else {})}
+                          if self._swap is not None else {}),
+                # the SI session dataplane (ISSUE 10; absent = SI off)
+                **({"sessions": {"live": self._sessions.live,
+                                 "bytes": self._sessions.bytes_used}}
+                   if self._sessions is not None else {})}
 
     def _deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
         return (None if deadline_ms is None
@@ -1079,6 +1280,115 @@ class CompressionService:
                                            frame_crc(payload)),
             deadline=self._deadline(deadline_ms), priority=priority))
 
+    # -- side-information sessions (ISSUE 10) ---------------------------------
+
+    def _require_si(self) -> session_lib.SessionStore:
+        if not self._si_enabled:
+            raise session_lib.SessionError(
+                "this service was started without enable_si — it has no "
+                "session dataplane (set ServiceConfig.enable_si=True)")
+        return self._sessions
+
+    def open_session(self, side_img: np.ndarray,
+                     session_id: Optional[str] = None) -> str:
+        """Register a side image y; returns the session id. This is the
+        WHOLE request-invariant half of the SI search, paid once: pad y
+        onto its bucket, run the jitted per-bucket prep executable
+        (AE-reconstruct, transform, window statistics, prior factors,
+        Pallas padding on TPU), and park the resulting device-resident
+        SidePrep in the LRU/TTL store. Every later `decode_si` against
+        this id skips all of it."""
+        sessions = self._require_si()
+        assert self._started, "start() + warmup() before open_session()"
+        if self._draining.is_set():
+            self.metrics.counter("serve_rejected_drain").inc()
+            raise ServiceDraining("service is draining; not accepting "
+                                  "new sessions")
+        img = np.asarray(side_img)
+        if img.ndim != 3 or img.shape[-1] != 3:
+            raise ValueError(f"expected (h, w, 3) side image, "
+                             f"got {img.shape}")
+        h, w = img.shape[:2]
+        bucket = self.policy.bucket_for(h, w)
+        padded = buckets_lib.pad_to_bucket(
+            img.astype(np.float32, copy=False), bucket)
+        bundle = self._swap.current
+        params, bs = bundle.device_state[0]
+        t0 = time.monotonic()
+        prep = self._si_prep_jit(params, bs, jnp.asarray(padded),
+                                 self._si_factors[bucket])
+        jax.block_until_ready(prep)
+        self.metrics.histogram("serve_si_prep_ms").observe(
+            (time.monotonic() - t0) * 1e3)
+        sid = session_id if session_id is not None \
+            else sessions.next_sid()
+        nbytes = sum(int(leaf.nbytes)
+                     for leaf in jax.tree_util.tree_leaves(prep))
+        sessions.put(session_lib.SessionEntry(
+            sid=sid, prep=prep, bucket=bucket, nbytes=nbytes,
+            digest=bundle.digest))
+        self.metrics.counter("serve_sessions_opened").inc()
+        return sid
+
+    def close_session(self, session_id: str) -> bool:
+        """Free a session's device-resident prep; False if it was
+        already gone (evicted/expired — not an error: the slot is free
+        either way)."""
+        sessions = self._require_si()
+        return sessions.evict(session_id, "closed")
+
+    def submit_decode_si(self, blob: bytes, session_id: str,
+                         deadline_ms: Optional[float] = None,
+                         priority: Optional[str] = None) -> Future:
+        """Framed DSRV stream + open session -> Future[(h, w, 3) uint8
+        SI-fused reconstruction]. The session is validated (and its LRU
+        recency refreshed) at the door — a gone session raises typed
+        `SessionExpired` here; one that expires between admission and
+        batch start fails the batch's futures with the same type. The
+        stream must route to the session's bucket: the siFinder patch
+        grid and correlation map are one geometry."""
+        sessions = self._require_si()
+        payload, shape, bucket = parse_stream(blob)
+        if bucket not in self.policy.buckets:
+            raise buckets_lib.NoBucketFits(
+                f"stream was encoded for bucket {bucket}, which this "
+                f"service does not serve (buckets: "
+                f"{list(self.policy.buckets)})")
+        entry = sessions.get(session_id)
+        if entry.bucket != bucket:
+            raise session_lib.SessionError(
+                f"stream bucket {bucket} does not match session "
+                f"{session_id!r} (opened at {entry.bucket}) — the SI "
+                f"search needs x and y at one geometry; open a session "
+                f"with a side image of the request's bucket")
+        return self._submit(Request(
+            key=(DECODE_SI, bucket), payload=(payload, shape,
+                                              frame_crc(payload)),
+            deadline=self._deadline(deadline_ms), priority=priority,
+            session=session_id))
+
+    def decode_si(self, blob: bytes, session_id: str,
+                  deadline_ms: Optional[float] = None,
+                  timeout: Optional[float] = 60.0,
+                  priority: Optional[str] = None) -> np.ndarray:
+        return self.submit_decode_si(blob, session_id, deadline_ms,
+                                     priority=priority).result(timeout)
+
+    def _resolve_session(self, batch, bundle) -> session_lib.SessionEntry:
+        """Batch-start session lookup (worker side): the entry captured
+        HERE is what the device stage reads — immutable, so a
+        concurrent eviction cannot tear the search. A session that
+        outlived its slot (LRU/TTL) or its model (hot swap landed since
+        the prep was built) fails the whole batch typed."""
+        entry = self._sessions.get(batch[0].session)
+        if entry.digest != bundle.digest:
+            self._sessions.evict(batch[0].session, "swap")
+            raise session_lib.SessionExpired(
+                f"session {batch[0].session!r} was prepared against "
+                f"model {entry.digest} but {bundle.digest} is serving "
+                f"(hot swap/rollback since) — re-open it")
+        return entry
+
     def encode(self, img: np.ndarray, deadline_ms: Optional[float] = None,
                timeout: Optional[float] = 60.0,
                priority: Optional[str] = None) -> EncodeResult:
@@ -1133,7 +1443,7 @@ class CompressionService:
                         accept_plan = plan
                         accept = frozenset(
                             (kind, bucket)
-                            for kind in (ENCODE, DECODE)
+                            for kind in (ENCODE, DECODE, DECODE_SI)
                             for bucket in plan.buckets_for(device))
                 # with work in flight, poll instead of blocking: an empty
                 # queue means it is time to finish the oldest batch, not
@@ -1316,7 +1626,8 @@ class CompressionService:
                     batch, bucket, device, bundle)
             else:
                 device_ms, entropy_ms = self._run_decode(
-                    batch, bucket, device, bundle)
+                    batch, bucket, device, bundle,
+                    si=(kind == DECODE_SI))
             dt = (time.monotonic() - t0) * 1e3
             self._busy_ms.add(dt)
             self._device_busy(device).add(dt)
@@ -1337,6 +1648,12 @@ class CompressionService:
             rec.handle = _DeviceBatch(self._encode_fn(
                 params, bs, self.placement.put_batch(device, x)))
         else:
+            if kind == DECODE_SI:
+                # resolve the session BEFORE any entropy work is queued:
+                # a gone/swapped session fails the batch typed here (the
+                # worker loop answers every future) with nothing in
+                # flight to flush
+                rec.si_entry = self._resolve_session(batch, bundle)
             bh, bw = bucket
             sub = buckets_lib.SUBSAMPLING
             rec.sym = np.zeros((self.config.max_batch, bh // sub,
@@ -1597,9 +1914,16 @@ class CompressionService:
         else:
             t_dev = time.monotonic()
             params, bs = rec.bundle.device_state[rec.device]
-            imgs = np.asarray(self._decode_fn(
-                params, bs, self.placement.put_batch(rec.device, rec.sym)))
+            sym_dev = self.placement.put_batch(rec.device, rec.sym)
+            if rec.kind == DECODE_SI:
+                imgs = np.asarray(self._si_decode_jit(
+                    params, bs, sym_dev, rec.si_entry.prep))
+            else:
+                imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
             device_ms = (time.monotonic() - t_dev) * 1e3
+            if rec.kind == DECODE_SI:
+                self.metrics.histogram("serve_si_search_ms").observe(
+                    device_ms)
             for i, r in enumerate(rec.batch):
                 if i in rec.per_item_exc:
                     continue       # its future already holds the error
@@ -1704,10 +2028,14 @@ class CompressionService:
                 model_digest=bundle.digest))
         return ((t_ent - t_dev) * 1e3, (time.monotonic() - t_ent) * 1e3)
 
-    def _run_decode(self, batch, bucket, device: int,
-                    bundle) -> Tuple[float, float]:
+    def _run_decode(self, batch, bucket, device: int, bundle,
+                    si: bool = False) -> Tuple[float, float]:
         """Serialized decode (entropy_workers=0): entropy then device,
-        inline on the worker thread. Returns (device_ms, entropy_ms)."""
+        inline on the worker thread. Returns (device_ms, entropy_ms).
+        `si` routes the device stage through the fused SI executable
+        against the batch's session prep (resolved FIRST — a gone
+        session fails the batch typed before any entropy work)."""
+        si_entry = self._resolve_session(batch, bundle) if si else None
         bh, bw = bucket
         sub = buckets_lib.SUBSAMPLING
         sym = np.zeros((self.config.max_batch, bh // sub, bw // sub,
@@ -1736,9 +2064,16 @@ class CompressionService:
             return (0.0, entropy_ms)
         params, bs = bundle.device_state[device]
         t_dev = time.monotonic()
-        imgs = np.asarray(self._decode_fn(
-            params, bs, self.placement.put_batch(device, sym)))
+        sym_dev = self.placement.put_batch(device, sym)
+        if si:
+            imgs = np.asarray(self._si_decode_jit(params, bs, sym_dev,
+                                                  si_entry.prep))
+        else:
+            imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
         device_ms = (time.monotonic() - t_dev) * 1e3
+        if si:
+            self.metrics.histogram("serve_si_search_ms").observe(
+                device_ms)
         for i, r in enumerate(batch):
             if i in per_item_exc:
                 r.future.set_exception(per_item_exc[i])

@@ -41,8 +41,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from dsin_tpu.utils import locks as locks_lib
 
-SITES = ("serve.worker.batch", "serve.rans", "serve.swap", "ckpt.write",
-         "ckpt.swap", "ckpt.manifest", "io.read")
+SITES = ("serve.worker.batch", "serve.rans", "serve.swap", "serve.session",
+         "ckpt.write", "ckpt.swap", "ckpt.manifest", "io.read")
 
 ACTIONS = ("raise", "crash", "delay", "corrupt")
 

@@ -33,6 +33,8 @@ a thread holding rank r may only acquire ranks > r):
       12  serve.future        Future done-callback slot (serve/batcher.py)
       14  serve.admission     per-class outstanding counts (serve/router.py)
       15  serve.placement     bucket->device routing table (serve/placement.py)
+      16  serve.session       side-information session LRU/TTL store
+                              (serve/session.py)
       17  serve.model         live/prev/staged model-bundle pointers for
                               the hot-swap state machine (serve/swap.py)
       20  serve.workers       worker-pool bookkeeping (serve/service.py)
@@ -78,6 +80,7 @@ HIERARCHY: Dict[str, int] = {
     "serve.rebalance": 13,
     "serve.admission": 14,
     "serve.placement": 15,
+    "serve.session": 16,
     "serve.model": 17,
     "serve.workers": 20,
     "serve.entropy_proc": 25,

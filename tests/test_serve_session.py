@@ -1,0 +1,484 @@
+"""Session-cached side-information serving (ISSUE 10).
+
+Three layers under test:
+  * SessionStore — LRU/TTL/byte-cap eviction order, typed misses,
+    metrics (pure stdlib, injected clock);
+  * the batcher's session-affinity coalescing (same bucket + same
+    session batch together; different sessions never share a batch);
+  * the SI service dataplane — open/decode_si end to end against the
+    real tiny model, zero steady-state compiles while sessions churn
+    over a MIXED SI and non-SI stream, door/mid-batch expiry typed,
+    hot-swap invalidation;
+  * the router's session pinning (fake replicas speaking the pipe
+    protocol): pinned routing, death -> typed SessionExpired + dropped
+    pins.
+"""
+
+import multiprocessing
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import (CompressionService, MetricsRegistry,
+                            ServiceConfig, SessionEntry, SessionExpired,
+                            SessionOverCapacity, SessionStore)
+from dsin_tpu.serve.batcher import (MicroBatcher, Request, SessionKey,
+                                    default_priority_classes)
+from dsin_tpu.serve.router import FrontDoorRouter
+from dsin_tpu.serve.service import parse_stream
+from dsin_tpu.serve.session import SessionError
+
+BUCKETS = ((16, 24), (32, 48))
+
+
+# -- SessionStore unit layer --------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _entry(sid, nbytes=10, bucket=(16, 24), digest="d0"):
+    return SessionEntry(sid=sid, prep=object(), bucket=bucket,
+                        nbytes=nbytes, digest=digest)
+
+
+def test_store_lru_eviction_order_and_get_refresh():
+    m = MetricsRegistry()
+    store = SessionStore(max_sessions=2, max_bytes=1000, metrics=m)
+    store.put(_entry("a"))
+    store.put(_entry("b"))
+    store.get("a")                      # refresh: b is now the LRU
+    evicted = store.put(_entry("c"))
+    assert evicted == ["b"]
+    store.get("a"), store.get("c")
+    with pytest.raises(SessionExpired, match="re-open"):
+        store.get("b")
+    assert m.counter("serve_session_evictions").value == 1
+    assert m.counter("serve_session_evictions_lru").value == 1
+    assert m.gauge("serve_sessions_live").value == 2
+
+
+def test_store_byte_cap_evicts_lru_and_refuses_oversize():
+    m = MetricsRegistry()
+    store = SessionStore(max_sessions=10, max_bytes=100, metrics=m)
+    store.put(_entry("a", nbytes=40))
+    store.put(_entry("b", nbytes=40))
+    assert store.put(_entry("c", nbytes=40)) == ["a"]   # 120 > 100
+    assert store.bytes_used == 80
+    assert m.counter("serve_session_evictions_bytes").value == 1
+    with pytest.raises(SessionOverCapacity, match="session_max_bytes"):
+        store.put(_entry("huge", nbytes=101))
+    # refusal changed nothing
+    assert store.live == 2 and store.bytes_used == 80
+
+
+def test_store_ttl_expiry_lazy_and_swept():
+    clock = _Clock()
+    m = MetricsRegistry()
+    store = SessionStore(max_sessions=8, max_bytes=1000, ttl_s=5.0,
+                         metrics=m, clock=clock)
+    store.put(_entry("a"))
+    clock.t += 3
+    store.get("a")                      # touch resets the idle clock
+    clock.t += 4
+    store.get("a")                      # 4s idle < 5s TTL
+    clock.t += 6
+    with pytest.raises(SessionExpired, match="TTL"):
+        store.get("a")
+    assert m.counter("serve_session_evictions_ttl").value == 1
+    # sweep-at-put: a dead session never blocks a slot
+    store.put(_entry("b"))
+    clock.t += 6
+    store.put(_entry("c"))
+    assert store.live == 1 and store.get("c")
+
+
+def test_store_replace_and_clear():
+    m = MetricsRegistry()
+    store = SessionStore(max_sessions=4, max_bytes=1000, metrics=m)
+    store.put(_entry("a", nbytes=10))
+    store.put(_entry("a", nbytes=30))   # replace, not evict
+    assert store.bytes_used == 30
+    assert m.counter("serve_session_evictions").value == 0
+    store.put(_entry("b"))
+    assert store.clear("swap") == 2
+    assert store.live == 0 and store.bytes_used == 0
+    assert m.counter("serve_session_evictions_swap").value == 2
+
+
+def test_store_validates_bounds():
+    with pytest.raises(ValueError):
+        SessionStore(max_sessions=0, max_bytes=10)
+    with pytest.raises(ValueError):
+        SessionStore(max_sessions=1, max_bytes=0)
+    with pytest.raises(ValueError):
+        SessionStore(max_sessions=1, max_bytes=10, ttl_s=0)
+
+
+# -- batcher session affinity -------------------------------------------------
+
+def test_batcher_coalesces_per_session_only():
+    b = MicroBatcher(max_batch=4, max_wait_ms=0.0, max_queue=32)
+    key = ("decode_si", (16, 24))
+    for sid in ("s1", "s2", "s1", "s2", "s1"):
+        b.submit(Request(key=key, payload=sid, session=sid))
+    batch = b.next_batch(timeout=0.1)
+    sessions = {r.session for r in batch}
+    assert len(sessions) == 1, "a batch mixed side-information sessions"
+    assert len(batch) == (3 if sessions == {"s1"} else 2)
+    batch2 = b.next_batch(timeout=0.1)
+    assert {r.session for r in batch2} != sessions
+
+
+def test_batcher_accept_filters_on_route_not_session():
+    b = MicroBatcher(max_batch=4, max_wait_ms=0.0, max_queue=32)
+    b.submit(Request(key=("decode_si", (16, 24)), payload=0, session="s1"))
+    b.submit(Request(key=("decode_si", (32, 48)), payload=1, session="s1"))
+    got = b.next_batch(timeout=0.1,
+                       accept=frozenset({("decode_si", (32, 48))}))
+    assert [r.key[1] for r in got] == [(32, 48)]
+    # session requests and plain requests with the same route never mix
+    b.submit(Request(key=("decode", (16, 24)), payload=2))
+    assert SessionKey(("decode_si", (16, 24)), "s1") != ("decode", (16, 24))
+    rest = b.next_batch(timeout=0.1)
+    assert len(rest) == 1
+
+
+# -- SI service dataplane -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("si_serve_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def _si_config(tiny_cfg_files, **over):
+    ae_p, pc_p = tiny_cfg_files
+    kw = dict(ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS,
+              max_batch=2, max_wait_ms=2.0, max_queue=16, workers=1,
+              enable_si=True, session_max=2)
+    kw.update(over)
+    return ServiceConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def si_service(tiny_cfg_files):
+    svc = CompressionService(_si_config(tiny_cfg_files)).start()
+    warm = svc.warmup()
+    assert warm["compiles"] > 0
+    yield svc
+    svc.drain()
+
+
+def _img(rng, h, w):
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+def test_si_decode_matches_executable_and_is_deterministic(si_service):
+    """decode_si returns exactly what the SI executable computes for the
+    streamed symbols against the session's cached prep, cropped."""
+    import jax.numpy as jnp
+    svc = si_service
+    rng = np.random.default_rng(0)
+    sid = svc.open_session(_img(rng, 16, 24))
+    res = svc.encode(_img(rng, 14, 20))
+    out = svc.decode_si(res.stream, sid)
+    assert out.shape == (14, 20, 3) and out.dtype == np.uint8
+    assert np.array_equal(out, svc.decode_si(res.stream, sid))
+    assert not np.array_equal(out, svc.decode(res.stream)), \
+        "SI decode equals the plain AE decode — siNet never ran"
+
+    entry = svc._sessions.get(sid)
+    payload, shape, bucket = parse_stream(res.stream)
+    vol = svc.codec.decode(payload)
+    sym = np.zeros((svc.config.max_batch, 2, 3, vol.shape[0]), np.int32)
+    sym[0] = np.transpose(vol, (1, 2, 0))
+    params, bs = svc._swap.current.device_state[0]
+    want = np.asarray(svc._si_decode_jit(params, bs, jnp.asarray(sym),
+                                         entry.prep))
+    np.testing.assert_array_equal(out, want[0][:14, :20].astype(np.uint8))
+
+
+def test_si_zero_steady_compiles_over_mixed_stream_with_churn(si_service):
+    """The acceptance pin: a mixed SI/non-SI stream with sessions being
+    OPENED AND EVICTED throughout (session_max=2, so every third open
+    evicts) compiles nothing after warmup."""
+    from dsin_tpu.utils.recompile import CompilationSentinel
+    svc = si_service
+    rng = np.random.default_rng(1)
+    streams = {b: svc.encode(_img(rng, b[0] - 2, b[1] - 4)).stream
+               for b in BUCKETS}
+    with CompilationSentinel(budget=0, label="SI session churn"):
+        sids = []
+        expired_hits = 0
+        for i in range(8):
+            bucket = BUCKETS[i % 2]
+            sids.append(svc.open_session(_img(rng, *bucket)))
+            for sid in sids[-2:]:
+                try:
+                    svc.decode_si(streams[bucket], sid)
+                except (SessionExpired, SessionError):
+                    expired_hits += 1   # evicted or cross-bucket: typed
+            svc.encode(_img(rng, 10, 20))          # non-SI rides along
+            svc.decode(streams[BUCKETS[0]])
+    assert svc.metrics.counter("serve_session_evictions").value > 0
+
+
+def test_si_door_expiry_and_bucket_mismatch_typed(si_service):
+    svc = si_service
+    rng = np.random.default_rng(2)
+    res = svc.encode(_img(rng, 14, 20))
+    with pytest.raises(SessionExpired):
+        svc.submit_decode_si(res.stream, "never-opened")
+    sid_big = svc.open_session(_img(rng, 32, 48))   # other bucket
+    with pytest.raises(SessionError, match="does not match session"):
+        svc.submit_decode_si(res.stream, sid_big)
+
+
+def test_si_disabled_service_refuses_typed(tiny_cfg_files):
+    svc = CompressionService(
+        _si_config(tiny_cfg_files, enable_si=False, buckets=((16, 24),),
+                   workers=1)).start()
+    try:
+        with pytest.raises(SessionError, match="enable_si"):
+            svc.open_session(np.zeros((16, 24, 3), np.uint8))
+        with pytest.raises(SessionError, match="enable_si"):
+            svc.submit_decode_si(b"", "sid")
+    finally:
+        svc.drain()
+
+
+def test_si_rejects_multi_device_and_indivisible_buckets(tiny_cfg_files):
+    with pytest.raises(ValueError, match="single device"):
+        CompressionService(
+            _si_config(tiny_cfg_files, devices=2)).start()
+    with pytest.raises(ValueError, match="divisible"):
+        CompressionService(
+            _si_config(tiny_cfg_files, buckets=((16, 16),))).start()
+
+
+def test_si_ttl_expiry_at_door(tiny_cfg_files):
+    svc = CompressionService(
+        _si_config(tiny_cfg_files, buckets=((16, 24),),
+                   session_ttl_s=0.1)).start()
+    svc.warmup()
+    try:
+        rng = np.random.default_rng(3)
+        sid = svc.open_session(_img(rng, 16, 24))
+        res = svc.encode(_img(rng, 16, 24))
+        assert svc.decode_si(res.stream, sid).shape == (16, 24, 3)
+        time.sleep(0.25)
+        with pytest.raises(SessionExpired, match="TTL"):
+            svc.submit_decode_si(res.stream, sid)
+    finally:
+        svc.drain()
+
+
+def test_si_expire_mid_batch_fails_futures_typed(tiny_cfg_files):
+    """A session valid at the door but TTL-dead by batch start fails the
+    batch's futures with SessionExpired — never a hang, never untyped
+    (the chaos battery soaks the same window under load)."""
+    svc = CompressionService(
+        _si_config(tiny_cfg_files, buckets=((16, 24),), max_batch=4,
+                   max_wait_ms=400.0, session_ttl_s=0.15)).start()
+    svc.warmup()
+    try:
+        rng = np.random.default_rng(4)
+        # encode FIRST: the 400ms coalesce window applies to the encode
+        # batch too, and it must not eat the session's TTL at the door
+        res = svc.encode(_img(rng, 16, 24))
+        sid = svc.open_session(_img(rng, 16, 24))
+        # two requests pass the door, then sit coalescing for ~400ms —
+        # past the 150ms TTL — before the worker starts the batch
+        futs = [svc.submit_decode_si(res.stream, sid) for _ in range(2)]
+        for f in futs:
+            with pytest.raises(SessionExpired):
+                f.result(timeout=10)
+    finally:
+        svc.drain()
+
+
+@pytest.mark.slow
+def test_si_sessions_invalidated_by_hot_swap(tiny_cfg_files, tmp_path):
+    """Sessions are model-versioned: a committed swap (here: to a
+    checkpoint of the SAME params — the cheapest version bump) clears
+    the store and decode_si answers SessionExpired until re-open."""
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    svc = CompressionService(
+        _si_config(tiny_cfg_files, buckets=((16, 24),))).start()
+    svc.warmup()
+    try:
+        rng = np.random.default_rng(5)
+        sid = svc.open_session(_img(rng, 16, 24))
+        res = svc.encode(_img(rng, 16, 24))
+        assert svc.decode_si(res.stream, sid).shape == (16, 24, 3)
+        ckpt = str(tmp_path / "ckpt_same")
+        ckpt_lib.save_checkpoint(ckpt, svc.state, manifest_extra={
+            "pc_config_sha256": ckpt_lib.config_sha256(
+                svc.model.pc_config),
+            "seed": 0,
+            "buckets": [list(b) for b in svc.policy.buckets]})
+        svc.swap_model(ckpt)
+        with pytest.raises(SessionExpired):
+            svc.submit_decode_si(res.stream, sid)
+        sid2 = svc.open_session(_img(rng, 16, 24))
+        assert svc.decode_si(res.stream, sid2).shape == (16, 24, 3)
+    finally:
+        svc.drain()
+
+
+# -- router session pinning (fake replicas) -----------------------------------
+
+class _SessionFakes:
+    """In-process fake replicas speaking the session half of the pipe
+    protocol (mirrors test_serve_router's _Fakes: poll loop, clean EOF
+    on kill)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.child_conns = {}
+        self.threads = {}
+        self.dead = {i: threading.Event() for i in range(n)}
+        self.opened = {i: [] for i in range(n)}
+        self.decoded = {i: [] for i in range(n)}
+        self.closed = {i: [] for i in range(n)}
+
+    def launcher(self, config, idx, ctx):
+        parent, child = multiprocessing.Pipe(duplex=True)
+        self.child_conns[idx] = child
+        t = threading.Thread(target=self._run, args=(idx, child),
+                             name=f"fake-si-replica-{idx}", daemon=True)
+        self.threads[idx] = t
+        t.start()
+        return None, parent
+
+    def _run(self, idx, conn):
+        conn.send(("ready", idx, {"replica": idx, "pid": 0,
+                                  "healthz_port": None,
+                                  "params_digest": "d0"}))
+        n_sids = 0
+        while not self.dead[idx].is_set():
+            try:
+                if not conn.poll(0.02):
+                    continue
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg[0] == "stop":
+                try:
+                    conn.send(("bye", idx, None))
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            op, rid, payload, priority, deadline_ms = msg
+            if op == "session_open":
+                n_sids += 1
+                sid = f"r{idx}-s{n_sids}"
+                self.opened[idx].append(sid)
+                conn.send(("ok", rid, sid))
+            elif op == "session_close":
+                self.closed[idx].append(payload)
+                conn.send(("ok", rid, True))
+            elif op == "decode_si":
+                self.decoded[idx].append(payload[1])
+                conn.send(("ok", rid, ("img", idx, payload[1])))
+            else:
+                conn.send(("ok", rid, ("echo", idx, op)))
+        conn.close()
+
+    def kill(self, idx):
+        self.dead[idx].set()
+        self.threads[idx].join(timeout=5)
+
+
+def _si_router(fakes, replicas=2, **kw):
+    cfg = ServiceConfig(ae_config="unused", pc_config="unused",
+                        max_queue=8,
+                        priority_classes=default_priority_classes(8))
+    kw.setdefault("poll_every_s", 5.0)
+    return FrontDoorRouter(cfg, replicas=replicas,
+                           launcher=fakes.launcher, **kw)
+
+
+def test_router_pins_sessions_and_routes_affine():
+    fakes = _SessionFakes(2)
+    r = _si_router(fakes).start()
+    try:
+        s_a = r.open_session(np.zeros((4, 4, 3)))     # rr -> replica 0
+        s_b = r.open_session(np.zeros((4, 4, 3)))     # rr -> replica 1
+        assert s_a.startswith("r0") and s_b.startswith("r1")
+        for _ in range(3):
+            assert r.decode_si(b"blob", s_a)[1] == 0
+        assert r.decode_si(b"blob", s_b)[1] == 1
+        assert fakes.decoded[0] == [s_a] * 3
+        assert fakes.decoded[1] == [s_b]
+        assert r.close_session(s_a) is True
+        assert fakes.closed[0] == [s_a]
+        with pytest.raises(SessionExpired):
+            r.submit_decode_si(b"blob", s_a)
+    finally:
+        r.drain()
+
+
+def test_router_replica_death_expires_its_sessions_typed():
+    fakes = _SessionFakes(2)
+    r = _si_router(fakes).start()
+    try:
+        s_a = r.open_session(np.zeros((4, 4, 3)))     # replica 0
+        s_b = r.open_session(np.zeros((4, 4, 3)))     # replica 1
+        fakes.kill(0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if r.health()["replicas"]["0"] == "dead":
+                break
+            time.sleep(0.02)
+        # pin dropped: the door answers typed, no hung slot
+        with pytest.raises(SessionExpired, match="re-open"):
+            r.submit_decode_si(b"blob", s_a)
+        assert r.metrics.counter(
+            "serve_router_session_orphans").value == 1
+        # the surviving replica's session still serves, and new opens
+        # land on it
+        assert r.decode_si(b"blob", s_b)[1] == 1
+        s_c = r.open_session(np.zeros((4, 4, 3)))
+        assert s_c.startswith("r1")
+        assert r.decode_si(b"blob", s_c)[1] == 1
+    finally:
+        r.drain()
+
+
+def test_router_death_midflight_si_futures_resolve_typed_once():
+    """SI requests in flight on a dying replica resolve exactly once,
+    typed SessionExpired (never rerouted — no other replica holds the
+    prep)."""
+    fakes = _SessionFakes(2)
+    r = _si_router(fakes).start()
+    try:
+        s_a = r.open_session(np.zeros((4, 4, 3)))
+        rep = r._replicas[0]
+        # park a pending decode_si in the in-flight map without letting
+        # the fake answer: enqueue directly, then kill
+        from dsin_tpu.serve.router import _Pending
+        pending = _Pending("decode_si", (b"blob", s_a), "interactive",
+                           None, 0)
+        with rep.lock:
+            rep.inflight[999999] = pending
+        fakes.kill(0)
+        exc = pending.future.exception(timeout=5)
+        assert isinstance(exc, SessionExpired)
+    finally:
+        r.drain()

@@ -1,0 +1,176 @@
+"""SidePrep (ISSUE 10): cached-vs-scratch search bit-identity.
+
+The serving session cache (serve/session.py) reuses one SidePrep across
+every request of a session, so the whole contract is that a search run
+against a cached prep emits EXACTLY the bytes the from-scratch call
+would — on the XLA materialized path, the tiled scan, and the fused
+Pallas kernel (interpreter on CPU). Fuzzes over several bucket-like
+geometries, with and without the Gaussian prior, plus the L2+LAB mode.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dsin_tpu.config import parse_config
+from dsin_tpu.ops import sifinder as sf
+from dsin_tpu.ops import sifinder_pallas as sfp
+
+PH, PW = 8, 12
+#: bucket-like geometries (edges divisible by the patch, like the serve
+#: bucket contract) of varying map widths/heights
+GEOMETRIES = [(16, 24), (24, 36), (32, 48), (40, 96)]
+
+
+def _pair(h, w, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+    y = np.clip(x[::-1] * 0.6 + rng.uniform(0, 255, x.shape) * 0.4,
+                0, 255).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("h,w", GEOMETRIES)
+@pytest.mark.parametrize("use_prior", [True, False])
+def test_cached_prep_bit_identical_xla(h, w, use_prior):
+    x, y = _pair(h, w, seed=h + w)
+    factors = (sf.gaussian_position_mask_factors(h, w, PH, PW)
+               if use_prior else None)
+    mask = (jnp.asarray(sf.gaussian_position_mask(h, w, PH, PW))
+            if use_prior else None)
+    prep = sf.build_side_prep(y, y, PH, PW, mask_factors=factors)
+
+    scratch = sf.search_single(x, y, y, mask, PH, PW, use_l2=False)
+    cached = sf.search_single(x, None, None, None, PH, PW, use_l2=False,
+                              prep=prep)
+    np.testing.assert_array_equal(np.asarray(cached.best_flat),
+                                  np.asarray(scratch.best_flat))
+    np.testing.assert_array_equal(np.asarray(cached.y_syn),
+                                  np.asarray(scratch.y_syn))
+    np.testing.assert_array_equal(np.asarray(cached.score_map),
+                                  np.asarray(scratch.score_map))
+
+
+@pytest.mark.parametrize("h,w", GEOMETRIES)
+def test_cached_prep_bit_identical_tiled(h, w):
+    x, y = _pair(h, w, seed=2 * h + w)
+    factors = sf.gaussian_position_mask_factors(h, w, PH, PW)
+    prep = sf.build_side_prep(y, y, PH, PW, mask_factors=factors)
+
+    scratch = sf.search_single_tiled(x, y, y, PH, PW,
+                                     mask_factors=factors, row_chunk=8)
+    cached = sf.search_single_tiled(x, None, None, PH, PW, row_chunk=8,
+                                    prep=prep)
+    np.testing.assert_array_equal(np.asarray(cached.best_flat),
+                                  np.asarray(scratch.best_flat))
+    np.testing.assert_array_equal(np.asarray(cached.y_syn),
+                                  np.asarray(scratch.y_syn))
+
+
+@pytest.mark.parametrize("h,w", GEOMETRIES)
+def test_tiled_prep_matches_materialized_prep(h, w):
+    """Cross-path: the tiled scan against a prep must still equal the
+    materialized search against the SAME prep (the PR-6 exactness
+    contract survives the prep refactor)."""
+    x, y = _pair(h, w, seed=3 * h + w)
+    factors = sf.gaussian_position_mask_factors(h, w, PH, PW)
+    prep = sf.build_side_prep(y, y, PH, PW, mask_factors=factors)
+    a = sf.search_single(x, None, None, None, PH, PW, use_l2=False,
+                         prep=prep)
+    b = sf.search_single_tiled(x, None, None, PH, PW, row_chunk=8,
+                               prep=prep)
+    np.testing.assert_array_equal(np.asarray(a.best_flat),
+                                  np.asarray(b.best_flat))
+    np.testing.assert_array_equal(np.asarray(a.y_syn), np.asarray(b.y_syn))
+
+
+def test_cached_prep_bit_identical_l2_lab():
+    h, w = 24, 36
+    x, y = _pair(h, w, seed=9)
+    mask = jnp.asarray(sf.gaussian_position_mask(h, w, PH, PW))
+    prep = sf.build_side_prep(y, y, PH, PW, use_l2=True)
+    scratch = sf.search_single(x, y, y, mask, PH, PW, use_l2=True)
+    cached = sf.search_single(x, None, None, mask, PH, PW, use_l2=True,
+                              prep=prep)
+    np.testing.assert_array_equal(np.asarray(cached.best_flat),
+                                  np.asarray(scratch.best_flat))
+    np.testing.assert_array_equal(np.asarray(cached.y_syn),
+                                  np.asarray(scratch.y_syn))
+
+
+@pytest.mark.parametrize("h,w", [(16, 24), (24, 36)])
+@pytest.mark.parametrize("use_prior", [True, False])
+def test_cached_prep_bit_identical_pallas(h, w, use_prior):
+    """Fused-kernel path (interpreter on CPU): the shared-side prepped
+    entry vs the per-image scratch entry with identical y replicated —
+    same kernel body and blocks, so outputs must be bit-identical."""
+    rng = np.random.default_rng(h * w)
+    x = jnp.asarray(rng.uniform(0, 255, (2, h, w, 3)).astype(np.float32))
+    y1 = jnp.asarray(rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+    y = jnp.stack([y1, y1])
+    hc, wc = h - PH + 1, w - PW + 1
+    p_count = (h // PH) * (w // PW)
+    if use_prior:
+        gh, gw = sf.gaussian_position_mask_factors(h, w, PH, PW)
+        factors = (gh, gw)
+    else:
+        gh = np.ones((hc, p_count), np.float32)
+        gw = np.ones((wc, p_count), np.float32)
+        factors = None
+
+    scratch = sfp.fused_synthesize_side_image(
+        x, y, y, jnp.asarray(gh), jnp.asarray(gw), PH, PW,
+        compute_dtype=jnp.float32, interpret=True)
+
+    prep = sf.build_side_prep(y1, y1, PH, PW, mask_factors=factors,
+                              for_pallas=True)
+    assert prep.y_t_pad is not None and prep.inv_denom_pad is not None
+    cfg = parse_config("""
+        use_L2andLAB = False
+        sifinder_impl = 'pallas_interpret'
+        sifinder_dtype = 'float32'
+    """)
+    cached = sf.synthesize_side_image_prepped(x, prep, PH, PW, cfg)
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(scratch))
+
+
+def test_prepped_dispatch_xla_matches_legacy_dispatch():
+    """synthesize_side_image_prepped('xla') == synthesize_side_image
+    ('xla') with the combined mask — the serve SI executable's search
+    equals the training-path search byte for byte."""
+    h, w = 32, 48
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.uniform(0, 255, (2, h, w, 3)).astype(np.float32))
+    y1 = jnp.asarray(rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+    y = jnp.stack([y1, y1])
+    mask = jnp.asarray(sf.gaussian_position_mask(h, w, PH, PW))
+    factors = sf.gaussian_position_mask_factors(h, w, PH, PW)
+    cfg = parse_config("use_L2andLAB = False\nsifinder_impl = 'xla'\n")
+
+    legacy = sf.synthesize_side_image(x, y, y, mask, PH, PW, cfg)
+    prep = sf.build_side_prep(y1, y1, PH, PW, mask_factors=factors)
+    prepped = sf.synthesize_side_image_prepped(x, prep, PH, PW, cfg)
+    np.testing.assert_array_equal(np.asarray(prepped), np.asarray(legacy))
+
+    # tiled dispatch against the same prep agrees too
+    cfg_t = parse_config(
+        "use_L2andLAB = False\nsifinder_impl = 'xla_tiled'\n"
+        "sifinder_row_chunk = 8\n")
+    tiled = sf.synthesize_side_image_prepped(x, prep, PH, PW, cfg_t)
+    np.testing.assert_array_equal(np.asarray(tiled), np.asarray(legacy))
+
+
+def test_prep_prior_factors_refuse_double_mask():
+    x, y = _pair(16, 24, seed=5)
+    factors = sf.gaussian_position_mask_factors(16, 24, PH, PW)
+    prep = sf.build_side_prep(y, y, PH, PW, mask_factors=factors)
+    mask = jnp.asarray(sf.gaussian_position_mask(16, 24, PH, PW))
+    with pytest.raises(AssertionError, match="not both"):
+        sf.search_single(x, None, None, mask, PH, PW, use_l2=False,
+                         prep=prep)
+
+
+def test_pallas_prep_refuses_l2():
+    _, y = _pair(16, 24, seed=6)
+    with pytest.raises(ValueError, match="Pearson-only"):
+        sf.build_side_prep(y, y, PH, PW, use_l2=True, for_pallas=True)

@@ -213,6 +213,28 @@ def test_serve_bench_smoke_json_contract(tmp_path):
     # replica axis stays OUT of the tier-1 smoke (it spawns processes;
     # the frontdoor-bench tpu_session.sh stage owns it)
     assert "replicas" not in report["frontdoor"]
+    # ISSUE 10: the session-cached SI axis rides the smoke run — the
+    # bench itself exits 1 unless the warm-session speedup clears its
+    # floor (host-weather escape), sessions churn with zero compiles,
+    # and every churn decode resolves ok or typed; re-pin the artifact
+    # shape so a silent gate removal cannot pass
+    si = report["si"]
+    assert si["warm"]["failed"] == 0
+    assert si["per_request_prep"]["failed"] == 0
+    assert si["warm"]["latency_ms"]["count"] > 0
+    assert si["per_request_prep"]["latency_ms"]["p50"] > 0
+    assert si["steady_compiles"] == 0, (
+        "session create/evict churn recompiled — the SI executables "
+        "are not shape-keyed")
+    assert len(si["pair_speedups"]) == si["repeats"]
+    assert si["speedup"] >= 0.9, (
+        "warm-session SI decode in the broken band vs per-request "
+        "prep: " f"{si}")
+    assert si["churn"]["evictions"] > 0
+    assert si["churn"]["untyped"] == 0
+    assert si["churn"]["decodes_ok"] > 0
+    assert si["prep_ms"]["count"] > 0
+    assert si["search_ms"]["count"] > 0
 
 
 @pytest.mark.chaos
@@ -269,6 +291,30 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert hs["replication"]["files"] > 0
     assert hs["swap_counters"]["serve_swaps"] >= 1
     assert hs["swap_counters"]["serve_rollbacks"] >= 1
+    # ISSUE 10: the side-information session battery rides every chaos
+    # run — pin its scenario shape so a silent removal cannot pass
+    se = report["sessions"]
+    assert se["violations"] == []
+    ssc = se["scenarios"]
+    ev = ssc["evict_under_load"]
+    assert ev["evictions"] > 0
+    assert ev["hung_futures"] == 0 and ev["untyped_errors"] == 0
+    assert ev["completed_ok"] > 0
+    sf = ssc["session_fault"]
+    assert sf["door_typed"] is True and sf["mid_batch_typed"] is True
+    assert sf["clean_after"] is True and sf["fired"] >= 2
+    em = ssc["expire_mid_batch"]
+    assert em["expired_typed"] == em["submitted"] > 0
+    assert em["hung_futures"] == 0
+    assert em["fresh_session_after"] is True
+    rd = ssc["replica_death"]
+    assert rd["hung_futures"] == 0 and rd["untyped_errors"] == 0
+    assert rd["door_expired_after_death"] is True
+    assert rd["survivor_serves"] is True
+    assert rd["new_session_after_death"] is True
+    assert rd["session_orphans"] >= 1
+    assert se["steady_compiles"] == 0
+    assert se["lock_order_inversions"] == 0
 
 
 def test_cache_dir_keyed_by_host_fingerprint(monkeypatch, tmp_path):

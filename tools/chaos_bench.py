@@ -52,6 +52,22 @@ after every abort, and zero steady-state compiles across swap +
 rollback. `--hotswap_only` runs just this battery (the fail-fast
 `hotswap-chaos` tpu_session.sh stage).
 
+Session battery (ISSUE 10): every run also soaks the side-information
+SESSION dataplane (serve/session.py) — (1) evict-under-load: sessions
+opened past session_max while decode_si load is in flight against
+older ones (every future resolves ok or typed SessionExpired; LRU
+evictions actually fire); (2) expire-mid-batch: a session valid at the
+door TTL-expires while its requests coalesce, and the batch fails
+typed, never hung; (3) `serve.session` fault injection at the lookup
+site, both at the door and at batch start; (4) replica-death with live
+sessions through the session-pinning FrontDoorRouter (in-process
+thread replicas running REAL services): the dead replica's sessions
+answer typed SessionExpired — futures resolve exactly once, pins are
+dropped (no hung session slots), the survivor keeps serving and
+adopts new sessions. Zero steady-state compiles across all of it.
+`--sessions_only` runs just this battery (the `si-bench` stage pairs
+it with serve_bench --si_only).
+
 Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
 (tests/test_tools_smoke.py) and the `chaos-smoke` stage of
 tools/tpu_session.sh.
@@ -569,6 +585,368 @@ def run_hotswap(args) -> dict:
     }
 
 
+class _ThreadReplicas:
+    """FrontDoorRouter launcher whose replicas are in-process THREADS
+    running REAL CompressionServices and speaking the pipe protocol —
+    the tier-1-affordable stand-in for spawn replicas (the convention:
+    real spawn stays out of tier-1, serve_bench.py). `kill(idx)` makes
+    the replica close its own pipe end on its own thread WITHOUT
+    draining its in-flight SI work — the router's reader sees the same
+    EOF a process crash produces while requests are still outstanding,
+    which is exactly the death the session-pinning contract is about."""
+
+    def __init__(self, make_config):
+        import multiprocessing
+        self._mp = multiprocessing
+        self._make_config = make_config
+        self.dead = {}
+        self.threads = {}
+        self.services = {}
+
+    def launcher(self, config, idx, ctx):
+        import threading
+        parent, child = self._mp.Pipe(duplex=True)
+        self.dead[idx] = threading.Event()
+        t = threading.Thread(target=self._run, args=(idx, child),
+                             name=f"chaos-si-replica-{idx}", daemon=True)
+        self.threads[idx] = t
+        t.start()
+        return None, parent
+
+    def _run(self, idx, conn):
+        import queue
+        import threading
+        from dsin_tpu.serve.router import _picklable_exc
+        from dsin_tpu.serve.service import CompressionService
+        try:
+            service = CompressionService(self._make_config()).start()
+            service.warmup()
+        except BaseException as e:  # noqa: BLE001 — router needs the cause
+            conn.send(("failed", idx, _picklable_exc(e)))
+            conn.close()
+            return
+        self.services[idx] = service
+        outq = queue.Queue()
+
+        def _sender():
+            while True:
+                item = outq.get()
+                if item is None:
+                    return
+                try:
+                    conn.send(item)
+                except (OSError, ValueError, BrokenPipeError):
+                    return
+
+        sender = threading.Thread(target=_sender, daemon=True,
+                                  name=f"chaos-si-send-{idx}")
+        sender.start()
+        outq.put(("ready", idx, {
+            "replica": idx, "pid": os.getpid(), "healthz_port": None,
+            "params_digest": service.model_digest}))
+        dead = self.dead[idx]
+        while not dead.is_set():
+            try:
+                if not conn.poll(0.02):
+                    continue
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            if msg[0] == "stop":
+                break
+            op, rid, payload, priority, deadline_ms = msg
+            try:
+                if op == "session_open":
+                    outq.put(("ok", rid, service.open_session(payload)))
+                    continue
+                if op == "session_close":
+                    outq.put(("ok", rid,
+                              service.close_session(payload)))
+                    continue
+                if op == "encode":
+                    fut = service.submit_encode(payload,
+                                                deadline_ms=deadline_ms,
+                                                priority=priority)
+                elif op == "decode_si":
+                    fut = service.submit_decode_si(
+                        payload[0], payload[1], deadline_ms=deadline_ms,
+                        priority=priority)
+                else:
+                    fut = service.submit_decode(payload,
+                                                deadline_ms=deadline_ms,
+                                                priority=priority)
+            except BaseException as e:  # noqa: BLE001 — typed rejects
+                outq.put(("err", rid, _picklable_exc(e)))
+                continue
+
+            def _complete(rid_, fut_):
+                exc = fut_.exception(timeout=0)
+                if exc is None:
+                    outq.put(("ok", rid_, fut_.result(timeout=0)))
+                else:
+                    outq.put(("err", rid_, _picklable_exc(exc)))
+
+            fut.add_done_callback(
+                lambda f, rid_=rid: _complete(rid_, f))
+        # HARD death (kill): close the pipe with work possibly still in
+        # flight — the router must type those futures, not this replica.
+        # Graceful stop drains first.
+        if not dead.is_set():
+            service.drain()
+        outq.put(None)
+        sender.join(timeout=10)
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if dead.is_set():
+            service.drain()
+
+    def kill(self, idx):
+        self.dead[idx].set()
+        self.threads[idx].join(timeout=60)
+
+
+def run_sessions(args) -> dict:
+    """The side-information session battery (see module docstring)."""
+    from dsin_tpu.serve import (CompressionService, ServiceConfig,
+                                SessionExpired)
+    from dsin_tpu.serve.router import FrontDoorRouter
+    from dsin_tpu.serve.session import SessionError
+    from dsin_tpu.utils import faults, locks
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    from tools.serve_bench import _parse_shapes
+
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled — the session soak needs them"
+
+    # the SI dataplane needs bucket edges divisible by the configs'
+    # y_patch_size (8, 12) — the chaos ladder (24,32 / 32,48) is not, so
+    # the battery runs its own divisible ladder (both the smoke and the
+    # ae_synthetic_micro configs use (8, 12) patches)
+    buckets = [(16, 24), (32, 48)]
+    base = dict(
+        ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
+        seed=args.seed, buckets=buckets, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        workers=args.workers, entropy_workers=args.entropy_workers,
+        entropy_backend=args.entropy_backend,
+        pipeline_depth=args.pipeline_depth, enable_si=True)
+    rng = np.random.default_rng(args.seed + 11)
+    sides = {tuple(b): rng.integers(0, 255, (b[0], b[1], 3),
+                                    dtype=np.uint8) for b in buckets}
+    violations = []
+    scenarios = {}
+    inversions_before = locks.inversion_count()
+    t0 = time.monotonic()
+
+    # -- service A: evict-under-load + serve.session faults ------------------
+    svc = CompressionService(ServiceConfig(**base, session_max=2)).start()
+    warm = svc.warmup()
+    with CompilationSentinel(budget=0, label="session steady state",
+                             raise_on_exceed=False) as sentinel:
+        bucket = tuple(buckets[0])
+        stream = svc.encode(sides[bucket], timeout=args.timeout_s).stream
+
+        # (1) evict-under-load: open past session_max while decode_si
+        # load is IN FLIGHT against older sessions
+        futures, door_expired = [], 0
+        sids = []
+        for k in range(6):
+            sids.append(svc.open_session(sides[bucket]))
+            for sid in sids:
+                try:
+                    futures.append(svc.submit_decode_si(stream, sid))
+                except (SessionExpired, SessionError):
+                    door_expired += 1
+        counts, hung = _await_all(futures, args.timeout_s)
+        evictions = svc.metrics.counter("serve_session_evictions").value
+        if hung:
+            violations.append(f"evict_under_load: {hung} hung futures")
+        if counts["untyped"]:
+            violations.append(f"evict_under_load: {counts['untyped']} "
+                              f"untyped errors")
+        if evictions == 0:
+            violations.append("evict_under_load: no eviction fired "
+                              "(vacuous — session_max never engaged)")
+        scenarios["evict_under_load"] = {
+            "opened": len(sids), "submitted": len(futures),
+            "door_expired": door_expired, "completed_ok": counts["ok"],
+            "typed_errors": counts["typed"], "hung_futures": hung,
+            "untyped_errors": counts["untyped"], "evictions": evictions,
+        }
+
+        # (2) serve.session fault at the DOOR (visit 1 = submit's get)
+        plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.session", action="raise", times=1)],
+            seed=args.seed)
+        door_typed = False
+        with faults.installed(plan):
+            try:
+                svc.submit_decode_si(stream, sids[-1])
+            except faults.InjectedFault:
+                door_typed = True
+        # (3) serve.session fault MID-BATCH (door passes, the worker's
+        # batch-start lookup fires) — the future must fail typed
+        plan2 = faults.FaultPlan([faults.FaultSpec(
+            site="serve.session", action="raise", after=1, times=1)],
+            seed=args.seed)
+        mid_typed = False
+        with faults.installed(plan2):
+            f = svc.submit_decode_si(stream, sids[-1])
+            exc = f.exception(timeout=args.timeout_s)
+            mid_typed = isinstance(exc, faults.InjectedFault)
+        if not (door_typed and mid_typed):
+            violations.append(
+                f"session_fault: injected serve.session faults not "
+                f"answered typed (door={door_typed}, mid={mid_typed})")
+        # the service still serves SI cleanly after the faults
+        clean = svc.decode_si(stream, sids[-1], timeout=args.timeout_s)
+        scenarios["session_fault"] = {
+            "door_typed": door_typed, "mid_batch_typed": mid_typed,
+            "clean_after": bool(clean.ndim == 3),
+            "fired": plan.activations["serve.session"]
+            + plan2.activations["serve.session"],
+        }
+    steady_compiles = sentinel.compilations
+    if sentinel.compilations:
+        violations.append(f"session battery: {sentinel.compilations} "
+                          f"steady-state compiles under churn")
+    svc.drain()
+
+    # -- service B: TTL expire-mid-batch -------------------------------------
+    svc_b = CompressionService(ServiceConfig(
+        **{**base, "max_wait_ms": 400.0, "max_batch": 4},
+        session_max=4, session_ttl_s=0.15)).start()
+    svc_b.warmup()
+    # the sentinel excludes warmup (which compiles by design) but must
+    # cover THIS service's traffic too: the TTL-expiry path is part of
+    # the battery's zero-steady-compile claim
+    with CompilationSentinel(budget=0, label="session ttl steady state",
+                             raise_on_exceed=False) as sentinel_b:
+        bucket = tuple(buckets[0])
+        stream_b = svc_b.encode(sides[bucket],
+                                timeout=args.timeout_s).stream
+        sid = svc_b.open_session(sides[bucket])
+        futs = [svc_b.submit_decode_si(stream_b, sid) for _ in range(2)]
+        expired_typed = 0
+        hung_b = untyped_b = 0
+        for f in futs:
+            try:
+                exc = f.exception(timeout=args.timeout_s)
+            except TimeoutError:
+                hung_b += 1
+                continue
+            if isinstance(exc, SessionExpired):
+                expired_typed += 1
+            elif exc is not None:
+                untyped_b += 1
+        if expired_typed != len(futs) or hung_b or untyped_b:
+            violations.append(
+                f"expire_mid_batch: {expired_typed}/{len(futs)} typed "
+                f"SessionExpired, {hung_b} hung, {untyped_b} other")
+        # a fresh session serves after the expiry (a FULL batch: this
+        # config's 400ms coalesce window exceeds the 150ms TTL, so only
+        # a batch that fills — and therefore pops — immediately can
+        # beat it)
+        sid2 = svc_b.open_session(sides[bucket])
+        futs_after = [svc_b.submit_decode_si(stream_b, sid2)
+                      for _ in range(4)]
+        ok_after = all(f.exception(timeout=args.timeout_s) is None
+                       for f in futs_after)
+    steady_compiles += sentinel_b.compilations
+    if sentinel_b.compilations:
+        violations.append(f"expire_mid_batch: {sentinel_b.compilations} "
+                          f"steady-state compiles")
+    scenarios["expire_mid_batch"] = {
+        "submitted": len(futs), "expired_typed": expired_typed,
+        "hung_futures": hung_b, "untyped_errors": untyped_b,
+        "fresh_session_after": ok_after,
+    }
+    svc_b.drain()
+
+    # -- replica-death with live sessions (session-pinning router) -----------
+    reps = _ThreadReplicas(lambda: ServiceConfig(**base, session_max=4))
+    router = FrontDoorRouter(ServiceConfig(**base, session_max=4),
+                             replicas=2, launcher=reps.launcher,
+                             poll_every_s=30.0).start()
+    # replicas warmed inside start(); everything after is steady state
+    sentinel_r = CompilationSentinel(budget=0,
+                                     label="session router steady state",
+                                     raise_on_exceed=False)
+    sentinel_r.__enter__()
+    try:
+        bucket = tuple(buckets[0])
+        stream_r = router.encode(sides[bucket],
+                                 timeout=args.timeout_s).stream
+        sid_a = router.open_session(sides[bucket])   # rr -> replica 0
+        sid_b = router.open_session(sides[bucket])   # rr -> replica 1
+        pin_a = router._sessions[sid_a]
+        in_flight = [router.submit_decode_si(stream_r, sid_a)
+                     for _ in range(8)]
+        reps.kill(pin_a)
+        counts_r, hung_r = _await_all(in_flight, args.timeout_s)
+        # the pin must be gone: the door answers typed immediately
+        door_after = False
+        try:
+            router.submit_decode_si(stream_r, sid_a)
+        except SessionExpired:
+            door_after = True
+        survivor_ok = router.decode_si(
+            stream_r, sid_b, timeout=args.timeout_s).ndim == 3
+        sid_c = router.open_session(sides[bucket])
+        new_open_ok = router.decode_si(
+            stream_r, sid_c, timeout=args.timeout_s).ndim == 3
+        orphans = router.metrics.counter(
+            "serve_router_session_orphans").value
+        if hung_r:
+            violations.append(f"replica_death: {hung_r} hung SI futures")
+        if counts_r["untyped"]:
+            violations.append(f"replica_death: {counts_r['untyped']} "
+                              f"untyped errors")
+        if not door_after:
+            violations.append("replica_death: dead replica's session "
+                              "still pinned (door did not expire typed)")
+        if not (survivor_ok and new_open_ok):
+            violations.append("replica_death: the surviving replica "
+                              "stopped serving sessions")
+        if orphans < 1:
+            violations.append("replica_death: no session orphan was "
+                              "recorded (pin table not cleaned)")
+        scenarios["replica_death"] = {
+            "in_flight": len(in_flight),
+            "completed_ok": counts_r["ok"],
+            "typed_errors": counts_r["typed"],
+            "untyped_errors": counts_r["untyped"],
+            "hung_futures": hung_r,
+            "door_expired_after_death": door_after,
+            "survivor_serves": survivor_ok,
+            "new_session_after_death": new_open_ok,
+            "session_orphans": orphans,
+        }
+    finally:
+        router.drain()
+        sentinel_r.__exit__(None, None, None)
+    steady_compiles += sentinel_r.compilations
+    if sentinel_r.compilations:
+        violations.append(f"replica_death: {sentinel_r.compilations} "
+                          f"steady-state compiles")
+
+    session_inversions = locks.inversion_count() - inversions_before
+    if session_inversions:
+        violations.append(f"{session_inversions} lock-order inversions "
+                          f"during the session battery")
+    return {
+        "warmup": warm,
+        "scenarios": scenarios,
+        "steady_compiles": steady_compiles,
+        "lock_order_inversions": session_inversions,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="seeded chaos soak for dsin_tpu/serve")
@@ -619,6 +997,12 @@ def main(argv=None) -> int:
                         "(kill-during-swap, corrupt manifest, swap "
                         "under load, rollback) — the fail-fast "
                         "hotswap-chaos tpu_session.sh stage")
+    p.add_argument("--sessions_only", action="store_true",
+                   help="run ONLY the side-information session battery "
+                        "(evict-under-load, expire-mid-batch, "
+                        "serve.session faults, replica-death with live "
+                        "sessions) — rides the fail-fast si-bench "
+                        "tpu_session.sh stage")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -639,25 +1023,36 @@ def main(argv=None) -> int:
         report = {"config": {"smoke": args.smoke, "seed": args.seed},
                   "hotswap": run_hotswap(args),
                   "violations": []}
+    elif args.sessions_only:
+        report = {"config": {"smoke": args.smoke, "seed": args.seed},
+                  "sessions": run_sessions(args),
+                  "violations": []}
     else:
         report = run_chaos(args)
         report["hotswap"] = run_hotswap(args)
-    # the hotswap battery's violations gate the exit code like the
-    # soak's own
-    report["violations"] = (report["violations"]
-                            + report["hotswap"]["violations"])
+        report["sessions"] = run_sessions(args)
+    # every battery's violations gate the exit code like the soak's own
+    for extra in ("hotswap", "sessions"):
+        if extra in report:
+            report["violations"] = (report["violations"]
+                                    + report[extra]["violations"])
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
     summary_keys = ("load", "supervision", "integrity", "invariants",
                     "lock_discipline", "steady_compiles")
-    print(json.dumps(
-        {**{k: report[k] for k in summary_keys if k in report},
-         "hotswap": {k: report["hotswap"][k]
-                     for k in ("scenarios", "swap_counters",
-                               "steady_compiles", "violations")},
-         "violations": report["violations"]}, indent=1))
+    summary = {k: report[k] for k in summary_keys if k in report}
+    if "hotswap" in report:
+        summary["hotswap"] = {k: report["hotswap"][k]
+                              for k in ("scenarios", "swap_counters",
+                                        "steady_compiles", "violations")}
+    if "sessions" in report:
+        summary["sessions"] = {k: report["sessions"][k]
+                               for k in ("scenarios", "steady_compiles",
+                                         "violations")}
+    summary["violations"] = report["violations"]
+    print(json.dumps(summary, indent=1))
     if report["violations"]:
         print(f"CHAOS_BENCH_FAILED: {report['violations']}",
               file=sys.stderr)

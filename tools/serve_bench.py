@@ -50,6 +50,19 @@ compiles in steady state or any device at N>1 served zero batches.
 `--devices_only` skips the serialized-vs-pipelined comparison (the
 fail-fast `serve-multidevice` tpu_session.sh stage).
 
+Session-cached SI axis (ISSUE 10): every run also drives the
+side-information dataplane through one warm SI-enabled service —
+WARM-SESSION (side image registered once, every request reuses the
+cached SidePrep) vs PER-REQUEST-PREP (open_session + decode_si +
+close_session per request, what serving SI without the cache costs) in
+alternating pass pairs, plus a CHURN leg that opens sessions past
+session_max while decoding. In --smoke mode the bench FAILS unless the
+median warm/per-request throughput ratio clears the 1.1 floor (with
+the `_effective_cores` host-weather note convention), zero requests
+fail untyped, the churn actually evicts, and ZERO steady-state
+compiles land while sessions are created/evicted. `--si_only` runs
+just this axis — the fail-fast `si-bench` tpu_session.sh stage.
+
 Emits a SERVE_BENCH.json trajectory artifact: totals (throughput,
 rejections by cause), latency quantiles, batch occupancy, compile
 counts, per-stage times, the device-scaling section, and a sampled time
@@ -140,7 +153,8 @@ def _write_smoke_cfgs(tmpdir):
 
 
 def _service_config(args, entropy_workers, devices=None,
-                    backend: str = "thread", classes=None, max_queue=None):
+                    backend: str = "thread", classes=None, max_queue=None,
+                    **extra):
     from dsin_tpu.serve import ServiceConfig
     buckets = _parse_shapes(args.buckets)
     return ServiceConfig(
@@ -150,15 +164,16 @@ def _service_config(args, entropy_workers, devices=None,
         max_queue=args.max_queue if max_queue is None else max_queue,
         workers=args.workers, entropy_workers=entropy_workers,
         entropy_backend=backend, priority_classes=classes,
-        pipeline_depth=args.pipeline_depth, devices=devices)
+        pipeline_depth=args.pipeline_depth, devices=devices, **extra)
 
 
 def _build_service(args, entropy_workers: int, devices=None,
-                   backend: str = "thread", classes=None, max_queue=None):
+                   backend: str = "thread", classes=None, max_queue=None,
+                   **extra):
     from dsin_tpu.serve import CompressionService
     cfg = _service_config(args, entropy_workers, devices=devices,
                           backend=backend, classes=classes,
-                          max_queue=max_queue)
+                          max_queue=max_queue, **extra)
     service = CompressionService(cfg).start()
     return service, service.warmup()
 
@@ -539,6 +554,209 @@ def _gate_device_axis(devices_section) -> list:
         if entry["failed"]:
             violations.append(
                 f"devices={n}: {entry['failed']} requests failed")
+    return violations
+
+
+def _lat_stats(samples_ms) -> dict:
+    if not samples_ms:
+        return {"count": 0, "mean": 0.0, "p50": 0.0, "p99": 0.0}
+    xs = sorted(samples_ms)
+    return {"count": len(xs),
+            "mean": round(sum(xs) / len(xs), 3),
+            "p50": round(xs[len(xs) // 2], 3),
+            "p99": round(xs[min(len(xs) - 1,
+                               int(round(0.99 * (len(xs) - 1))))], 3)}
+
+
+def _run_si_section(args) -> dict:
+    """Session-cached SI serving (ISSUE 10): warm-session vs
+    per-request-prep through ONE warm SI-enabled service.
+
+    * WARM mode opens one session per bucket up front; each timed
+      request is decode_si only — the dataplane the session cache buys.
+    * PER-REQUEST-PREP mode pays the y-half per request (open_session +
+      decode_si + close_session) — what serving the SI path without a
+      session cache would cost. Same stream, alternating passes per
+      repeat; `speedup` is the MEDIAN per-pair throughput ratio (the
+      PR-4 host-drift methodology), gated in --smoke with the
+      `_effective_cores` host-weather note convention.
+    * CHURN then opens sessions past session_max while decoding — the
+      acceptance pin is zero steady-state compiles while sessions are
+      created AND evicted under load, with every request resolving
+      (ok or typed SessionExpired), plus evictions > 0 (non-vacuous).
+    """
+    from dsin_tpu.serve import SessionError
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    svc, warm = _build_service(args, args.entropy_workers,
+                               enable_si=True, session_max=4)
+    shapes = _parse_shapes(args.shapes)
+    rng = np.random.default_rng(args.seed + 3)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    buckets = sorted({svc.policy.bucket_for(h, w) for h, w in shapes})
+    sides = {b: rng.integers(0, 255, (b[0], b[1], 3), dtype=np.uint8)
+             for b in buckets}
+    n = args.si_requests
+    results = {"warm": {"runs_rps": [], "lat_ms": [], "failed": 0},
+               "per_request_prep": {"runs_rps": [], "lat_ms": [],
+                                    "failed": 0}}
+    pair_cores = []
+    churn = {}
+    with CompilationSentinel(budget=0, label="si steady state",
+                             raise_on_exceed=False) as sentinel:
+        streams = {}
+        for h, w in shapes:
+            res = svc.encode(images[shapes.index((h, w))], timeout=120)
+            streams[(h, w)] = (res.stream, svc.policy.bucket_for(h, w))
+
+        def warm_pass():
+            sids = {b: svc.open_session(sides[b]) for b in buckets}
+            lat, failed = [], 0
+            t0 = time.monotonic()
+            for i in range(n):
+                stream, bucket = streams[shapes[i % len(shapes)]]
+                t1 = time.monotonic()
+                try:
+                    svc.decode_si(stream, sids[bucket], timeout=120)
+                except Exception:  # noqa: BLE001 — counted, gated below
+                    failed += 1
+                lat.append((time.monotonic() - t1) * 1e3)
+            dur = time.monotonic() - t0
+            for sid in sids.values():
+                svc.close_session(sid)
+            return n / dur if dur > 0 else 0.0, lat, failed
+
+        def perreq_pass():
+            lat, failed = [], 0
+            t0 = time.monotonic()
+            for i in range(n):
+                stream, bucket = streams[shapes[i % len(shapes)]]
+                t1 = time.monotonic()
+                try:
+                    sid = svc.open_session(sides[bucket])
+                    svc.decode_si(stream, sid, timeout=120)
+                    svc.close_session(sid)
+                except Exception:  # noqa: BLE001 — counted, gated below
+                    failed += 1
+                lat.append((time.monotonic() - t1) * 1e3)
+            dur = time.monotonic() - t0
+            return n / dur if dur > 0 else 0.0, lat, failed
+
+        for r in range(args.si_repeats):
+            pair_cores.append(round(_effective_cores(), 2))
+            order = [("warm", warm_pass), ("per_request_prep", perreq_pass)]
+            if r % 2:
+                order.reverse()
+            for name, fn in order:
+                rps, lat, failed = fn()
+                results[name]["runs_rps"].append(round(rps, 3))
+                results[name]["lat_ms"].extend(lat)
+                results[name]["failed"] += failed
+
+        # churn: sessions created + evicted UNDER LOAD (session_max=4)
+        ev_before = svc.metrics.counter("serve_session_evictions").value
+        sids = []
+        ok = expired = untyped = 0
+        for k in range(3 * 4):
+            bucket = buckets[k % len(buckets)]
+            sids.append((bucket, svc.open_session(sides[bucket])))
+            for b, sid in sids[-6:]:
+                stream = next(s for s, bk in streams.values() if bk == b)
+                try:
+                    svc.decode_si(stream, sid, timeout=120)
+                    ok += 1
+                except SessionError:
+                    expired += 1      # evicted underneath us: typed
+                except Exception:  # noqa: BLE001 — the violation class
+                    untyped += 1
+        churn = {
+            "opened": len(sids),
+            "decodes_ok": ok,
+            "expired_typed": expired,
+            "untyped": untyped,
+            "evictions": svc.metrics.counter(
+                "serve_session_evictions").value - ev_before,
+        }
+    snap = svc.metrics.snapshot()
+    svc.drain()
+    ratios = [w / p for w, p in zip(results["warm"]["runs_rps"],
+                                    results["per_request_prep"]["runs_rps"])
+              if p > 0]
+    return {
+        "requests_per_mode": n,
+        "repeats": args.si_repeats,
+        "session_max": 4,
+        "warm": {
+            "throughput_rps": _median(results["warm"]["runs_rps"]),
+            "runs_rps": results["warm"]["runs_rps"],
+            "latency_ms": _lat_stats(results["warm"]["lat_ms"]),
+            "failed": results["warm"]["failed"],
+        },
+        "per_request_prep": {
+            "throughput_rps": _median(
+                results["per_request_prep"]["runs_rps"]),
+            "runs_rps": results["per_request_prep"]["runs_rps"],
+            "latency_ms": _lat_stats(results["per_request_prep"]["lat_ms"]),
+            "failed": results["per_request_prep"]["failed"],
+        },
+        "pair_speedups": [round(r, 3) for r in ratios],
+        "speedup": round(_median(ratios), 3) if ratios else None,
+        "pair_effective_cores": pair_cores,
+        "churn": churn,
+        "prep_ms": {k: round(float(v), 3) for k, v in
+                    snap["histograms"].get("serve_si_prep_ms",
+                                           {}).items()},
+        "search_ms": {k: round(float(v), 3) for k, v in
+                      snap["histograms"].get("serve_si_search_ms",
+                                             {}).items()},
+        "sessions_opened": snap["counters"].get("serve_sessions_opened",
+                                                0),
+        "steady_compiles": sentinel.compilations,
+        "warmup": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in warm.items()},
+    }
+
+
+def _gate_si(section, floor: float = 1.1) -> list:
+    """--smoke violations for the SI session axis: zero failures in
+    either mode, zero steady-state compiles while sessions churn,
+    a non-vacuous churn (evictions fired; every decode resolved ok or
+    typed), and the warm-session speedup over per-request prep at the
+    floor — downgraded to a host-weather note in a serial window
+    (the _effective_cores convention)."""
+    violations = []
+    for mode in ("warm", "per_request_prep"):
+        if section[mode]["failed"]:
+            violations.append(f"si {mode}: {section[mode]['failed']} "
+                              f"requests failed")
+    if section["steady_compiles"]:
+        violations.append(
+            f"si: {section['steady_compiles']} steady-state compiles "
+            f"while sessions churned — session create/evict must reuse "
+            f"the warmed executables")
+    churn = section["churn"]
+    if churn.get("evictions", 0) <= 0:
+        violations.append("si churn never evicted a session (vacuous — "
+                          "the LRU bound did not engage)")
+    if churn.get("untyped", 0):
+        violations.append(f"si churn: {churn['untyped']} untyped "
+                          f"errors (expiry must be SessionExpired)")
+    speedup = section.get("speedup")
+    if speedup is None or speedup < floor:
+        cores = section.get("pair_effective_cores") or []
+        median_cores = _median(cores)
+        if isinstance(median_cores, float) and median_cores < 1.3:
+            print(f"SERVE_BENCH_NOTE: warm-session speedup {speedup} "
+                  f"below the {floor} floor in a serial window "
+                  f"(effective cores {cores}) — floor not applied",
+                  file=sys.stderr)
+        else:
+            violations.append(
+                f"warm-session SI decode only {speedup}x the "
+                f"per-request-prep baseline (floor {floor}; pairs "
+                f"{section.get('pair_speedups')}, cores {cores}) — "
+                f"the session cache is not amortizing the prep")
     return violations
 
 
@@ -1017,6 +1235,17 @@ def main(argv=None) -> int:
                    help="run ONLY the front-door scenarios (priority-"
                         "mix overload + replica scale-out) — the "
                         "frontdoor-bench tpu_session.sh stage")
+    p.add_argument("--si_requests", type=int, default=48,
+                   help="requests per SI mode pass (warm-session and "
+                        "per-request-prep each run this many decode_si "
+                        "calls per repeat)")
+    p.add_argument("--si_repeats", type=int, default=3,
+                   help="alternating warm/per-request-prep pass pairs; "
+                        "the SI speedup is the median per-pair ratio")
+    p.add_argument("--si_only", action="store_true",
+                   help="run ONLY the session-cached SI axis (warm vs "
+                        "per-request prep + session churn) — the "
+                        "si-bench tpu_session.sh stage")
     p.add_argument("--out", default="SERVE_BENCH.json")
     p.add_argument("--smoke_model", action="store_true",
                    help="use the built-in tiny model configs but keep "
@@ -1051,19 +1280,22 @@ def main(argv=None) -> int:
         args.repeats = 5       # median of 5 pairs: one noisy host
         args.sample_every_ms = 20.0    # window cannot flip the verdict
         args.frontdoor_requests = 200   # ~1.7s window: a real backlog
+        args.si_requests = 20   # per-mode pass stays seconds-fast
 
     only_flags = [f for f in ("devices_only", "backends_only",
-                              "frontdoor_only") if getattr(args, f)]
+                              "frontdoor_only", "si_only")
+                  if getattr(args, f)]
     if len(only_flags) > 1:
         print(f"SERVE_BENCH_FAILED: {only_flags} are mutually "
               f"exclusive", file=sys.stderr)
         return 2
     if args.devices is None:
         # smoke keeps the axis short (CI seconds); the committed
-        # artifact run records the full curve; backends_only and
-        # frontdoor_only never run the device axis, so they never
-        # force host devices
-        args.devices = ("" if (args.backends_only or args.frontdoor_only)
+        # artifact run records the full curve; backends_only/
+        # frontdoor_only/si_only never run the device axis, so they
+        # never force host devices
+        args.devices = ("" if (args.backends_only or args.frontdoor_only
+                               or args.si_only)
                         else "1 2" if args.smoke else "1 2 4 8")
     axis = [int(v) for v in args.devices.split()]
     if any(n < 1 for n in axis):
@@ -1136,6 +1368,21 @@ def main(argv=None) -> int:
                 "replicas": _run_frontdoor_replicas(args),
             },
         }
+    elif args.si_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "si_requests": args.si_requests,
+                "si_repeats": args.si_repeats,
+                "smoke": args.smoke,
+            },
+            "si": _run_si_section(args),
+        }
     else:
         report = run_bench(args)
         report["config"]["entropy_backend"] = args.entropy_backend
@@ -1154,13 +1401,18 @@ def main(argv=None) -> int:
         if not args.smoke:
             report["config"]["replicas"] = args.replicas
             report["frontdoor"]["replicas"] = _run_frontdoor_replicas(args)
+        # session-cached SI serving (ISSUE 10): rides every run — the
+        # smoke gate holds the warm-vs-per-request-prep speedup floor
+        # (host-weather escape) and zero compiles under session churn
+        report["config"]["si_requests"] = args.si_requests
+        report["si"] = _run_si_section(args)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
     summary_keys = ("load", "latency_ms", "batch_occupancy",
                     "steady_compiles", "pipeline", "entropy_backends",
-                    "devices", "frontdoor")
+                    "devices", "frontdoor", "si")
     print(json.dumps({k: report[k] for k in summary_keys if k in report},
                      indent=1))
     if args.smoke and args.devices_only:
@@ -1177,6 +1429,12 @@ def main(argv=None) -> int:
         return 0
     if args.smoke and args.frontdoor_only:
         violations = _gate_frontdoor(report["frontdoor"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
+    if args.smoke and args.si_only:
+        violations = _gate_si(report["si"])
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1
@@ -1232,6 +1490,8 @@ def main(argv=None) -> int:
             violations.extend(_gate_device_axis(report["devices"]))
         if "frontdoor" in report:
             violations.extend(_gate_frontdoor(report["frontdoor"]))
+        if "si" in report:
+            violations.extend(_gate_si(report["si"]))
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

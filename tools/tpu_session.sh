@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -146,6 +146,30 @@ frontdoor-bench)
     exit 1
   fi
   ;;
+si-bench)
+  # session-cached SI serving smoke before chip time (ISSUE 10): the
+  # warm-session vs per-request-prep comparison (speedup floor with the
+  # host-weather note convention, zero compiles under session churn)
+  # plus the chaos session battery (evict-under-load, expire-mid-batch,
+  # serve.session faults, replica-death with live sessions). Both exit
+  # 1 on violation; seconds on CPU.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --si_only \
+    --devices "" --out artifacts/si_bench.json \
+    > artifacts/si_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/si_bench.log
+    echo "TPU_SESSION_FAILED: si-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke --sessions_only \
+    --out artifacts/si_sessions_chaos.json \
+    > artifacts/si_sessions_chaos.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/si_sessions_chaos.log
+    echo "TPU_SESSION_FAILED: si-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -217,7 +241,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke serve-smoke serve-multidevice entropy-bench frontdoor-bench bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
