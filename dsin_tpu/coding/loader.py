@@ -250,12 +250,33 @@ def encode_batch_isolated(codec, volumes) -> list:
         return out
 
 
-def worker_encode_batch(volumes) -> list:
+def _traced_task(fn, data, trace):
+    """Run a coding task in this worker, echoing the serialized trace
+    contexts back with the child-side timing (ISSUE 11): the parent
+    bridge bit-checks the echo against what it sent (the propagation
+    contract across the spawn boundary) and records the child's coding
+    span. `trace` is an opaque picklable tuple of TraceContexts —
+    nothing here imports the serve stack."""
+    t0 = time.monotonic()
+    out = fn(data)
+    t1 = time.monotonic()
+    return out, {"trace": trace, "pid": os.getpid(),
+                 "coding_ms": (t1 - t0) * 1e3}
+
+
+def worker_encode_batch(volumes, trace=None):
     """Process-pool task: encode N (D, H, W) symbol volumes with the
     resident codec — one native rANS call for the whole micro-batch,
     per-lane isolation on refusal (encode_batch_isolated's
-    [(payload, None) | (None, exception)] contract)."""
-    return encode_batch_isolated(_resident_codec(), volumes)
+    [(payload, None) | (None, exception)] contract). With `trace`
+    (sampled TraceContexts riding the task), returns (lanes, echo) —
+    the echo carries the contexts back bit-identical plus the
+    child-side coding time."""
+    if trace is None:
+        return encode_batch_isolated(_resident_codec(), volumes)
+    return _traced_task(
+        lambda v: encode_batch_isolated(_resident_codec(), v),
+        volumes, trace)
 
 
 def decode_batch_isolated(codec, payloads) -> list:
@@ -276,8 +297,13 @@ def decode_batch_isolated(codec, payloads) -> list:
         return out
 
 
-def worker_decode_batch(payloads) -> list:
+def worker_decode_batch(payloads, trace=None):
     """Process-pool task: decode N payloads with the resident codec.
     Payloads arrive CRC-verified (the parent-side bridge keeps the
-    per-request verify + fault-site semantics)."""
-    return decode_batch_isolated(_resident_codec(), payloads)
+    per-request verify + fault-site semantics). `trace` as in
+    `worker_encode_batch`: (lanes, echo) when contexts ride the task."""
+    if trace is None:
+        return decode_batch_isolated(_resident_codec(), payloads)
+    return _traced_task(
+        lambda p: decode_batch_isolated(_resident_codec(), p),
+        payloads, trace)

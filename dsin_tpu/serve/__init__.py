@@ -12,8 +12,11 @@ Layering (each module stands alone below the next):
                    policy + per-device shardings via parallel/mesh.py)
     session.py   — side-information session cache: LRU/TTL/byte-bounded
                    store of device-resident SidePrep bundles (ISSUE 10)
+    trace.py     — span-based request tracer + crash flight recorder
+                   (ISSUE 11): per-request TraceContexts, bounded span/
+                   event rings, /trace + Chrome export, JSONL dumps
     metrics.py   — lock-guarded counters/gauges/histograms + http.server
-                   /healthz + /metrics endpoint
+                   /healthz + /metrics (+ /trace) endpoint
     service.py   — device-affine executor threads over the batched
                    jitted codec; model state loaded once via
                    coding/loader.py
@@ -34,28 +37,33 @@ from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
                                       PlacementPlan, RebalanceTrigger,
                                       plan_placement)
 from dsin_tpu.serve.router import (AdmissionController, AggregatedMetrics,
-                                   FleetSwapError, FrontDoorRouter)
+                                   AggregatedTraces, FleetSwapError,
+                                   FrontDoorRouter)
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
 from dsin_tpu.serve.session import (SessionEntry, SessionError,
                                     SessionExpired, SessionOverCapacity,
                                     SessionStore)
-from dsin_tpu.serve.swap import ModelBundle, SwapCoordinator, SwapError
+from dsin_tpu.serve.swap import (ModelBundle, RollbackWatchdog,
+                                 SwapCoordinator, SwapError)
+from dsin_tpu.serve.trace import FlightRecorder, TraceContext, Tracer
 from dsin_tpu.train.checkpoint import ManifestMismatch
 from dsin_tpu.utils.integrity import IntegrityError
 
 __all__ = [
     "BULK", "INTERACTIVE",
-    "AdmissionController", "AggregatedMetrics", "BucketPolicy",
-    "CompressionService", "DeadlineExceeded", "DevicePlacement",
-    "EncodeResult", "FleetSwapError", "FrontDoorRouter", "Future",
+    "AdmissionController", "AggregatedMetrics", "AggregatedTraces",
+    "BucketPolicy", "CompressionService", "DeadlineExceeded",
+    "DevicePlacement", "EncodeResult", "FleetSwapError",
+    "FlightRecorder", "FrontDoorRouter", "Future",
     "IntegrityError", "ManifestMismatch", "MetricsRegistry",
     "MetricsServer", "MicroBatcher", "ModelBundle", "NoBucketFits",
     "PlacementError", "PlacementPlan", "PriorityClass",
-    "RebalanceTrigger", "Request", "ServeError", "ServiceConfig",
-    "ServiceDraining", "ServiceOverloaded", "ServiceUnavailable",
-    "SessionEntry", "SessionError", "SessionExpired",
-    "SessionOverCapacity", "SessionStore", "SwapCoordinator", "SwapError",
+    "RebalanceTrigger", "Request", "RollbackWatchdog", "ServeError",
+    "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
+    "ServiceUnavailable", "SessionEntry", "SessionError",
+    "SessionExpired", "SessionOverCapacity", "SessionStore",
+    "SwapCoordinator", "SwapError", "TraceContext", "Tracer",
     "crop_from_bucket", "default_priority_classes", "pad_to_bucket",
     "plan_placement",
 ]

@@ -225,7 +225,10 @@ class Request:
     batcher's first/most-latency-sensitive class, filled in at
     submit). `session` (ISSUE 10) narrows coalescing: requests sharing
     a key still only batch together when they also share the session —
-    consumers' `accept` sets keep filtering on the key alone."""
+    consumers' `accept` sets keep filtering on the key alone. `trace`
+    (ISSUE 11) is the request's TraceContext (serve/trace.py), minted
+    at admission and read by every pipeline stage that records a span —
+    opaque to the batcher itself."""
     key: Hashable
     payload: Any
     deadline: Optional[float] = None
@@ -233,6 +236,7 @@ class Request:
     arrival: float = field(default_factory=time.monotonic)
     priority: Optional[str] = None
     session: Optional[str] = None
+    trace: Optional[Any] = None
 
 
 class MicroBatcher:

@@ -21,10 +21,11 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
-from urllib.parse import urlparse
+from typing import Callable, Dict, Mapping, Optional
+from urllib.parse import parse_qs, urlparse
 
 from dsin_tpu.utils import locks as locks_lib
 
@@ -126,6 +127,7 @@ class MetricsRegistry:
         self._histograms: Dict[str, Histogram] = {}      # guarded-by: self._lock
         self._accumulators: Dict[str, Accumulator] = {}  # guarded-by: self._lock
         self._info: Dict[str, object] = {}               # guarded-by: self._lock
+        self._seq = 0                                    # guarded-by: self._lock
 
     # construct only on miss (not setdefault's eager default): building
     # a metric builds its RankedLock, which registers a stats ledger —
@@ -177,7 +179,17 @@ class MetricsRegistry:
             histograms = dict(self._histograms)
             accumulators = dict(self._accumulators)
             info = dict(self._info)
+            # monotonic per-registry sequence + capture wall-clock
+            # (ISSUE 11 satellite): every snapshot is provably FRESH —
+            # a scrape whose seq did not advance (or whose timestamp is
+            # old) came from a wedged/cached source, and the router's
+            # AggregatedMetrics flags it instead of silently merging
+            # stale numbers
+            self._seq += 1
+            seq = self._seq
         return {
+            "seq": seq,
+            "captured_at": time.time(),
             "info": info,
             "counters": {k: c.value for k, c in sorted(counters.items())},
             "gauges": {k: g.value for k, g in sorted(gauges.items())},
@@ -225,13 +237,21 @@ def render_snapshot_text(snap: dict) -> str:
 
 
 class MetricsServer:
-    """`/healthz` + `/metrics` on a daemon thread; port 0 = ephemeral
-    (tests read `.port` after start)."""
+    """`/healthz` + `/metrics` (+ `/trace`, ISSUE 11) on a daemon
+    thread; port 0 = ephemeral (tests read `.port` after start).
+
+    `trace` is an optional provider called with the request's query
+    params (flattened `{key: value}`) returning a JSON-able body — a
+    service passes its tracer's view, the router passes the fleet-
+    merged AggregatedTraces. Without a provider /trace answers 404, so
+    pre-tracing deployments keep their exact surface."""
 
     def __init__(self, registry: MetricsRegistry,
                  health: Callable[[], dict],
-                 port: int = 0, host: str = "127.0.0.1"):
-        registry_ref, health_ref = registry, health
+                 port: int = 0, host: str = "127.0.0.1",
+                 trace: Optional[Callable[[Mapping[str, str]],
+                                          object]] = None):
+        registry_ref, health_ref, trace_ref = registry, health, trace
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: ARG002
@@ -262,6 +282,12 @@ class MetricsServer:
                     else:
                         self._send(200, registry_ref.render_text(),
                                    "text/plain; version=0.0.4")
+                elif url.path == "/trace" and trace_ref is not None:
+                    params = {k: v[-1] for k, v in
+                              parse_qs(url.query or "").items()}
+                    self._send(200, json.dumps(trace_ref(params),
+                                               default=str),
+                               "application/json")
                 else:
                     self._send(404, "not found\n", "text/plain")
 

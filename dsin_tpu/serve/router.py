@@ -82,7 +82,22 @@ Router-level /metrics aggregation (the PR 8 follow-up): pass
 replica's snapshot — counters/gauges/accumulators summed, histograms
 merged (count-weighted mean; p50/p99 as the fleet-wide max, the
 conservative operator view), per-replica model digests + scrape health
-in the info section — so operators stop polling N ports.
+in the info section — so operators stop polling N ports. Snapshot
+FRESHNESS is verified (ISSUE 11 satellite): every registry snapshot
+carries a monotonic `seq` + `captured_at`, and a replica whose seq
+failed to advance since the previous scrape (or whose capture
+timestamp is old) is flagged in `replicas_stale` and EXCLUDED from the
+merge instead of silently contributing frozen numbers.
+
+Tracing (ISSUE 11): the router mints the front-door `TraceContext`
+(serve/trace.py) at `_submit` — its head sampling decision rides the
+pipe with every (re)dispatch and is honored replica-side, so one trace
+id indexes the router hop AND the replica-internal spans. The
+`router.dispatch` span covers intake -> future resolution; the fleet
+`/trace` endpoint (AggregatedTraces) merges the router's ring with a
+live scrape of every replica's `/trace` onto one wall-clock timeline.
+Replica deaths and admission sheds land in the router's own
+FlightRecorder ring, dumping next to the replicas' own artifacts.
 
 Locks (utils/locks.py ranks): `serve.frontdoor` (4) guards the replica
 state table and the per-class rr counters; `serve.replica` (6) guards
@@ -104,7 +119,8 @@ from dataclasses import replace
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from dsin_tpu.serve import metrics as metrics_lib
-from dsin_tpu.serve.batcher import (DeadlineExceeded, Future,
+from dsin_tpu.serve import trace as trace_lib
+from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, ServeError,
                                     ServiceOverloaded, ServiceUnavailable)
 from dsin_tpu.serve.session import SessionExpired
 from dsin_tpu.utils import locks as locks_lib
@@ -305,7 +321,10 @@ def _replica_main(conn, config, replica_id: int) -> None:
                 break              # router died: drain and exit
             if msg[0] == "stop":
                 break
-            op, rid, payload, priority, deadline_ms = msg
+            # request messages carry a 6th element since ISSUE 11 (the
+            # front-door TraceContext); control ops stay 5-tuples
+            op, rid, payload, priority, deadline_ms = msg[:5]
+            trace = msg[5] if len(msg) > 5 else None
             if op in CONTROL_OPS:
                 if op == "swap_prepare":
                     # prepare is the slow phase (load + census warm):
@@ -351,14 +370,16 @@ def _replica_main(conn, config, replica_id: int) -> None:
             try:
                 if op == "encode":
                     fut = service.submit_encode(
-                        payload, deadline_ms=deadline_ms, priority=priority)
+                        payload, deadline_ms=deadline_ms,
+                        priority=priority, trace=trace)
                 elif op == "decode":
                     fut = service.submit_decode(
-                        payload, deadline_ms=deadline_ms, priority=priority)
+                        payload, deadline_ms=deadline_ms,
+                        priority=priority, trace=trace)
                 elif op == "decode_si":
                     fut = service.submit_decode_si(
                         payload[0], payload[1], deadline_ms=deadline_ms,
-                        priority=priority)
+                        priority=priority, trace=trace)
                 else:
                     raise ValueError(f"unknown replica op {op!r}")
             except BaseException as e:  # noqa: BLE001 — typed door rejects
@@ -399,19 +420,24 @@ class _Pending:
     safe), plus the caller's future. Exactly-once resolution is owned
     by whoever pops it from an in-flight map. The deadline is pinned
     ABSOLUTE at intake (`expires_at`) so a reroute forwards only the
-    REMAINING budget instead of restarting the clock."""
+    REMAINING budget instead of restarting the clock. `trace` (ISSUE
+    11) is the front-door TraceContext that crosses the pipe with every
+    (re)dispatch — a rerouted request keeps its trace id."""
 
     __slots__ = ("op", "payload", "priority", "expires_at", "future",
-                 "retries")
+                 "retries", "trace")
 
-    def __init__(self, op, payload, priority, deadline_ms, retries):
+    def __init__(self, op, payload, priority, deadline_ms, retries,
+                 trace=None):
         self.op = op
         self.payload = payload
         self.priority = priority
         self.expires_at = (None if deadline_ms is None
                            else time.monotonic() + deadline_ms / 1000.0)
         self.future = Future()
+        self.future.trace = trace
         self.retries = retries
+        self.trace = trace
 
     def remaining_ms(self) -> Optional[float]:
         """Budget left right now; None = no deadline, <= 0 = expired."""
@@ -453,7 +479,10 @@ class FrontDoorRouter:
                  poll_every_s: float = 0.25, evict_after: int = 2,
                  death_retries: int = 1, health_timeout_s: float = 2.0,
                  start_timeout_s: float = 600.0, launcher=None,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 trace_sample_rate: float = 0.0,
+                 trace_capacity: int = 4096,
+                 flight_dir: Optional[str] = None):
         if replicas < 1:
             raise ValueError(f"need at least one replica, got {replicas}")
         if evict_after < 1:
@@ -509,6 +538,18 @@ class FrontDoorRouter:
         #: the fleet-merged metrics view (the one-endpoint aggregation);
         #: usable directly (`.snapshot()`) or served via `metrics_port`
         self.aggregate = AggregatedMetrics(self)
+        # observability (ISSUE 11): the router mints the FRONT-DOOR
+        # trace context (its head sampling decision rides the pipe and
+        # is honored by the replica), records the router.dispatch span,
+        # and keeps its own flight ring (sheds, replica deaths)
+        self.tracer = trace_lib.Tracer(
+            sample_rate=trace_sample_rate, capacity=trace_capacity,
+            metrics=self.metrics)
+        self.flight = trace_lib.FlightRecorder(
+            dump_dir=flight_dir, metrics=self.metrics)
+        #: the fleet-merged trace view: the router's own spans + a live
+        #: /trace scrape of every replica, stitched onto one timeline
+        self.traces = AggregatedTraces(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -552,7 +593,8 @@ class FrontDoorRouter:
         if self.metrics_port is not None:
             self._metrics_server = metrics_lib.MetricsServer(
                 self.aggregate, self.health,
-                port=self.metrics_port).start()
+                port=self.metrics_port,
+                trace=self.traces.http_snapshot).start()
         self._started = True
         return self
 
@@ -628,12 +670,18 @@ class FrontDoorRouter:
                 deadline_ms: Optional[float]) -> Future:
         assert self._started, "start() the router before submitting"
         cls = priority or self._class_names[0]
-        self.admission.admit(cls)   # sheds HERE, before any enqueue
+        try:
+            self.admission.admit(cls)   # sheds HERE, before any enqueue
+        except ServiceOverloaded:
+            self.flight.record("shed", reason="admission", cls=cls)
+            raise
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms.get(cls)
         pending = _Pending(op, payload, cls, deadline_ms,
-                           self.death_retries)
+                           self.death_retries,
+                           trace=self.tracer.mint(origin="router"))
         self.admission.attach(cls, pending.future)
+        self._attach_trace(pending, op, cls)
         try:
             self._dispatch(pending)
         except ServiceUnavailable as e:
@@ -643,6 +691,28 @@ class FrontDoorRouter:
             raise
         self.metrics.counter(f"serve_router_routed_{cls}").inc()
         return pending.future
+
+    def _attach_trace(self, pending: _Pending, op: str,
+                      cls: str) -> None:
+        """Router-hop observability (ISSUE 11): the router.dispatch
+        span covers front-door intake -> future resolution (reroutes
+        included — it is the caller-visible hop), and a typed-error
+        resolution records into the router's flight ring like the
+        service's own callback does replica-side."""
+        ctx = pending.trace
+        t0 = time.monotonic()
+
+        def _resolved(fut):
+            exc = fut.exception(timeout=0)
+            self.tracer.span_for(ctx, trace_lib.SPAN_ROUTER, t0,
+                                 time.monotonic(), op=op, cls=cls)
+            if exc is not None and isinstance(exc, (ServeError,
+                                                    ValueError)):
+                self.tracer.error(ctx, exc)
+                self.flight.note_error(
+                    exc, trace_id=ctx.trace_id if ctx else None)
+
+        pending.future.add_done_callback(_resolved)
 
     # -- side-information sessions (ISSUE 10) --------------------------------
 
@@ -657,7 +727,7 @@ class FrontDoorRouter:
             rep.inflight[rid] = pending
             try:
                 rep.conn.send((op, rid, pending.payload, pending.priority,
-                               pending.remaining_ms()))
+                               pending.remaining_ms(), pending.trace))
                 return True
             except (OSError, ValueError, BrokenPipeError):
                 del rep.inflight[rid]
@@ -753,12 +823,18 @@ class FrontDoorRouter:
                 f"{'died' if idx is not None else 'is unknown'}) — "
                 f"re-open it")
         cls = priority or self._class_names[0]
-        self.admission.admit(cls)   # sheds HERE, before any enqueue
+        try:
+            self.admission.admit(cls)   # sheds HERE, before any enqueue
+        except ServiceOverloaded:
+            self.flight.record("shed", reason="admission", cls=cls)
+            raise
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms.get(cls)
         pending = _Pending("decode_si", (blob, session_id), cls,
-                           deadline_ms, 0)
+                           deadline_ms, 0,
+                           trace=self.tracer.mint(origin="router"))
         self.admission.attach(cls, pending.future)
+        self._attach_trace(pending, "decode_si", cls)
         self._swap_gate.wait(_SWAP_GATE_TIMEOUT_S)
         rep = self._replicas[idx]
         if not self._send_pinned(rep, "decode_si", pending):
@@ -813,9 +889,12 @@ class FrontDoorRouter:
                 try:
                     # forward the REMAINING budget: on a reroute the
                     # replacement replica must not restart the clock
+                    # (the trace context rides every (re)dispatch, so
+                    # a rerouted request keeps one stitched timeline)
                     rep.conn.send((pending.op, rid, pending.payload,
                                    pending.priority,
-                                   pending.remaining_ms()))
+                                   pending.remaining_ms(),
+                                   pending.trace))
                     sent = True
                 except (OSError, ValueError, BrokenPipeError):
                     del rep.inflight[rid]
@@ -869,6 +948,10 @@ class FrontDoorRouter:
         draining = self._stop.is_set()
         if not draining:
             self.metrics.counter("serve_router_replica_deaths").inc()
+            # replica death is a flight-dump trigger (ISSUE 11): the
+            # router's ring holds the routing/shed decisions that led
+            # up to it
+            self.flight.note_death("replica_death", replica=rep.idx)
         # drop the dead replica's session pins FIRST: a submit racing
         # this death must find no pin (typed SessionExpired at the
         # door), never a pin pointing at a corpse
@@ -1212,6 +1295,8 @@ class FrontDoorRouter:
                 if not pending.future.done():
                     pending.future.set_exception(ServiceUnavailable(
                         "front door drained with this request in flight"))
+        self.flight.flush(timeout=5.0)
+        self.flight.close()
 
 
 # -- router-level /metrics aggregation (ISSUE 9 satellite) --------------------
@@ -1233,10 +1318,52 @@ class AggregatedMetrics:
     which replicas failed to answer the scrape. Duck-types the
     `MetricsRegistry` surface `MetricsServer` needs (`snapshot()` /
     `render_text()`), so `FrontDoorRouter(metrics_port=...)` serves it
-    over the standard endpoint."""
+    over the standard endpoint.
+
+    Staleness (ISSUE 11 satellite): a scrape that ANSWERS is not
+    necessarily FRESH — a wedged replica (or an interposed cache) can
+    keep serving the same frozen snapshot while its dataplane is dead.
+    Registry snapshots therefore carry a per-process monotonic `seq`
+    (incremented by the snapshot itself) and a `captured_at` wall
+    timestamp; this view remembers the last seq it saw per replica and
+    treats a non-advancing seq, or a capture older than
+    `stale_after_s`, as STALE — flagged in `info.replicas_stale` and
+    excluded from the merge, never silently averaged in."""
+
+    #: capture-timestamp slack before a scrape counts as stale (same
+    #: host, so clock skew is not a concern at this scale)
+    stale_after_s = 5.0
 
     def __init__(self, router: "FrontDoorRouter"):
         self._router = router
+        # last seen snapshot seq per replica idx; the scrape loop may
+        # run concurrently from ThreadingHTTPServer handler threads
+        self._seq_lock = locks_lib.RankedLock("metrics.registry")
+        self._last_seq: Dict[int, int] = {}   # guarded-by: self._seq_lock
+
+    def _is_stale(self, idx: int, snap: dict, now: float) -> bool:
+        """Freshness verdict for one replica scrape. A missing seq
+        (pre-ISSUE-11 replica, test fake) is not judged — only
+        POSITIVE evidence of staleness flags a replica. The seq test is
+        EQUALITY, not <=: a live registry mints a fresh seq per
+        snapshot, so two concurrent scrapes legitimately observe
+        adjacent seqs in either order (a <= test would falsely flag the
+        loser of that race), while a frozen/cached response replays the
+        IDENTICAL seq — the signature being hunted. A seq that went
+        BACKWARDS (replica restart) is fresh numbers, not stale ones."""
+        seq = snap.get("seq")
+        captured = snap.get("captured_at")
+        stale = False
+        if seq is not None:
+            with self._seq_lock:
+                prev = self._last_seq.get(idx)
+                if prev is not None and seq == prev:
+                    stale = True
+                else:
+                    self._last_seq[idx] = seq
+        if captured is not None and now - captured > self.stale_after_s:
+            stale = True
+        return stale
 
     def _scrape(self, rep: _Replica) -> Optional[dict]:
         port = (rep.info or {}).get("healthz_port")
@@ -1259,6 +1386,7 @@ class AggregatedMetrics:
         per_replica_info: Dict[str, dict] = {}
         digests: Dict[str, Optional[str]] = {}
         unreachable = []
+        stale = []
         # fan the scrapes out: unreachable replicas each burn up to
         # health_timeout_s, and paying that N times IN SERIES would
         # blow the operator's scrape interval — concurrent GETs bound
@@ -1274,9 +1402,17 @@ class AggregatedMetrics:
         with ThreadPoolExecutor(
                 max_workers=max(1, len(replicas))) as pool:
             snaps = list(pool.map(_safe_scrape, replicas))
+        now = time.time()
         for rep, snap in zip(replicas, snaps):
             if snap is None:
                 unreachable.append(rep.idx)
+                digests[str(rep.idx)] = (rep.info or {}).get(
+                    "params_digest")
+                continue
+            if self._is_stale(rep.idx, snap, now):
+                # frozen numbers are worse than missing ones: flag the
+                # replica and keep its stale values OUT of the merge
+                stale.append(rep.idx)
                 digests[str(rep.idx)] = (rep.info or {}).get(
                     "params_digest")
                 continue
@@ -1311,6 +1447,7 @@ class AggregatedMetrics:
                 "per_replica": per_replica_info,
                 "replicas_scraped": len(per_replica_info),
                 "replicas_unreachable": unreachable,
+                "replicas_stale": stale,
             },
             "counters": dict(sorted(counters.items())),
             "gauges": dict(sorted(gauges.items())),
@@ -1325,3 +1462,74 @@ class AggregatedMetrics:
 
     def render_text(self) -> str:
         return metrics_lib.render_snapshot_text(self.snapshot())
+
+
+# -- router-level /trace aggregation (ISSUE 11) -------------------------------
+
+class AggregatedTraces:
+    """ONE fleet-wide trace view: the router's own span ring merged
+    with a live `/trace` scrape of every replica, stitched onto one
+    wall-clock timeline (spans carry wall anchors precisely because
+    monotonic bases do not compare across processes).
+
+    A front-door request's trace id indexes the router.dispatch span
+    (minted router-side, the context crossed the pipe) AND the
+    replica-internal queue/device/entropy/SI spans — the scrape
+    forwards the `?id=` filter so per-trace lookups stay cheap at the
+    replicas. Mirrors AggregatedMetrics' scrape semantics: fresh
+    fan-out per call, unreachable replicas reported, concurrent GETs so
+    N dead replicas cost ~one timeout total."""
+
+    def __init__(self, router: "FrontDoorRouter"):
+        self._router = router
+
+    def _scrape(self, rep: _Replica,
+                trace_id: Optional[str]) -> Optional[dict]:
+        port = (rep.info or {}).get("healthz_port")
+        if port is None:
+            return None
+        url = f"http://127.0.0.1:{port}/trace"
+        if trace_id is not None:
+            url += f"?id={trace_id}"
+        with urllib.request.urlopen(
+                url, timeout=self._router.health_timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def snapshot(self, trace_id: Optional[str] = None) -> dict:
+        own = self._router.tracer.snapshot(trace_id=trace_id)
+        from concurrent.futures import ThreadPoolExecutor
+
+        def _safe(rep):
+            try:
+                return self._scrape(rep, trace_id)
+            except Exception:   # noqa: BLE001 — a dead scrape is data
+                return None
+        replicas = list(self._router._replicas)
+        scraped = 0
+        unreachable = []
+        parts = [own]
+        if replicas:
+            with ThreadPoolExecutor(max_workers=len(replicas)) as pool:
+                snaps = list(pool.map(_safe, replicas))
+            for rep, snap in zip(replicas, snaps):
+                if snap is None:
+                    unreachable.append(rep.idx)
+                    continue
+                scraped += 1
+                parts.append(snap)
+        return {
+            "spans": trace_lib.merge_trace_snapshots(parts),
+            "router_spans": len(own["spans"]),
+            "replicas_scraped": scraped,
+            "replicas_unreachable": unreachable,
+            "flight": self._router.flight.meta(),
+        }
+
+    def http_snapshot(self, params: Mapping[str, str]) -> object:
+        """/trace provider for the router's MetricsServer: same query
+        surface as a single service (`?id=`, `?format=chrome`), fleet-
+        merged."""
+        snap = self.snapshot(trace_id=params.get("id"))
+        if params.get("format") == "chrome":
+            return trace_lib.chrome_trace(snap["spans"])
+        return snap

@@ -159,6 +159,25 @@ counters, the `serve_swap_state` gauge (0 idle / 1 preparing /
 in /healthz. The `serve.swap` fault site (prepare + commit windows)
 lets chaos_bench kill a swap at its narrowest points and assert the
 service keeps serving the old params.
+
+Observability (ISSUE 11): every request carries a `TraceContext`
+(serve/trace.py) minted at `_submit` (or forwarded by the front door,
+keeping ITS head-sampling decision), and every pipeline stage records a
+span against the batch's sampled contexts — queue wait at batch seal,
+device dispatch->host, the entropy task (both backends; the process
+backend serializes the contexts with the pool task and bit-checks the
+echo), SI session lookup and the fused search executable. Spans wrap
+dispatch boundaries only (never jitted code), so tracing holds
+`CompilationSentinel(budget=0)`; serve_bench's --trace leg gates the
+enabled-vs-disabled overhead and cross-checks span totals against the
+`serve_*_ms` accumulators. A typed error resolving any future counts
+into `serve_typed_errors`, tags the trace (always-on error spans), and
+triggers the FlightRecorder — an always-on ring of admission/shed/
+batch-seal/swap/session/worker events that auto-dumps a JSONL timeline
+on typed errors and worker deaths. The post-swap `RollbackWatchdog`
+(serve/swap.py) compares typed-error-rate windows around every
+`commit_swap` and calls `rollback(expect_current=...)` itself past the
+configured threshold — the ROADMAP's health-triggered rollback loop.
 """
 
 from __future__ import annotations
@@ -181,8 +200,9 @@ from dsin_tpu.serve import placement as placement_lib
 from dsin_tpu.serve import router as router_lib
 from dsin_tpu.serve import swap as swap_lib
 from dsin_tpu.serve import session as session_lib
+from dsin_tpu.serve import trace as trace_lib
 from dsin_tpu.serve.batcher import (Future, MicroBatcher, PriorityClass,
-                                    Request, ServiceDraining,
+                                    Request, ServeError, ServiceDraining,
                                     ServiceUnavailable)
 from dsin_tpu.utils import faults, recompile
 from dsin_tpu.utils import locks as locks_lib
@@ -286,6 +306,32 @@ class ServiceConfig:
     session_max: int = 8
     session_max_bytes: int = 64 * 1024 * 1024
     session_ttl_s: Optional[float] = None
+    #: request tracing + flight recorder (ISSUE 11, serve/trace.py).
+    #: `trace_enabled=False` removes the whole layer (no contexts
+    #: minted, nothing recorded) — the bench's overhead baseline.
+    #: `trace_sample_rate` is the HEAD sampling rate for per-request
+    #: spans (deterministic counter rotation; 0.0 = only typed-error
+    #: spans and flight events are recorded, the production-lean
+    #: default); contexts arriving from the front door keep THEIR
+    #: sampling decision regardless of this rate.
+    trace_enabled: bool = True
+    trace_sample_rate: float = 0.0
+    trace_capacity: int = 4096
+    #: flight recorder: always-on event ring; `flight_dir` enables the
+    #: typed-error/worker-death triggered JSONL auto-dumps (None = ring
+    #: only, queryable via /trace and snapshot()).
+    flight_capacity: int = 2048
+    flight_dir: Optional[str] = None
+    flight_dump_min_interval_s: float = 1.0
+    #: post-swap rollback watchdog (ISSUE 11 satellite / ROADMAP
+    #: elastic-fleet item): compare typed-error-rate windows before and
+    #: after every commit_swap and roll back automatically when the
+    #: rate jumps by more than `rollback_watchdog_threshold` over at
+    #: least `rollback_watchdog_min_requests` post-commit resolutions.
+    #: None = off (the operator owns rollback, the PR 9 behavior).
+    rollback_watchdog_window_s: Optional[float] = None
+    rollback_watchdog_threshold: float = 0.5
+    rollback_watchdog_min_requests: int = 8
     #: persistent XLA compilation cache (utils/cache.py) at start(), so
     #: a restarted service re-warms from disk instead of recompiling
     persistent_cache: bool = True
@@ -498,6 +544,23 @@ class CompressionService:
         self.config = config
         self.policy = buckets_lib.BucketPolicy(config.buckets)
         self.metrics = metrics_lib.MetricsRegistry()
+        # observability layer (ISSUE 11): tracer + flight recorder are
+        # built before anything that may record into them; the rate and
+        # capacities are validated by the constructors (typed, cheap)
+        self.tracer = trace_lib.Tracer(
+            sample_rate=config.trace_sample_rate,
+            capacity=config.trace_capacity,
+            enabled=config.trace_enabled, metrics=self.metrics)
+        self.flight = trace_lib.FlightRecorder(
+            capacity=config.flight_capacity, dump_dir=config.flight_dir,
+            min_dump_interval_s=config.flight_dump_min_interval_s,
+            metrics=self.metrics, enabled=config.trace_enabled)
+        self._watchdog: Optional[swap_lib.RollbackWatchdog] = None
+        if config.rollback_watchdog_window_s is not None:
+            self._watchdog = swap_lib.RollbackWatchdog(
+                config.rollback_watchdog_window_s,
+                config.rollback_watchdog_threshold,
+                config.rollback_watchdog_min_requests)
         self._batcher = MicroBatcher(
             config.max_batch, config.max_wait_ms, config.max_queue,
             classes=config.priority_classes,
@@ -624,7 +687,8 @@ class CompressionService:
             # the store's own __init__ validates the bounds
             self._sessions = session_lib.SessionStore(
                 self.config.session_max, self.config.session_max_bytes,
-                self.config.session_ttl_s, metrics=self.metrics)
+                self.config.session_ttl_s, metrics=self.metrics,
+                flight=self.flight)
         # load-aware auto-rebalance (ISSUE 8 satellite) knobs, validated
         # up front with the rest: a bad value must not leave spawned
         # worker threads behind when start() raises
@@ -743,9 +807,21 @@ class CompressionService:
         if self.config.metrics_port is not None:
             self._metrics_server = metrics_lib.MetricsServer(
                 self.metrics, self.health,
-                port=self.config.metrics_port).start()
+                port=self.config.metrics_port,
+                trace=self._trace_http).start()
         self._started = True
         return self
+
+    def _trace_http(self, params) -> object:
+        """The /trace endpoint body (ISSUE 11): this process's span
+        ring (`?id=` filters one trace, `?format=chrome` exports the
+        Chrome/Perfetto event dict) plus the flight recorder's event
+        ring and dump bookkeeping."""
+        if params.get("format") == "chrome":
+            return self.tracer.http_snapshot(params)
+        snap = self.tracer.http_snapshot(params)
+        snap["flight"] = self.flight.meta()
+        return snap
 
     def warmup(self) -> dict:
         """Compile every (bucket, device, direction) executable in the
@@ -975,6 +1051,8 @@ class CompressionService:
                 bundle.retire()
             self._swap.abandon_prepare()
             raise
+        self.flight.record("swap_prepared", digest=digest,
+                           ckpt=ckpt_dir)
         return {"digest": digest, "epoch": epoch, "ckpt": ckpt_dir,
                 "warm": warm,
                 "seconds": round(time.monotonic() - t0, 3)}
@@ -1024,7 +1102,25 @@ class CompressionService:
         # sessions are model-versioned: their preps embed the OLD
         # params' ŷ reconstruction — invalidate, clients re-open
         self._invalidate_sessions("swap")
-        return self._swap.snapshot()
+        snap = self._swap.snapshot()
+        self.flight.record("swap_commit", digest=snap["digest"],
+                           prev=snap["prev_digest"])
+        if self._watchdog is not None:
+            # arm the post-swap health comparison (ISSUE 11 satellite):
+            # the supervisor's counter samples provide the pre-window,
+            # its ticks will evaluate the post-window
+            errors, resolved = self._error_counters()
+            self._watchdog.arm(time.monotonic(), snap["digest"],
+                               errors, resolved)
+        return snap
+
+    def _error_counters(self) -> Tuple[int, int]:
+        """(typed errors, total resolutions) — the watchdog's inputs,
+        both counted at ONE place (the per-future _note_resolution
+        callback) so a request can never land in the numerator and
+        denominator a different number of times."""
+        return (self.metrics.counter("serve_typed_errors").value,
+                self.metrics.counter("serve_resolved").value)
 
     def abort_swap(self) -> dict:
         """Discard the staged bundle (or release a dangling prepare
@@ -1033,6 +1129,7 @@ class CompressionService:
         assert self._started, "start() before abort_swap()"
         for b in self._swap.abort():
             b.retire()
+        self.flight.record("swap_abort")
         return self._swap.snapshot()
 
     def swap_model(self, ckpt_dir: str) -> dict:
@@ -1060,8 +1157,15 @@ class CompressionService:
         assert self._started, "start() before rollback()"
         for b in self._swap.rollback(expect_current=expect_current):
             b.retire()
+        if self._watchdog is not None:
+            # a rollback (operator OR watchdog) supersedes any pending
+            # post-swap comparison — never judge a model that already
+            # left
+            self._watchdog.disarm()
         self._invalidate_sessions("rollback")
-        return self._swap.snapshot()
+        snap = self._swap.snapshot()
+        self.flight.record("swap_rollback", digest=snap["digest"])
+        return snap
 
     def _invalidate_sessions(self, reason: str) -> None:
         """Drop every cached SidePrep (the serving params changed — a
@@ -1123,6 +1227,10 @@ class CompressionService:
             if self._metrics_server is not None:
                 self._metrics_server.stop()
                 self._metrics_server = None
+            # stop the flight-dump thread AFTER the pipeline flushed:
+            # typed errors raised by the drain itself still dump
+            self.flight.flush(timeout=5.0)
+            self.flight.close()
         return not alive
 
     def drain(self, timeout: Optional[float] = 30.0) -> bool:
@@ -1194,11 +1302,22 @@ class CompressionService:
         self.metrics.counter(f"serve_shed_{cls}").inc(n)
 
     def _submit(self, request: Request) -> Future:
+        # admission is where a request's TraceContext is minted (ISSUE
+        # 11) — one per request unless the front door already minted
+        # one (the router's context carries ITS sampling decision
+        # across the pipe, which is what stitches a fleet trace)
+        if request.trace is None:
+            request.trace = self.tracer.mint()
+        # the future carries the context so (a) callers can look their
+        # trace up by id and (b) the typed-error resolution callback
+        # can tag the error span — set BEFORE anything can resolve it
+        request.future.trace = request.trace
         # the drain flag flips before the queue actually closes (the
         # close runs on the serve-drain thread) — refuse here too so no
         # request slips into that window
         if self._draining.is_set():
             self.metrics.counter("serve_rejected_drain").inc()
+            self.flight.record("shed", reason="draining")
             raise ServiceDraining("service is draining; not accepting "
                                   "new requests")
         if self._started and self.live_workers == 0:
@@ -1206,6 +1325,7 @@ class CompressionService:
             # request could only hang until its deadline — fail fast and
             # let the client retry elsewhere while the supervisor heals
             self.metrics.counter("serve_rejected_unavailable").inc()
+            self.flight.record("shed", reason="no_workers")
             raise ServiceUnavailable(
                 "no live workers (pool is restarting); retry shortly")
         cls = None
@@ -1219,6 +1339,7 @@ class CompressionService:
                 self._admission.admit(cls)
             except Exception:
                 self.metrics.counter("serve_rejected_overload").inc()
+                self.flight.record("shed", reason="admission", cls=cls)
                 raise
         try:
             self._batcher.submit(request)
@@ -1226,30 +1347,70 @@ class CompressionService:
             if cls is not None:
                 self._admission.release(cls)
             self.metrics.counter("serve_rejected_drain").inc()
+            self.flight.record("shed", reason="draining")
             raise
         except Exception:
             if cls is not None:
                 self._admission.release(cls)
             self.metrics.counter("serve_rejected_overload").inc()
+            self.flight.record("shed", reason="queue_full",
+                               cls=request.priority)
             raise
         if cls is not None:
             # attach AFTER a successful enqueue: resolution (result,
             # shed-as-victim, expiry, drain, crash) frees the slot
             self._admission.attach(cls, request.future)
+        # typed-error visibility (ISSUE 11): ANY typed resolution —
+        # shed-as-victim, expiry, integrity, session loss — counts,
+        # tags the trace, and triggers a flight dump. Attached after
+        # enqueue like the admission slot; an already-resolved future
+        # fires the callback immediately.
+        request.future.add_done_callback(self._note_resolution)
+        self.flight.record("admit", cls=request.priority,
+                           key=str(request.key))
         # counted only once ACCEPTED: submitted - completed must bound
         # the queued+in-flight backlog, so rejections stay out of it
         self.metrics.counter("serve_submitted").inc()
         self.metrics.gauge("serve_queue_depth").set(self._batcher.depth)
         return request.future
 
+    def _note_resolution(self, fut: Future) -> None:
+        """Done-callback on every accepted request: a future resolving
+        with a TYPED error (the ServeError/ValueError/InjectedFault
+        families — IntegrityError and SessionExpired are subclasses)
+        increments `serve_typed_errors` (the rollback watchdog's input
+        signal), records the always-on error span for its trace id, and
+        triggers a flight-recorder dump. May run under the batcher
+        condition (shed/drain resolutions), so everything here is
+        leaf-locked and free of blocking I/O."""
+        exc = fut.exception(timeout=0)
+        # every accepted request resolves exactly once through here —
+        # the denominator the rollback watchdog needs. (serve_completed
+        # cannot serve that role: _note_batch_done counts the whole
+        # batch, so a failed lane would land in BOTH the error
+        # numerator and that denominator and cap a 100%-failure storm's
+        # computed rate at 0.5.)
+        self.metrics.counter("serve_resolved").inc()
+        if exc is None or not isinstance(
+                exc, (ServeError, ValueError, faults.InjectedFault)):
+            return
+        self.metrics.counter("serve_typed_errors").inc()
+        ctx = getattr(fut, "trace", None)
+        self.tracer.error(ctx, exc)
+        self.flight.note_error(
+            exc, trace_id=ctx.trace_id if ctx is not None else None)
+
     def submit_encode(self, img: np.ndarray,
                       deadline_ms: Optional[float] = None,
-                      priority: Optional[str] = None) -> Future:
+                      priority: Optional[str] = None,
+                      trace=None) -> Future:
         """(h, w, 3) uint8/float image -> Future[EncodeResult]. Raises
         ServiceOverloaded/ServiceDraining/NoBucketFits at the door.
         `priority` names a configured traffic class (None = the most
         latency-sensitive one; the class's default deadline applies
-        when `deadline_ms` is None)."""
+        when `deadline_ms` is None). `trace` (ISSUE 11) is a front-door
+        TraceContext whose head sampling decision this service honors;
+        None = mint one here."""
         img = np.asarray(img)
         if img.ndim != 3 or img.shape[-1] != 3:
             raise ValueError(f"expected (h, w, 3) image, got {img.shape}")
@@ -1259,11 +1420,13 @@ class CompressionService:
             img.astype(np.float32, copy=False), bucket)
         return self._submit(Request(
             key=(ENCODE, bucket), payload=(padded, (h, w)),
-            deadline=self._deadline(deadline_ms), priority=priority))
+            deadline=self._deadline(deadline_ms), priority=priority,
+            trace=trace))
 
     def submit_decode(self, blob: bytes,
                       deadline_ms: Optional[float] = None,
-                      priority: Optional[str] = None) -> Future:
+                      priority: Optional[str] = None,
+                      trace=None) -> Future:
         """Framed DSRV stream -> Future[(h, w, 3) uint8 image]. A v2
         frame failing its CRC raises IntegrityError here, at the door."""
         payload, shape, bucket = parse_stream(blob)
@@ -1278,7 +1441,8 @@ class CompressionService:
         return self._submit(Request(
             key=(DECODE, bucket), payload=(payload, shape,
                                            frame_crc(payload)),
-            deadline=self._deadline(deadline_ms), priority=priority))
+            deadline=self._deadline(deadline_ms), priority=priority,
+            trace=trace))
 
     # -- side-information sessions (ISSUE 10) ---------------------------------
 
@@ -1339,7 +1503,8 @@ class CompressionService:
 
     def submit_decode_si(self, blob: bytes, session_id: str,
                          deadline_ms: Optional[float] = None,
-                         priority: Optional[str] = None) -> Future:
+                         priority: Optional[str] = None,
+                         trace=None) -> Future:
         """Framed DSRV stream + open session -> Future[(h, w, 3) uint8
         SI-fused reconstruction]. The session is validated (and its LRU
         recency refreshed) at the door — a gone session raises typed
@@ -1365,7 +1530,7 @@ class CompressionService:
             key=(DECODE_SI, bucket), payload=(payload, shape,
                                               frame_crc(payload)),
             deadline=self._deadline(deadline_ms), priority=priority,
-            session=session_id))
+            session=session_id, trace=trace))
 
     def decode_si(self, blob: bytes, session_id: str,
                   deadline_ms: Optional[float] = None,
@@ -1380,6 +1545,7 @@ class CompressionService:
         concurrent eviction cannot tear the search. A session that
         outlived its slot (LRU/TTL) or its model (hot swap landed since
         the prep was built) fails the whole batch typed."""
+        t0 = time.monotonic()
         entry = self._sessions.get(batch[0].session)
         if entry.digest != bundle.digest:
             self._sessions.evict(batch[0].session, "swap")
@@ -1387,6 +1553,9 @@ class CompressionService:
                 f"session {batch[0].session!r} was prepared against "
                 f"model {entry.digest} but {bundle.digest} is serving "
                 f"(hot swap/rollback since) — re-open it")
+        self.tracer.span_batch(batch, trace_lib.SPAN_SESSION, t0,
+                               time.monotonic(),
+                               session=batch[0].session)
         return entry
 
     def encode(self, img: np.ndarray, deadline_ms: Optional[float] = None,
@@ -1518,14 +1687,22 @@ class CompressionService:
                         continue
                     if self._restart_at[i] is None:
                         # first observation of this death: schedule the
-                        # restart after the slot's current backoff
+                        # restart after the slot's current backoff —
+                        # and dump the flight ring (the "what happened
+                        # just before the worker died" artifact)
                         self._restart_at[i] = now + self._restart_policy \
                             .delay(self._restarts[i])
+                        self.flight.note_death(
+                            "worker_death", slot=i,
+                            error=type(self._worker_exits.get(i)).__name__
+                            if self._worker_exits.get(i) else None)
                     elif now >= self._restart_at[i]:
                         self._restarts[i] += 1
                         self._restart_at[i] = None
                         self._workers[i] = self._spawn_worker(i)
                         self.metrics.counter("serve_worker_restarts").inc()
+                        self.flight.record("worker_restart", slot=i,
+                                           restarts=self._restarts[i])
                         live += 1
             self.metrics.gauge("serve_workers_live").set(live)
             if (self._rebalance_trigger is not None
@@ -1540,8 +1717,36 @@ class CompressionService:
                     # self-healing outranks the opt-in rebalance
                     self.metrics.counter(
                         "serve_auto_rebalance_errors").inc()
+            if self._watchdog is not None:
+                self._watchdog_tick(now)
             self._draining.wait(self.config.supervise_every_s)
         self.metrics.gauge("serve_workers_live").set(self.live_workers)
+
+    def _watchdog_tick(self, now: float) -> None:
+        """One rollback-watchdog step on the supervisor thread (ISSUE
+        11 satellite): feed the counter sample, and when an armed
+        post-swap comparison resolves against the new model, roll back
+        CONDITIONALLY (expect_current pins the judged digest, so a
+        watchdog racing an operator rollback refuses typed instead of
+        double-flipping). The verdict is computed outside every lock;
+        rollback itself is the O(1) pointer swap."""
+        errors, resolved = self._error_counters()
+        self._watchdog.sample(now, errors, resolved)
+        verdict = self._watchdog.evaluate(now, errors, resolved)
+        if verdict is None:
+            return
+        self.flight.record("watchdog_verdict", **verdict)
+        if not verdict["fire"]:
+            return
+        try:
+            self.rollback(expect_current=verdict["digest"])
+        except swap_lib.SwapError:
+            # the judged model already left (operator rollback / second
+            # swap won the race) — nothing to protect against anymore
+            self.metrics.counter("serve_watchdog_refused").inc()
+            return
+        self.metrics.counter("serve_watchdog_rollbacks").inc()
+        self.flight.note_death("watchdog_rollback", **verdict)
 
     def _auto_rebalance_tick(self, now: float) -> None:
         """One skew check on the supervisor thread (single-threaded use
@@ -1617,6 +1822,18 @@ class CompressionService:
         # hot swap landing mid-batch cannot tear it (serve/swap.py)
         bundle = self._swap.current
         t0 = time.monotonic()
+        # batch formation is where queue wait ENDS: one queue.wait span
+        # per sampled request (each has its own arrival), and an
+        # always-on batch-seal flight event
+        if self.tracer.enabled:
+            for r in batch:
+                ctx = r.trace
+                if ctx is not None and ctx.sampled:
+                    self.tracer.record(trace_lib.SPAN_QUEUE, r.arrival,
+                                       t0, [ctx.trace_id],
+                                       cls=r.priority)
+        self.flight.record("batch_seal", op=kind, bucket=list(bucket),
+                           size=len(batch), device=device)
         self.metrics.gauge("serve_queue_depth").set(self._batcher.depth)
         self.metrics.histogram("serve_batch_occupancy").observe(
             len(batch) / self.config.max_batch)
@@ -1770,16 +1987,22 @@ class CompressionService:
             self.metrics.counter("serve_entropy_proc_rebuilds").inc()
         seen.shutdown(wait=False)                # idempotent
 
-    def _encode_vols(self, bundle, vols) -> list:
+    def _encode_vols(self, bundle, vols, trace=None) -> list:
         """N (D, H, W) symbol volumes -> [(payload, None) |
         (None, exc)] per lane (loader.encode_batch_isolated's
         contract on both backends), one batch call on the configured
         backend — always against the BATCH's bundle, never the live
-        pointer (hot-swap coherence)."""
+        pointer (hot-swap coherence). `trace` (sampled TraceContexts)
+        rides the process-backend task and comes back as a bit-checked
+        echo with the child-side coding span (ISSUE 11)."""
         from dsin_tpu.coding import loader as loader_lib
         if bundle.proc_initargs is not None:
-            return self._proc_call(bundle, loader_lib.worker_encode_batch,
-                                   vols)
+            out = self._proc_call(bundle, loader_lib.worker_encode_batch,
+                                  vols, trace)
+            if trace is not None:
+                out, echo = out
+                self._note_proc_echo(trace, echo)
+            return out
         return loader_lib.encode_batch_isolated(self._thread_codec(bundle),
                                                 vols)
 
@@ -1791,12 +2014,34 @@ class CompressionService:
         from dsin_tpu.coding import loader as loader_lib
         return loader_lib.decode_batch_isolated(codec, payloads)
 
-    def _decode_payloads(self, bundle, payloads) -> list:
+    def _decode_payloads(self, bundle, payloads, trace=None) -> list:
         if bundle.proc_initargs is not None:
             from dsin_tpu.coding import loader as loader_lib
-            return self._proc_call(bundle, loader_lib.worker_decode_batch,
-                                   payloads)
+            out = self._proc_call(bundle, loader_lib.worker_decode_batch,
+                                  payloads, trace)
+            if trace is not None:
+                out, echo = out
+                self._note_proc_echo(trace, echo)
+            return out
         return self._decode_with(self._thread_codec(bundle), payloads)
+
+    def _note_proc_echo(self, sent, echo: dict) -> None:
+        """Process-backend trace echo (ISSUE 11): bit-check the
+        contexts that rode the pool task against what came back —
+        serialization must be lossless for ids to stitch — and record
+        the child-side coding span (pid + coding_ms measured in the
+        worker process, positioned at the bridge-side receive)."""
+        back = echo.get("trace")
+        if tuple(back or ()) != tuple(sent):
+            # a mangled context cannot corrupt results (the lanes ride
+            # separately) but it breaks stitching — surface it loudly
+            self.metrics.counter("serve_trace_proc_mismatch").inc()
+            return
+        t1 = time.monotonic()
+        t0 = t1 - echo.get("coding_ms", 0.0) / 1e3
+        self.tracer.record(trace_lib.SPAN_ENTROPY_PROC, t0, t1,
+                           [c.trace_id for c in sent],
+                           pid=echo.get("pid"))
 
     def _decode_batch_lanes(self, batch, sym, decode, fail) -> None:
         """One micro-batch's decode-side entropy work under the
@@ -1856,12 +2101,22 @@ class CompressionService:
             if self._entropy_hook is not None:
                 for i, req in enumerate(rec.batch):
                     self._entropy_hook(rec, i, req)
+            trace = self.tracer.sampled_tuple(rec.batch)
             if rec.kind == ENCODE:
                 symbols = rec.handle.host()   # shared one-time transfer
+                # the encode device span ends at the shared transfer:
+                # the same dispatched->transfer_done instants the
+                # device_ms metric integrates (cross-check contract)
+                self.tracer.span_batch(
+                    rec.batch, trace_lib.SPAN_DEVICE,
+                    rec.handle.dispatched, rec.handle.transfer_done,
+                    kind=rec.kind, bucket=list(rec.bucket),
+                    device=rec.device)
                 te0 = time.monotonic()
                 vols = [np.transpose(symbols[i], (2, 0, 1))
                         for i in range(len(rec.batch))]
-                payloads = self._encode_vols(rec.bundle, vols)
+                payloads = self._encode_vols(rec.bundle, vols,
+                                             trace=trace)
                 te1 = time.monotonic()
                 for i, req in enumerate(rec.batch):
                     payload, exc = payloads[i]
@@ -1883,7 +2138,8 @@ class CompressionService:
                 te0 = time.monotonic()
                 self._decode_batch_lanes(
                     rec.batch, rec.sym,
-                    lambda p: self._decode_payloads(rec.bundle, p),
+                    lambda p: self._decode_payloads(rec.bundle, p,
+                                                    trace=trace),
                     lambda i, req, e: self._item_failed(rec, i, req, e))
                 te1 = time.monotonic()
         except BaseException as e:  # noqa: BLE001 — answer every caller
@@ -1895,6 +2151,9 @@ class CompressionService:
         if te0 is not None and te1 is not None:
             self.metrics.histogram("serve_entropy_batch_ms").observe(
                 (te1 - te0) * 1e3)
+            self.tracer.span_batch(rec.batch, trace_lib.SPAN_ENTROPY,
+                                   te0, te1, kind=rec.kind,
+                                   backend=self.config.entropy_backend)
         return (te0, te1)
 
     def _finish_batch(self, rec: _Inflight) -> None:
@@ -1920,10 +2179,22 @@ class CompressionService:
                     params, bs, sym_dev, rec.si_entry.prep))
             else:
                 imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
-            device_ms = (time.monotonic() - t_dev) * 1e3
+            t_dev_end = time.monotonic()
+            device_ms = (t_dev_end - t_dev) * 1e3
+            self.tracer.span_batch(rec.batch, trace_lib.SPAN_DEVICE,
+                                   t_dev, t_dev_end, kind=rec.kind,
+                                   bucket=list(rec.bucket),
+                                   device=rec.device)
             if rec.kind == DECODE_SI:
                 self.metrics.histogram("serve_si_search_ms").observe(
                     device_ms)
+                # the SI device stage IS the fused search executable:
+                # record it under its own name too, so an SI trace
+                # reads decode->search->siNet at a glance and the
+                # bench can cross-check serve_si_search_ms
+                self.tracer.span_batch(
+                    rec.batch, trace_lib.SPAN_SI_SEARCH, t_dev,
+                    t_dev_end, session=rec.batch[0].session)
             for i, r in enumerate(rec.batch):
                 if i in rec.per_item_exc:
                     continue       # its future already holds the error
@@ -2026,7 +2297,15 @@ class CompressionService:
                 bpp=len(payload) * 8.0 / (h * w),
                 shape=(h, w), bucket=bucket,
                 model_digest=bundle.digest))
-        return ((t_ent - t_dev) * 1e3, (time.monotonic() - t_ent) * 1e3)
+        t_done = time.monotonic()
+        # spans share the exact instants the stage metrics integrate
+        # (the serve_bench cross-check holds them to each other)
+        self.tracer.span_batch(batch, trace_lib.SPAN_DEVICE, t_dev,
+                               t_ent, kind=ENCODE, bucket=list(bucket),
+                               device=device)
+        self.tracer.span_batch(batch, trace_lib.SPAN_ENTROPY, t_ent,
+                               t_done, kind=ENCODE, backend="inline")
+        return ((t_ent - t_dev) * 1e3, (t_done - t_ent) * 1e3)
 
     def _run_decode(self, batch, bucket, device: int, bundle,
                     si: bool = False) -> Tuple[float, float]:
@@ -2053,7 +2332,11 @@ class CompressionService:
         self._decode_batch_lanes(
             batch, sym, lambda p: self._decode_with(bundle.codec, p),
             _fail)
-        entropy_ms = (time.monotonic() - t_ent) * 1e3
+        t_ent_end = time.monotonic()
+        entropy_ms = (t_ent_end - t_ent) * 1e3
+        self.tracer.span_batch(batch, trace_lib.SPAN_ENTROPY, t_ent,
+                               t_ent_end, kind=batch[0].key[0],
+                               backend="inline")
         if len(per_item_exc) == len(batch):
             # whole batch failed before the device stage: decoding a
             # zero tensor would be pure wasted device work — answer the
@@ -2070,10 +2353,17 @@ class CompressionService:
                                                   si_entry.prep))
         else:
             imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
-        device_ms = (time.monotonic() - t_dev) * 1e3
+        t_dev_end = time.monotonic()
+        device_ms = (t_dev_end - t_dev) * 1e3
+        self.tracer.span_batch(batch, trace_lib.SPAN_DEVICE, t_dev,
+                               t_dev_end, kind=batch[0].key[0],
+                               bucket=list(bucket), device=device)
         if si:
             self.metrics.histogram("serve_si_search_ms").observe(
                 device_ms)
+            self.tracer.span_batch(batch, trace_lib.SPAN_SI_SEARCH,
+                                   t_dev, t_dev_end,
+                                   session=batch[0].session)
         for i, r in enumerate(batch):
             if i in per_item_exc:
                 r.future.set_exception(per_item_exc[i])

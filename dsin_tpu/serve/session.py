@@ -99,7 +99,7 @@ class SessionStore:
 
     def __init__(self, max_sessions: int, max_bytes: int,
                  ttl_s: Optional[float] = None, metrics=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, flight=None):
         if max_sessions < 1:
             raise ValueError(f"max_sessions must be >= 1, "
                              f"got {max_sessions}")
@@ -111,6 +111,11 @@ class SessionStore:
         self.max_bytes = int(max_bytes)
         self.ttl_s = ttl_s
         self.metrics = metrics
+        #: optional serve/trace.py FlightRecorder: evictions are exactly
+        #: the "why did my session vanish" events an incident timeline
+        #: needs (its ring lock ranks above serve.session, so recording
+        #: from under this store's lock is legal)
+        self.flight = flight
         self._clock = clock
         self._lock = locks_lib.RankedLock("serve.session")
         # insertion/recency order: first = least recently used
@@ -149,6 +154,9 @@ class SessionStore:
             return False
         self._bytes -= slot.entry.nbytes
         self._note_eviction(reason)
+        if self.flight is not None:
+            self.flight.record("session_evict", sid=sid, reason=reason,
+                               bucket=list(slot.entry.bucket))
         return True
 
     def _sweep_ttl_locked(self, now: float) -> None:
@@ -227,6 +235,9 @@ class SessionStore:
             self._slots.clear()
             self._bytes = 0
             self._note_eviction(reason, n)
+            if self.flight is not None and n:
+                self.flight.record("sessions_cleared", reason=reason,
+                                   count=n)
             self._publish_locked()
             return n
 

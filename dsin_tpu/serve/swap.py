@@ -44,6 +44,7 @@ pointer swap, abort = discard staged, rollback = swap back to prev.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from dsin_tpu.utils import locks as locks_lib
@@ -320,3 +321,117 @@ class SwapCoordinator:
         self.metrics.counter("serve_rollbacks").inc()
         self._publish_locked_out(snap)
         return []
+
+
+class RollbackWatchdog:
+    """Post-swap automatic rollback trigger (ISSUE 11 satellite; the
+    ROADMAP elastic-fleet item PR 9 deferred).
+
+    The one health signal a just-committed model cannot fake is its
+    typed-error rate against live traffic. The watchdog keeps a short
+    sliding window of (time, typed_errors, resolved) counter samples —
+    the supervisor feeds it one sample per tick — and on every
+    `commit_swap` ARMS a comparison: the typed-error rate over the
+    `window_s` BEFORE the commit (the old model's baseline) versus the
+    rate over the first `min_requests`-plus resolutions AFTER it. Once
+    the post window has both elapsed and seen enough traffic to judge,
+    `evaluate` returns a verdict exactly once; a post-minus-pre rate
+    jump beyond `threshold` tells the service to call
+    `rollback(expect_current=<committed digest>)` — CONDITIONAL, so a
+    watchdog racing an operator who already rolled back refuses typed
+    instead of double-flipping models.
+
+    Pure bookkeeping: this class never touches the swap coordinator or
+    metrics itself — the service samples the counters, and acts on the
+    verdict OUTSIDE this object's lock (the `serve.watchdog` rank sits
+    below `serve.workers`, and rollback's `serve.model` acquisition
+    must never nest under it)."""
+
+    def __init__(self, window_s: float, threshold: float,
+                 min_requests: int):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        if min_requests < 1:
+            raise ValueError(f"min_requests must be >= 1, "
+                             f"got {min_requests}")
+        self.window_s = float(window_s)
+        self.threshold = float(threshold)
+        self.min_requests = int(min_requests)
+        self._lock = locks_lib.RankedLock("serve.watchdog")
+        # (t, typed_errors, resolved) samples, oldest first
+        self._samples: deque = deque()   # guarded-by: self._lock
+        self._armed: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+
+    @staticmethod
+    def _rate(errors: int, resolved: int) -> float:
+        return (errors / resolved) if resolved > 0 else 0.0
+
+    def sample(self, now: float, typed_errors: int, resolved: int) -> None:
+        """One supervisor-tick counter observation; old samples beyond
+        2x the window age out (bounded memory at any tick rate)."""
+        with self._lock:
+            self._samples.append((now, typed_errors, resolved))
+            horizon = now - 2.0 * self.window_s
+            while len(self._samples) > 1 and self._samples[0][0] < horizon:
+                self._samples.popleft()
+
+    def arm(self, now: float, digest: str, typed_errors: int,
+            resolved: int) -> None:
+        """Called at commit: pin the committed digest, the post-window
+        baseline counters, and the PRE-swap error rate computed from
+        the sample window ending now."""
+        with self._lock:
+            base_t, base_e, base_r = now, typed_errors, resolved
+            # oldest sample still inside the pre window = the baseline
+            pre_e = pre_r = 0
+            for t, e, r in self._samples:
+                if t >= now - self.window_s:
+                    pre_e, pre_r = typed_errors - e, resolved - r
+                    break
+            self._armed = {
+                "digest": digest,
+                "t_commit": base_t,
+                "base_errors": base_e,
+                "base_resolved": base_r,
+                "pre_rate": self._rate(pre_e, pre_r),
+            }
+
+    def disarm(self) -> None:
+        """Manual swap/rollback supersedes a pending comparison."""
+        with self._lock:
+            self._armed = None
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return self._armed is not None
+
+    def evaluate(self, now: float, typed_errors: int,
+                 resolved: int) -> Optional[Dict[str, Any]]:
+        """The post-window judgement, returned at most once per arm:
+        None while the window is still open or the post-commit traffic
+        is below `min_requests` (too little evidence to roll back a
+        model over); else {"fire", "pre_rate", "post_rate", "digest"}
+        and the watchdog disarms."""
+        with self._lock:
+            armed = self._armed
+            if armed is None:
+                return None
+            if now < armed["t_commit"] + self.window_s:
+                return None
+            post_resolved = resolved - armed["base_resolved"]
+            if post_resolved < self.min_requests:
+                return None
+            post_rate = self._rate(typed_errors - armed["base_errors"],
+                                   post_resolved)
+            self._armed = None
+        return {
+            "fire": post_rate - armed["pre_rate"] > self.threshold,
+            "pre_rate": round(armed["pre_rate"], 4),
+            "post_rate": round(post_rate, 4),
+            "post_resolved": post_resolved,
+            "digest": armed["digest"],
+            "window_s": self.window_s,
+        }

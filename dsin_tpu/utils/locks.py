@@ -37,6 +37,8 @@ a thread holding rank r may only acquire ranks > r):
                               (serve/session.py)
       17  serve.model         live/prev/staged model-bundle pointers for
                               the hot-swap state machine (serve/swap.py)
+      18  serve.watchdog      post-swap rollback-watchdog sample window
+                              (serve/swap.py RollbackWatchdog)
       20  serve.workers       worker-pool bookkeeping (serve/service.py)
       25  serve.entropy_proc  process-pool slot / child-death rebuild (serve/service.py)
       30  codec.engine        lazy incremental-engine slot (coding/codec.py)
@@ -47,6 +49,14 @@ a thread holding rank r may only acquire ranks > r):
       60  faults.plan         fault-plan bookkeeping (utils/faults.py)
       70  recompile.counter   XLA compile listener (utils/recompile.py)
       80  metrics.registry    metric-name namespace (serve/metrics.py)
+      85  serve.trace         trace-span / flight-recorder rings
+                              (serve/trace.py) — near-leaf so every
+                              layer can record events while holding its
+                              own lock (the batcher resolves shed
+                              victims whose callbacks record here), yet
+                              the recorders can still bump metric
+                              counters (rank 90). Ring and meta locks
+                              share the rung and are never nested.
       90  metrics.metric      per-metric leaf locks (serve/metrics.py)
 
 The leaf rungs are deliberately the metrics locks: every layer reports
@@ -82,6 +92,7 @@ HIERARCHY: Dict[str, int] = {
     "serve.placement": 15,
     "serve.session": 16,
     "serve.model": 17,
+    "serve.watchdog": 18,
     "serve.workers": 20,
     "serve.entropy_proc": 25,
     "codec.engine": 30,
@@ -92,6 +103,7 @@ HIERARCHY: Dict[str, int] = {
     "faults.plan": 60,
     "recompile.counter": 70,
     "metrics.registry": 80,
+    "serve.trace": 85,
     "metrics.metric": 90,
 }
 

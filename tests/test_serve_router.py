@@ -148,7 +148,9 @@ class _Fakes:
                 except OSError:
                     pass
                 return
-            op, rid, payload, priority, deadline_ms = msg
+            # request messages carry a trailing TraceContext since
+            # ISSUE 11; control ops remain 5-tuples
+            op, rid, payload, priority, deadline_ms = msg[:5]
             if op == "swap_prepare":
                 self.got_prepare[idx].set()
                 if self.hang_prepare[idx]:

@@ -384,7 +384,9 @@ class _SessionFakes:
                 except OSError:
                     pass
                 return
-            op, rid, payload, priority, deadline_ms = msg
+            # request messages carry a trailing TraceContext since
+            # ISSUE 11; control/session ops remain 5-tuples
+            op, rid, payload, priority, deadline_ms = msg[:5]
             if op == "session_open":
                 n_sids += 1
                 sid = f"r{idx}-s{n_sids}"

@@ -1,0 +1,540 @@
+"""ISSUE 11: end-to-end request tracing + flight recorder.
+
+Unit layer: TraceContext bit-identity across every serialization
+boundary the serve stack uses (pickle, a real multiprocessing pipe, a
+real spawn process pool), deterministic head sampling, ring
+overwrite/concurrency under forced interleavings (the locks acquire
+hook), typed-error flight dumps per error family, the rollback
+watchdog's windowed verdicts, and the snapshot freshness satellite
+(seq + captured_at; AggregatedMetrics flags stale replicas).
+
+Integration layer: one traced SI-enabled service — per-op span
+taxonomy, the /trace HTTP endpoint, budget-0 over a mixed SI/non-SI
+stream WITH tracing enabled (the acceptance pin: spans wrap dispatch,
+never jitted code), and a typed error auto-dumping a JSONL timeline.
+"""
+
+import json
+import multiprocessing
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import metrics as metrics_lib
+from dsin_tpu.serve import trace as trace_lib
+from dsin_tpu.serve.batcher import (DeadlineExceeded, ServiceDraining,
+                                    ServiceOverloaded,
+                                    ServiceUnavailable)
+from dsin_tpu.serve.session import SessionExpired
+from dsin_tpu.serve.swap import RollbackWatchdog
+from dsin_tpu.serve.trace import FlightRecorder, TraceContext, Tracer
+from dsin_tpu.utils import locks as locks_lib
+from dsin_tpu.utils.faults import InjectedFault
+from dsin_tpu.utils.integrity import IntegrityError
+
+
+# -- context propagation bit-checks -------------------------------------------
+
+def test_context_pickle_bit_check():
+    ctx = TraceContext("t1234-00000007", True, "router")
+    back = pickle.loads(pickle.dumps(ctx))
+    assert back == ctx
+    assert (back.trace_id, back.sampled, back.origin) == \
+        ("t1234-00000007", True, "router")
+
+
+def test_context_across_replica_pipe_bit_check():
+    """The exact transport the front door uses: a request tuple with
+    the trailing TraceContext through a real multiprocessing duplex
+    pipe (Connection pickling, not in-process object passing)."""
+    ctx = TraceContext("tabc-0000002a", True, "router")
+    parent, child = multiprocessing.Pipe(duplex=True)
+    try:
+        msg = ("decode_si", 7, (b"blob", "sess-1"), "interactive",
+               123.5, ctx)
+        parent.send(msg)
+        got = child.recv()
+        assert got[:5] == msg[:5]
+        assert got[5] == ctx
+        # the 5-tuple control form stays decodable (back-compat)
+        parent.send(("swap_abort", 8, None, None, None))
+        got = child.recv()
+        op, rid, payload, priority, deadline_ms = got[:5]
+        assert (got[5] if len(got) > 5 else None) is None
+        assert op == "swap_abort"
+    finally:
+        parent.close()
+        child.close()
+
+
+def test_context_through_spawn_process_pool_bit_check():
+    """The process entropy backend's boundary: a REAL spawn child
+    echoes the context; equality after the round trip is the
+    serialization contract the stitched trace relies on."""
+    from concurrent.futures import ProcessPoolExecutor
+    ctx = TraceContext("tdef-000000ff", True, "service")
+    with ProcessPoolExecutor(
+            max_workers=1,
+            mp_context=multiprocessing.get_context("spawn")) as pool:
+        assert pool.submit(trace_lib.echo_context, ctx).result(60) == ctx
+
+
+def test_worker_batch_trace_echo(monkeypatch):
+    """loader.worker_encode_batch ships the trace tuple with the task
+    and echoes it back bit-identical alongside the child-side coding
+    time — the parent's _note_proc_echo bit-checks exactly this."""
+    from dsin_tpu.coding import loader as loader_lib
+
+    class _StubCodec:
+        def encode_batch(self, vols):
+            return [b"p%d" % i for i in range(len(vols))]
+
+        def decode_batch(self, payloads):
+            return [np.zeros((1, 2, 3), np.int32) for _ in payloads]
+
+    monkeypatch.setattr(loader_lib, "_worker_codec", _StubCodec())
+    ctxs = (TraceContext("tx-1", True), TraceContext("tx-2", True))
+    # untraced call keeps the PR 7 contract: a bare lane list
+    lanes = loader_lib.worker_encode_batch([np.zeros((1, 2, 3))] * 2)
+    assert [p for p, e in lanes] == [b"p0", b"p1"]
+    lanes, echo = loader_lib.worker_encode_batch(
+        [np.zeros((1, 2, 3))] * 2, trace=ctxs)
+    assert [p for p, e in lanes] == [b"p0", b"p1"]
+    assert tuple(echo["trace"]) == ctxs
+    assert echo["pid"] == os.getpid()
+    assert echo["coding_ms"] >= 0.0
+    _lanes, echo = loader_lib.worker_decode_batch([b"x"], trace=ctxs)
+    assert tuple(echo["trace"]) == ctxs
+
+
+# -- sampling -----------------------------------------------------------------
+
+def test_mint_deterministic_head_sampling():
+    tr = Tracer(sample_rate=0.5, capacity=8)
+    flags = [tr.mint().sampled for _ in range(8)]
+    assert flags == [False, True] * 4   # counter rotation, no RNG
+    tr0 = Tracer(sample_rate=0.0, capacity=8)
+    assert not any(tr0.mint().sampled for _ in range(8))
+    tr1 = Tracer(sample_rate=1.0, capacity=8)
+    assert all(tr1.mint().sampled for _ in range(8))
+    ids = {tr1.mint().trace_id for _ in range(16)}
+    assert len(ids) == 16, "trace ids must be unique"
+
+
+def test_mint_disabled_returns_none_and_validation():
+    tr = Tracer(sample_rate=1.0, enabled=False)
+    assert tr.mint() is None
+    with pytest.raises(ValueError, match="sample_rate"):
+        Tracer(sample_rate=1.5)
+    with pytest.raises(ValueError, match="capacity"):
+        Tracer(sample_rate=0.5, capacity=0)
+
+
+def test_forwarded_context_recorded_regardless_of_local_rate():
+    """A front-door-sampled context must produce spans in a replica
+    whose own rate is 0 — the stitching contract."""
+    tr = Tracer(sample_rate=0.0, capacity=8)
+    ctx = TraceContext("remote-1", True, "router")
+
+    class _Req:
+        trace = ctx
+
+    tr.span_batch([_Req()], "batch.device", 0.0, 0.001)
+    snap = tr.snapshot(trace_id="remote-1")
+    assert [s["name"] for s in snap["spans"]] == ["batch.device"]
+
+
+# -- ring behavior ------------------------------------------------------------
+
+def test_ring_overwrites_oldest():
+    tr = Tracer(sample_rate=1.0, capacity=4)
+    for i in range(7):
+        tr.record(f"s{i}", 0.0, 0.001, [f"t{i}"])
+    snap = tr.snapshot()
+    assert snap["recorded"] == 7 and snap["dropped"] == 3
+    assert [s["name"] for s in snap["spans"]] == ["s3", "s4", "s5", "s6"]
+
+
+def test_concurrent_append_forced_interleaving():
+    """Two threads racing the ring's `serve.trace` lock under the
+    deterministic acquire hook: thread A is parked AT the lock until
+    thread B's span landed — both spans must be present, B's first."""
+    tr = Tracer(sample_rate=1.0, capacity=8)
+    b_done = threading.Event()
+    a_at_lock = threading.Event()
+
+    def hook(lock):
+        if lock.name != "serve.trace":
+            return
+        if threading.current_thread().name == "trace-a":
+            a_at_lock.set()
+            assert b_done.wait(5), "thread B never recorded"
+
+    prev = locks_lib.set_acquire_hook(hook)
+    try:
+        def run_a():
+            tr.record("from-a", 0.0, 0.001, ["a"])
+
+        def run_b():
+            assert a_at_lock.wait(5)
+            tr.record("from-b", 0.0, 0.001, ["b"])
+            b_done.set()
+
+        ta = threading.Thread(target=run_a, name="trace-a")
+        tb = threading.Thread(target=run_b, name="trace-b")
+        ta.start()
+        tb.start()
+        ta.join(5)
+        tb.join(5)
+    finally:
+        locks_lib.set_acquire_hook(prev)
+    names = [s["name"] for s in tr.snapshot()["spans"]]
+    assert names == ["from-b", "from-a"]
+    assert tr.snapshot()["recorded"] == 2
+
+
+def test_error_span_always_recorded_even_unsampled():
+    tr = Tracer(sample_rate=0.0, capacity=8)
+    ctx = tr.mint()
+    assert ctx is not None and not ctx.sampled
+    tr.error(ctx, ServiceOverloaded("full", priority="bulk", depth=3))
+    spans = tr.snapshot(trace_id=ctx.trace_id)["spans"]
+    assert [s["name"] for s in spans] == ["error"]
+    assert spans[0]["args"]["error"] == "ServiceOverloaded"
+
+
+def test_snapshot_filters_by_batch_membership_and_chrome_export(tmp_path):
+    tr = Tracer(sample_rate=1.0, capacity=8)
+    tr.record("batch.device", 0.0, 0.002, ["t-a", "t-b"], device=0)
+    tr.record("queue.wait", 0.0, 0.001, ["t-b"])
+    assert {s["name"] for s in tr.snapshot("t-a")["spans"]} == \
+        {"batch.device"}
+    assert {s["name"] for s in tr.snapshot("t-b")["spans"]} == \
+        {"batch.device", "queue.wait"}
+    chrome = trace_lib.chrome_trace(tr.snapshot()["spans"])
+    assert len(chrome["traceEvents"]) == 2
+    ev = chrome["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["dur"] > 0
+    assert ev["args"]["trace_ids"] == ["t-a", "t-b"]
+    out = tmp_path / "chrome.json"
+    assert tr.dump_chrome(str(out)) == 2
+    assert len(json.loads(out.read_text())["traceEvents"]) == 2
+
+
+def test_stage_totals_sum_span_durations():
+    tr = Tracer(sample_rate=1.0, capacity=8)
+    tr.record("batch.device", 0.0, 0.002, ["a"])
+    tr.record("batch.device", 0.0, 0.003, ["b"])
+    tr.record("batch.entropy", 0.0, 0.001, ["a"])
+    totals = tr.stage_totals_ms()
+    assert totals["batch.device"] == pytest.approx(5.0, abs=0.01)
+    assert totals["batch.entropy"] == pytest.approx(1.0, abs=0.01)
+
+
+# -- flight recorder ----------------------------------------------------------
+
+#: every typed-error family a request future can resolve with — each
+#: must trigger a non-empty dump (the ISSUE 11 test satellite)
+TYPED_FAMILIES = [
+    ServiceOverloaded("queue full", priority="bulk", depth=9),
+    DeadlineExceeded("expired", priority="interactive"),
+    ServiceDraining("draining"),
+    ServiceUnavailable("no workers"),
+    IntegrityError("CRC mismatch"),
+    SessionExpired("session gone"),
+    InjectedFault("chaos"),
+]
+
+
+@pytest.mark.parametrize("exc", TYPED_FAMILIES,
+                         ids=lambda e: type(e).__name__)
+def test_flight_dump_per_typed_error_family(tmp_path, exc):
+    fr = FlightRecorder(capacity=16, dump_dir=str(tmp_path),
+                        min_dump_interval_s=0.0)
+    fr.record("admit", cls="bulk")
+    fr.note_error(exc, trace_id="t-err")
+    assert fr.flush(timeout=10)
+    meta = fr.meta()
+    assert meta["dumps"] == 1 and meta["last_dump_path"]
+    lines = [json.loads(ln) for ln in
+             open(meta["last_dump_path"]).read().splitlines()]
+    assert lines[0]["kind"] == "_dump"
+    assert lines[0]["reason"] == "typed_error"
+    kinds = [ln["kind"] for ln in lines[1:]]
+    assert kinds == ["admit", "typed_error"]
+    assert lines[-1]["error"] == type(exc).__name__
+    assert lines[-1]["trace_id"] == "t-err"
+    fr.close()
+
+
+def test_flight_without_dir_records_ring_only():
+    fr = FlightRecorder(capacity=4)
+    fr.note_error(ServiceDraining("x"))
+    fr.note_death("worker_death", slot=1)
+    assert fr.meta()["dumps"] == 0
+    kinds = [e["kind"] for e in fr.snapshot()]
+    assert kinds == ["typed_error", "worker_death"]
+    fr.close()
+
+
+def test_flight_dump_rate_limit_coalesces(tmp_path):
+    fr = FlightRecorder(capacity=64, dump_dir=str(tmp_path),
+                        min_dump_interval_s=0.15)
+    for i in range(10):
+        fr.note_error(InjectedFault(f"e{i}"))
+    assert fr.flush(timeout=10)
+    meta = fr.meta()
+    # a storm coalesces: far fewer dumps than triggers, every trigger
+    # satisfied, and the LAST dump covers the whole storm
+    assert 1 <= meta["dumps"] < 10
+    assert meta["pending"] == 0
+    lines = open(meta["last_dump_path"]).read().splitlines()
+    assert sum(1 for ln in lines
+               if json.loads(ln).get("kind") == "typed_error") == 10
+    fr.close()
+
+
+def test_flight_death_trigger_and_disabled(tmp_path):
+    fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path),
+                        min_dump_interval_s=0.0)
+    fr.note_death("replica_death", replica=2)
+    assert fr.flush(timeout=10) and fr.meta()["dumps"] == 1
+    fr.set_enabled(False)
+    fr.note_error(InjectedFault("ignored"))
+    assert fr.meta()["dumps"] == 1
+    assert all(e["kind"] != "typed_error" for e in fr.snapshot())
+    fr.close()
+
+
+# -- rollback watchdog --------------------------------------------------------
+
+def test_watchdog_fires_on_error_rate_jump():
+    wd = RollbackWatchdog(window_s=1.0, threshold=0.3, min_requests=4)
+    # healthy pre window: 100 resolutions, 2 typed errors
+    wd.sample(0.0, 0, 0)
+    wd.sample(9.5, 2, 100)
+    wd.arm(10.0, "digest-b", 2, 100)
+    assert wd.armed
+    # window not yet elapsed -> no verdict
+    assert wd.evaluate(10.5, 4, 104) is None
+    # elapsed but too little traffic -> keep waiting
+    assert wd.evaluate(11.1, 3, 102) is None
+    v = wd.evaluate(11.2, 10, 108)
+    assert v is not None and v["fire"] is True
+    assert v["digest"] == "digest-b"
+    assert v["post_rate"] == 1.0
+    assert not wd.armed, "verdict is returned exactly once"
+    assert wd.evaluate(12.0, 20, 110) is None
+
+
+def test_watchdog_quiet_on_healthy_swap_and_disarm():
+    wd = RollbackWatchdog(window_s=0.5, threshold=0.3, min_requests=4)
+    wd.sample(0.0, 0, 0)
+    wd.arm(1.0, "d", 0, 50)
+    v = wd.evaluate(1.6, 1, 70)   # 1/20 post errors: under threshold
+    assert v is not None and v["fire"] is False
+    wd.arm(2.0, "d2", 1, 70)
+    wd.disarm()
+    assert wd.evaluate(3.0, 50, 120) is None
+    with pytest.raises(ValueError):
+        RollbackWatchdog(0.0, 0.3, 4)
+    with pytest.raises(ValueError):
+        RollbackWatchdog(1.0, 0.3, 0)
+
+
+def test_watchdog_pre_rate_uses_window_before_commit():
+    wd = RollbackWatchdog(window_s=1.0, threshold=0.3, min_requests=2)
+    # ancient sample outside the pre window is ignored; the in-window
+    # sample says the OLD model was already erroring at 50%
+    wd.sample(0.0, 0, 0)
+    wd.sample(9.2, 10, 80)
+    wd.arm(10.0, "d", 20, 100)    # pre window: 10 errors / 20 resolved
+    v = wd.evaluate(11.1, 25, 110)   # post: 5/10 = same 50%
+    assert v is not None and v["fire"] is False
+    assert v["pre_rate"] == pytest.approx(0.5)
+
+
+# -- snapshot freshness (satellite) -------------------------------------------
+
+def test_registry_snapshot_seq_and_timestamp():
+    reg = metrics_lib.MetricsRegistry()
+    reg.counter("x").inc()
+    s1 = reg.snapshot()
+    s2 = reg.snapshot()
+    assert s2["seq"] == s1["seq"] + 1
+    assert abs(time.time() - s2["captured_at"]) < 5.0
+    # text rendering unaffected by the new keys
+    assert "x_total 1" in reg.render_text()
+
+
+def test_aggregated_metrics_flags_stale_replicas():
+    """A replica serving a FROZEN snapshot (seq never advances) is
+    flagged and excluded from the merge instead of silently summed;
+    an old captured_at is stale on sight."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from dsin_tpu.serve.router import AggregatedMetrics
+
+    frozen = {"seq": 7, "captured_at": time.time(),
+              "info": {}, "counters": {"serve_completed": 11},
+              "gauges": {}, "histograms": {}, "accumulators": {}}
+
+    class _Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: ARG002
+            pass
+
+        def do_GET(self):  # noqa: N802
+            body = json.dumps(frozen).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        class _Rep:
+            idx = 0
+            info = {"healthz_port": server.server_address[1],
+                    "params_digest": "dd"}
+
+        class _StubRouter:
+            metrics = metrics_lib.MetricsRegistry()
+            _replicas = [_Rep()]
+            health_timeout_s = 2.0
+
+        agg = AggregatedMetrics(_StubRouter())
+        first = agg.snapshot()
+        assert first["info"]["replicas_stale"] == []
+        assert first["counters"].get("serve_completed") == 11
+        # second scrape: same seq -> stale, excluded, flagged
+        second = agg.snapshot()
+        assert second["info"]["replicas_stale"] == [0]
+        assert "serve_completed" not in second["counters"]
+        assert second["info"]["replica_digests"]["0"] == "dd"
+        # freshness also fails on an old capture timestamp alone
+        frozen["seq"] = 99
+        frozen["captured_at"] = time.time() - 60.0
+        third = agg.snapshot()
+        assert third["info"]["replicas_stale"] == [0]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# -- traced service integration ----------------------------------------------
+
+BUCKET = (16, 24)
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("trace_serve_cfg")
+    ae = tiny_ae_cfg(crop_size=BUCKET, batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+@pytest.fixture(scope="module")
+def traced_service(tiny_cfg_files, tmp_path_factory):
+    from dsin_tpu.serve import CompressionService, ServiceConfig
+    ae_p, pc_p = tiny_cfg_files
+    flight_dir = str(tmp_path_factory.mktemp("flight"))
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=(BUCKET,),
+        max_batch=2, max_wait_ms=2.0, max_queue=16, workers=1,
+        enable_si=True, session_max=2, trace_sample_rate=1.0,
+        flight_dir=flight_dir, flight_dump_min_interval_s=0.0,
+        metrics_port=0)).start()
+    svc.warmup()
+    yield svc
+    svc.drain()
+
+
+def _img(rng, h, w):
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+def _spans_for(svc, tid, need, timeout_s=10.0):
+    """Pipelined batches publish spans at pipeline finish, shortly
+    after futures resolve — poll until `need` is covered."""
+    deadline = time.monotonic() + timeout_s
+    names = set()
+    while time.monotonic() < deadline:
+        names = {s["name"] for s in
+                 svc.tracer.snapshot(trace_id=tid)["spans"]}
+        if need <= names:
+            return names
+        time.sleep(0.02)
+    return names
+
+
+def test_traced_request_spans_and_budget0(traced_service):
+    """The acceptance pin: a mixed SI/non-SI stream with tracing fully
+    on (sample_rate=1.0) compiles NOTHING after warmup, and each op's
+    trace carries its stage taxonomy."""
+    from dsin_tpu.utils.recompile import CompilationSentinel
+    svc = traced_service
+    rng = np.random.default_rng(0)
+    with CompilationSentinel(budget=0, label="traced mixed stream"):
+        sid = svc.open_session(_img(rng, *BUCKET))
+        enc = svc.submit_encode(_img(rng, 14, 20))
+        res = enc.result(60)
+        dec = svc.submit_decode(res.stream)
+        dec.result(60)
+        dsi = svc.submit_decode_si(res.stream, sid)
+        dsi.result(60)
+        for _ in range(4):   # churny tail: more mixed traffic
+            svc.encode(_img(rng, 14, 20), timeout=60)
+            svc.decode_si(res.stream, sid, timeout=60)
+    assert enc.trace is not None and enc.trace.sampled
+    enc_names = _spans_for(svc, enc.trace.trace_id,
+                           {"queue.wait", "batch.device",
+                            "batch.entropy"})
+    assert {"queue.wait", "batch.device", "batch.entropy"} <= enc_names
+    si_need = {"queue.wait", "batch.device", "batch.entropy",
+               "session.lookup", "batch.si_search"}
+    assert si_need <= _spans_for(svc, dsi.trace.trace_id, si_need)
+
+
+def test_trace_http_endpoint_and_flight_dump(traced_service):
+    import urllib.request
+    svc = traced_service
+    rng = np.random.default_rng(1)
+    res = svc.encode(_img(rng, 14, 20), timeout=60)
+    port = svc._metrics_server.port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace", timeout=10) as resp:
+        body = json.loads(resp.read().decode())
+    assert body["spans"] and body["enabled"] is True
+    assert "flight" in body
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace?format=chrome",
+            timeout=10) as resp:
+        chrome = json.loads(resp.read().decode())
+    assert chrome["traceEvents"]
+    # a typed error (deadline passed at the door's clock) must resolve
+    # the future typed AND leave a non-empty JSONL dump behind
+    fut = svc.submit_encode(_img(rng, 14, 20), deadline_ms=0.0001)
+    exc = fut.exception(timeout=60)
+    assert isinstance(exc, DeadlineExceeded)
+    assert svc.flight.flush(timeout=10)
+    meta = svc.flight.meta()
+    assert meta["dumps"] >= 1 and meta["last_dump_path"]
+    lines = open(meta["last_dump_path"]).read().splitlines()
+    assert any(json.loads(ln).get("kind") == "typed_error"
+               for ln in lines)
+    assert svc.metrics.counter("serve_typed_errors").value >= 1
+    # the error span is recorded under the request's trace id
+    err_spans = svc.tracer.snapshot(
+        trace_id=fut.trace.trace_id)["spans"]
+    assert any(s["name"] == "error" for s in err_spans)
+    assert res.stream  # the earlier healthy request was unaffected

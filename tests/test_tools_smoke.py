@@ -235,6 +235,27 @@ def test_serve_bench_smoke_json_contract(tmp_path):
     assert si["churn"]["decodes_ok"] > 0
     assert si["prep_ms"]["count"] > 0
     assert si["search_ms"]["count"] > 0
+    # ISSUE 11: the request-tracing leg rides the smoke run — the
+    # bench itself exits 1 on a broken overhead band, a failed
+    # span-vs-accumulator cross-check, steady-state compiles with
+    # tracing on, or a missing flight dump; re-pin the artifact shape
+    # so a silent gate removal cannot pass
+    tr = report["trace"]
+    assert tr["steady_compiles"] == 0, (
+        "tracing-enabled stream recompiled — spans leaked into jit")
+    assert len(tr["pair_ratios"]) == tr["repeats"]
+    assert tr["traced_rps"] > 0 and tr["untraced_rps"] > 0
+    for stage in ("device", "entropy", "si_search"):
+        c = tr["cross_check"][stage]
+        assert c["span_ms"] > 0, (stage, c)
+        slack = max(0.10 * max(c["metric_ms"], c["span_ms"]), 5.0)
+        assert c["drift_ms"] <= slack, (stage, c)
+    need = {"queue.wait", "batch.device", "batch.entropy",
+            "session.lookup", "batch.si_search"}
+    assert need <= set(tr["sample_trace"]["span_names"])
+    assert tr["flight"]["dumps"] >= 1
+    assert tr["flight"]["last_dump_path"]
+    assert tr["chrome_events"] > 0
 
 
 @pytest.mark.chaos
@@ -284,6 +305,14 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert sw["new_model_responses"] > 0
     assert sw["digest_a"] != sw["digest_b"]
     assert sc["rollback"]["bit_identical_to_pre_swap"] is True
+    # ISSUE 11: the rollback watchdog scenario — a post-swap typed-
+    # error storm must trigger an AUTOMATIC conditional rollback
+    wd = sc["watchdog_rollback"]
+    assert wd["fired"] is True
+    assert wd["watchdog_rollbacks"] >= 1
+    assert wd["typed_errors_during"] >= 1
+    assert wd["untyped_during"] == 0
+    assert wd["bit_identical_after"] is True
     assert hs["steady_compiles"] == 0, (
         "the hot swap compiled in steady state — the census warm "
         "must reuse every executable")
@@ -313,8 +342,23 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert rd["survivor_serves"] is True
     assert rd["new_session_after_death"] is True
     assert rd["session_orphans"] >= 1
+    # ISSUE 11: the stitched front-door trace — one decode_si through
+    # the session-pinning router resolves, by trace id, to the router
+    # hop PLUS the replica-internal queue/device/entropy/SI spans via
+    # the fleet /trace aggregation
+    ts = ssc["trace_stitch"]
+    assert ts["stitched"] is True
+    assert "router.dispatch" in ts["span_names"]
+    assert "batch.si_search" in ts["span_names"]
+    assert ts["replicas_scraped"] >= 1
     assert se["steady_compiles"] == 0
     assert se["lock_order_inversions"] == 0
+    # ISSUE 11: every injected-fault battery must leave a non-empty
+    # flight-recorder dump behind (the replayable incident timeline)
+    fr = report["flight_recorder"]
+    assert fr["dumps"] >= 1
+    assert fr["last_dump_events"] >= 1
+    assert report["invariants"]["flight_dumps"] >= 1
 
 
 def test_cache_dir_keyed_by_host_fingerprint(monkeypatch, tmp_path):

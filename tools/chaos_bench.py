@@ -142,8 +142,14 @@ def run_chaos(args) -> dict:
         "the chaos soak must run with them on"
     locks.reset_stats()
 
+    import tempfile
     shapes = _parse_shapes(args.shapes)
     buckets = _parse_shapes(args.buckets)
+    # the flight recorder soaks WITH the service (ISSUE 11): every
+    # injected fault that resolves a future typed, and every worker
+    # death, must leave a non-empty JSONL dump behind — the replayable
+    # incident timeline this battery's violations are judged against
+    flight_dir = tempfile.mkdtemp(prefix="chaos_flight_")
     cfg = ServiceConfig(
         ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
         seed=args.seed, buckets=buckets, max_batch=args.max_batch,
@@ -151,7 +157,8 @@ def run_chaos(args) -> dict:
         workers=args.workers, entropy_workers=args.entropy_workers,
         entropy_backend=args.entropy_backend,
         pipeline_depth=args.pipeline_depth, restart_backoff_s=0.02,
-        restart_backoff_max_s=0.25)
+        restart_backoff_max_s=0.25, trace_sample_rate=1.0,
+        flight_dir=flight_dir, flight_dump_min_interval_s=0.0)
     service = CompressionService(cfg).start()
     warm = service.warmup()
 
@@ -270,6 +277,21 @@ def run_chaos(args) -> dict:
         violations.append(f"{sentinel.compilations} steady-state XLA "
                           f"compiles (recovery must reuse executables)")
 
+    # flight-recorder invariant (ISSUE 11): the batteries above fired
+    # worker crashes AND typed integrity errors — both are dump
+    # triggers, so an empty recorder means the forensic layer is dead
+    service.flight.flush(timeout=10.0)
+    flight_meta = service.flight.meta()
+    flight_last_events = 0
+    if flight_meta["last_dump_path"]:
+        with open(flight_meta["last_dump_path"]) as f:
+            flight_last_events = sum(1 for _ in f) - 1   # minus header
+    if flight_meta["dumps"] < 1 or flight_last_events < 1:
+        violations.append(
+            f"injected faults produced no non-empty flight-recorder "
+            f"dump ({flight_meta['dumps']} dumps, last had "
+            f"{flight_last_events} events) — every violation report "
+            f"must carry a replayable timeline")
     service.drain()
     lock_stats = locks.stats_snapshot()
     inversions = locks.inversion_count()
@@ -322,6 +344,13 @@ def run_chaos(args) -> dict:
             "untyped_errors": load_counts["untyped"],
             "integrity_false_negatives": door_missed + rans_missed,
             "lock_order_inversions": inversions,
+            "flight_dumps": flight_meta["dumps"],
+        },
+        "flight_recorder": {
+            "dumps": flight_meta["dumps"],
+            "events_in_ring": flight_meta["events"],
+            "last_dump_path": flight_meta["last_dump_path"],
+            "last_dump_events": flight_last_events,
         },
         "lock_discipline": {
             "enforced": locks.enforcement_enabled(),
@@ -358,13 +387,22 @@ def run_hotswap(args) -> dict:
 
     shapes = _parse_shapes(args.shapes)
     buckets = _parse_shapes(args.buckets)
+    # rollback watchdog armed on every commit (ISSUE 11 satellite):
+    # short window so its scenario runs in CI seconds; the healthy
+    # scenarios double as proof it does NOT fire on good swaps
+    flight_dir = tempfile.mkdtemp(prefix="chaos_swap_flight_")
     cfg = ServiceConfig(
         ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
         seed=args.seed, buckets=buckets, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         workers=args.workers, entropy_workers=args.entropy_workers,
         entropy_backend=args.entropy_backend,
-        pipeline_depth=args.pipeline_depth)
+        pipeline_depth=args.pipeline_depth,
+        rollback_watchdog_window_s=0.3,
+        rollback_watchdog_threshold=0.3,
+        rollback_watchdog_min_requests=3,
+        trace_sample_rate=1.0, flight_dir=flight_dir,
+        flight_dump_min_interval_s=0.0)
     service = CompressionService(cfg).start()
     warm = service.warmup()
     rng = np.random.default_rng(args.seed + 7)
@@ -563,6 +601,58 @@ def run_hotswap(args) -> dict:
             "digest": service.model_digest,
             "bit_identical_to_pre_swap": roll.stream == a_streams[0]}
 
+        # -- rollback watchdog fires on a bad post-swap error rate --------
+        # (ISSUE 11 satellite, the ROADMAP elastic-fleet item): swap to
+        # B again, then make every decode resolve typed IntegrityError
+        # (serve.rans corruption). The watchdog's post-commit window
+        # sees the typed-error rate jump and must call
+        # rollback(expect_current=B) ITSELF — the service converges on
+        # A with no operator in the loop.
+        wd_before = service.metrics.counter(
+            "serve_watchdog_rollbacks").value
+        service.swap_model(replica_dir)
+        b_stream = service.encode(images[0],
+                                  timeout=args.timeout_s).stream
+        bad_plan = faults.FaultPlan([faults.FaultSpec(
+            site="serve.rans", action="corrupt", probability=1.0)],
+            seed=args.seed + 3)
+        wd_typed = wd_other = 0
+        wd_fired = False
+        with faults.installed(bad_plan):
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                f = service.submit_decode(b_stream)
+                exc = f.exception(timeout=args.timeout_s)
+                if exc is None or not isinstance(exc, Exception):
+                    wd_other += 1
+                else:
+                    wd_typed += 1
+                if service.model_digest == digest_a:
+                    wd_fired = True
+                    break
+                time.sleep(0.02)
+        wd_rollbacks = service.metrics.counter(
+            "serve_watchdog_rollbacks").value - wd_before
+        if not wd_fired or wd_rollbacks < 1:
+            violations.append(
+                f"watchdog_rollback: post-swap typed-error storm did "
+                f"not auto-roll-back ({wd_rollbacks} watchdog "
+                f"rollbacks, serving {service.model_digest})")
+        # the service serves the OLD params cleanly once the fault
+        # plan is gone — the same recovery contract as every scenario
+        wd_clean = service.encode(images[0], timeout=args.timeout_s)
+        if wd_clean.stream != a_streams[0]:
+            violations.append("watchdog_rollback: old-model bit-"
+                              "identity lost after the auto rollback")
+        scenarios["watchdog_rollback"] = {
+            "fired": wd_fired,
+            "watchdog_rollbacks": wd_rollbacks,
+            "typed_errors_during": wd_typed,
+            "untyped_during": wd_other,
+            "digest_after": service.model_digest,
+            "bit_identical_after": wd_clean.stream == a_streams[0],
+        }
+
     if sentinel.compilations:
         violations.append(f"{sentinel.compilations} steady-state XLA "
                           f"compiles across swap+rollback")
@@ -616,10 +706,15 @@ class _ThreadReplicas:
     def _run(self, idx, conn):
         import queue
         import threading
+        from dataclasses import replace as _replace
         from dsin_tpu.serve.router import _picklable_exc
         from dsin_tpu.serve.service import CompressionService
         try:
-            service = CompressionService(self._make_config()).start()
+            # a real metrics endpoint per thread replica: the router's
+            # /trace aggregation scrapes it exactly like a spawn
+            # replica's (the stitched-trace scenario's transport)
+            service = CompressionService(
+                _replace(self._make_config(), metrics_port=0)).start()
             service.warmup()
         except BaseException as e:  # noqa: BLE001 — router needs the cause
             conn.send(("failed", idx, _picklable_exc(e)))
@@ -642,7 +737,8 @@ class _ThreadReplicas:
                                   name=f"chaos-si-send-{idx}")
         sender.start()
         outq.put(("ready", idx, {
-            "replica": idx, "pid": os.getpid(), "healthz_port": None,
+            "replica": idx, "pid": os.getpid(),
+            "healthz_port": service._metrics_server.port,
             "params_digest": service.model_digest}))
         dead = self.dead[idx]
         while not dead.is_set():
@@ -654,7 +750,8 @@ class _ThreadReplicas:
                 break
             if msg[0] == "stop":
                 break
-            op, rid, payload, priority, deadline_ms = msg
+            op, rid, payload, priority, deadline_ms = msg[:5]
+            trace = msg[5] if len(msg) > 5 else None
             try:
                 if op == "session_open":
                     outq.put(("ok", rid, service.open_session(payload)))
@@ -666,15 +763,17 @@ class _ThreadReplicas:
                 if op == "encode":
                     fut = service.submit_encode(payload,
                                                 deadline_ms=deadline_ms,
-                                                priority=priority)
+                                                priority=priority,
+                                                trace=trace)
                 elif op == "decode_si":
                     fut = service.submit_decode_si(
                         payload[0], payload[1], deadline_ms=deadline_ms,
-                        priority=priority)
+                        priority=priority, trace=trace)
                 else:
                     fut = service.submit_decode(payload,
                                                 deadline_ms=deadline_ms,
-                                                priority=priority)
+                                                priority=priority,
+                                                trace=trace)
             except BaseException as e:  # noqa: BLE001 — typed rejects
                 outq.put(("err", rid, _picklable_exc(e)))
                 continue
@@ -732,7 +831,8 @@ def run_sessions(args) -> dict:
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         workers=args.workers, entropy_workers=args.entropy_workers,
         entropy_backend=args.entropy_backend,
-        pipeline_depth=args.pipeline_depth, enable_si=True)
+        pipeline_depth=args.pipeline_depth, enable_si=True,
+        trace_sample_rate=1.0)
     rng = np.random.default_rng(args.seed + 11)
     sides = {tuple(b): rng.integers(0, 255, (b[0], b[1], 3),
                                     dtype=np.uint8) for b in buckets}
@@ -870,7 +970,8 @@ def run_sessions(args) -> dict:
     reps = _ThreadReplicas(lambda: ServiceConfig(**base, session_max=4))
     router = FrontDoorRouter(ServiceConfig(**base, session_max=4),
                              replicas=2, launcher=reps.launcher,
-                             poll_every_s=30.0).start()
+                             poll_every_s=30.0,
+                             trace_sample_rate=1.0).start()
     # replicas warmed inside start(); everything after is steady state
     sentinel_r = CompilationSentinel(budget=0,
                                      label="session router steady state",
@@ -924,6 +1025,37 @@ def run_sessions(args) -> dict:
             "survivor_serves": survivor_ok,
             "new_session_after_death": new_open_ok,
             "session_orphans": orphans,
+        }
+
+        # -- stitched front-door trace (the ISSUE 11 acceptance pin) ------
+        # one decode_si through the router must yield ONE trace id
+        # resolving, via the fleet /trace aggregation, to the router
+        # hop PLUS the replica-internal queue/device/entropy/SI spans
+        fut = router.submit_decode_si(stream_r, sid_c)
+        fut.result(args.timeout_s)
+        tid = fut.trace.trace_id
+        # the replica publishes its batch spans at pipeline finish,
+        # moments after the future resolves — poll briefly
+        need = {"router.dispatch", "queue.wait", "batch.device",
+                "batch.entropy", "batch.si_search", "session.lookup"}
+        names = set()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            merged = router.traces.snapshot(trace_id=tid)
+            names = {s["name"] for s in merged["spans"]}
+            if need <= names:
+                break
+            time.sleep(0.05)
+        missing = sorted(need - names)
+        if missing:
+            violations.append(
+                f"trace_stitch: front-door decode_si trace {tid} is "
+                f"missing spans {missing} (got {sorted(names)})")
+        scenarios["trace_stitch"] = {
+            "trace_id": tid,
+            "span_names": sorted(names),
+            "stitched": not missing,
+            "replicas_scraped": merged["replicas_scraped"],
         }
     finally:
         router.drain()
