@@ -474,6 +474,39 @@ class BottleneckCodec:
                 dec.close()
         return outs
 
+    def coding_gap(self, symbols_dhw: np.ndarray, stream: bytes) -> dict:
+        """Realized stream size vs this codec's own cross-entropy bound —
+        the serving coding-gap signal (ISSUE 13, serve/quality.py).
+
+        `stream` must be a DTPC frame THIS codec produced for
+        `symbols_dhw`; the scan mode is read from its header so the
+        `ideal_bits` pass runs the SAME engine (engines differ in
+        last-ulp PMF floats, so the bound must come from the coder that
+        emitted the bytes). Returns payload bits (header excluded — the
+        13 framing bytes are transport, not model redundancy), the
+        bound, and the gap both absolute and relative. The gap is the
+        rANS coding redundancy over the QUANTIZED tables: always >= 0
+        up to the coder's final-state flush, and stable for a healthy
+        model — a RISING gap under live traffic means probclass no
+        longer matches the data distribution. This is the ONE gap
+        definition; the serve telemetry and its tests both call it."""
+        mode_id, shape = self._parse_header(stream)
+        symbols = np.asarray(symbols_dhw)
+        if tuple(symbols.shape) != shape:
+            raise ValueError(f"symbols {tuple(symbols.shape)} are not the "
+                             f"volume this stream frames {shape}")
+        mode = next(name for name, mid in _MODES.items() if mid == mode_id)
+        ideal = self.ideal_bits(symbols, mode=mode)
+        payload_bits = (len(stream) - 13) * 8
+        gap_bits = payload_bits - ideal
+        return {
+            "payload_bits": payload_bits,
+            "ideal_bits": round(ideal, 3),
+            "gap_bits": round(gap_bits, 3),
+            "gap_pct": round(100.0 * gap_bits / ideal, 4) if ideal > 0
+            else 0.0,
+        }
+
     def ideal_bits(self, symbols_dhw: np.ndarray,
                    mode: str = "wavefront_np") -> float:
         """Information content under the *quantized* tables — the tight lower

@@ -57,6 +57,13 @@ class SearchResult(NamedTuple):
     best_flat: jnp.ndarray   # (P,) argmax/argmin of the flattened map
     row: jnp.ndarray         # (P,) match rows
     col: jnp.ndarray         # (P,) match cols
+    # (P,) the winning (masked) score per patch — the SI-match quality
+    # signal (ISSUE 13, serve/quality.py). Read from the SAME score
+    # values the argmax already ranked, so carrying it cannot perturb
+    # the match (XLA dead-code-eliminates the gather when unused).
+    # None where the search never materializes per-patch scores (the
+    # fused Pallas kernel folds them on-chip).
+    best_score: Optional[jnp.ndarray] = None
 
 
 class SidePrep(NamedTuple):
@@ -451,10 +458,13 @@ def search_single(x_dec: jnp.ndarray, y_img: jnp.ndarray, y_dec: jnp.ndarray,
             # Pearson (argmax): multiply — distant positions are damped
             scores = scores * mask
     best, rows, cols = find_matches(scores, use_l2)
+    p_count = scores.shape[-1]
+    best_score = jnp.take_along_axis(
+        scores.reshape(-1, p_count), best[None, :], axis=0)[0]
     y_patches = gather_patches(prep.y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)
     return SearchResult(y_syn=y_syn, score_map=scores, best_flat=best,
-                        row=rows, col=cols)
+                        row=rows, col=cols, best_score=best_score)
 
 
 def search_single_tiled(x_dec: jnp.ndarray, y_img: Optional[jnp.ndarray],
@@ -526,15 +536,16 @@ def search_single_tiled(x_dec: jnp.ndarray, y_img: Optional[jnp.ndarray],
         def mask_chunk(scores, r0):
             return scores
 
-    _, best_flat = chunked_score_argmax(q, r_pad, hc, wc, row_chunk,
-                                        mask_chunk, patch_h,
-                                        conv_dtype=conv_dtype, eps=eps,
-                                        inv_std_padded=inv_pad)
+    best_val, best_flat = chunked_score_argmax(q, r_pad, hc, wc, row_chunk,
+                                               mask_chunk, patch_h,
+                                               conv_dtype=conv_dtype,
+                                               eps=eps,
+                                               inv_std_padded=inv_pad)
     rows, cols = best_flat // wc, best_flat % wc
     y_patches = gather_patches(prep.y_img, rows, cols, patch_h, patch_w)
     y_syn = assemble_patches(y_patches, h, w)
     return SearchResult(y_syn=y_syn, score_map=None, best_flat=best_flat,
-                        row=rows, col=cols)
+                        row=rows, col=cols, best_score=best_val)
 
 
 def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
@@ -647,7 +658,7 @@ def synthesize_side_image(x_dec: jnp.ndarray, y_img: jnp.ndarray,
 
 def synthesize_side_image_prepped(x_dec: jnp.ndarray, prep: SidePrep,
                                   patch_h: int, patch_w: int,
-                                  config) -> jnp.ndarray:
+                                  config, with_scores: bool = False):
     """Batched y_syn (N, H, W, 3) against ONE cached SidePrep — the
     serving hot path (serve/session.py): every request of a session
     shares the side image, so the prep enters ONCE and only the
@@ -662,7 +673,14 @@ def synthesize_side_image_prepped(x_dec: jnp.ndarray, prep: SidePrep,
       * 'xla' / 'xla_tiled' run the prepped XLA searches.
     Pearson-mode preps only on the pallas paths; an L2 prep (sum_y2 set)
     runs the XLA paths exactly like `search_single(use_l2=True)`.
-    """
+
+    `with_scores=True` (ISSUE 13) returns `(y_syn, best_scores (N, P))`
+    — the winning masked Pearson score per patch, the SI-match quality
+    signal serve/quality.py summarizes per session. The scores are the
+    values the argmax already ranked, so the match (and y_syn) is
+    bit-identical with the flag on or off. XLA paths only: the fused
+    Pallas kernel folds scores on-chip and cannot emit them, and an L2
+    prep's distances are not a correlation signal — both raise."""
     use_l2 = prep.sum_y2 is not None
     impl = getattr(config, "sifinder_impl", "auto")
     if impl not in ("auto", "xla", "xla_tiled", "pallas", "pallas_interpret"):
@@ -671,9 +689,18 @@ def synthesize_side_image_prepped(x_dec: jnp.ndarray, prep: SidePrep,
             "'auto', 'xla', 'xla_tiled', 'pallas', 'pallas_interpret'")
     if impl == "auto":
         impl = ("pallas" if (not use_l2 and prep.y_t_pad is not None
-                             and jax.default_backend() == "tpu")
+                             and jax.default_backend() == "tpu"
+                             and not with_scores)
                 else "xla")
+    if with_scores and use_l2:
+        raise ValueError("with_scores is Pearson-only: an L2 prep's "
+                         "distances are not a match-quality correlation")
     if impl in ("pallas", "pallas_interpret"):
+        if with_scores:
+            raise ValueError(
+                f"sifinder_impl={impl!r} cannot return match scores — "
+                "the fused kernel folds them on-chip; use 'xla'/"
+                "'xla_tiled' when score telemetry is on")
         if use_l2:
             raise ValueError(f"sifinder_impl={impl!r} is Pearson-only")
         if prep.y_t_pad is None:
@@ -694,8 +721,14 @@ def synthesize_side_image_prepped(x_dec: jnp.ndarray, prep: SidePrep,
                      patch_h=patch_h, patch_w=patch_w, prep=prep,
                      row_chunk=sifinder_row_chunk(config),
                      conv_dtype=sifinder_conv_dtype(config))
+        if with_scores:
+            return jax.vmap(
+                lambda a: (lambda r: (r.y_syn, r.best_score))(fn(a)))(x_dec)
         return jax.vmap(lambda a: fn(a).y_syn)(x_dec)
     fn = partial(search_single, y_img=None, y_dec=None, mask=None,
                  patch_h=patch_h, patch_w=patch_w, use_l2=use_l2,
                  conv_dtype=sifinder_conv_dtype(config), prep=prep)
+    if with_scores:
+        return jax.vmap(
+            lambda a: (lambda r: (r.y_syn, r.best_score))(fn(a)))(x_dec)
     return jax.vmap(lambda a: fn(a).y_syn)(x_dec)

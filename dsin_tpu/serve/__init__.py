@@ -12,6 +12,9 @@ Layering (each module stands alone below the next):
                    policy + per-device shardings via parallel/mesh.py)
     session.py   — side-information session cache: LRU/TTL/byte-bounded
                    store of device-resident SidePrep bundles (ISSUE 10)
+    quality.py   — model-health telemetry (ISSUE 13): coding-gap +
+                   bpp export, per-session SI-match quality with a
+                   floor alarm, and the golden canary that gates swaps
     trace.py     — span-based request tracer + crash flight recorder
                    (ISSUE 11): per-request TraceContexts, bounded span/
                    event rings, /trace + Chrome export, JSONL dumps
@@ -33,6 +36,7 @@ from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, DeadlineExceeded,
 from dsin_tpu.serve.buckets import (BucketPolicy, NoBucketFits,
                                     crop_from_bucket, pad_to_bucket)
 from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
+from dsin_tpu.serve.quality import CanaryFailed, QualityMonitor
 from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
                                       PlacementPlan, RebalanceTrigger,
                                       plan_placement)
@@ -53,13 +57,15 @@ from dsin_tpu.utils.integrity import IntegrityError
 __all__ = [
     "BULK", "INTERACTIVE",
     "AdmissionController", "AggregatedMetrics", "AggregatedTraces",
-    "BucketPolicy", "CompressionService", "DeadlineExceeded",
+    "BucketPolicy", "CanaryFailed", "CompressionService",
+    "DeadlineExceeded",
     "DevicePlacement", "EncodeResult", "FleetSwapError",
     "FlightRecorder", "FrontDoorRouter", "Future",
     "IntegrityError", "ManifestMismatch", "MetricsRegistry",
     "MetricsServer", "MicroBatcher", "ModelBundle", "NoBucketFits",
     "PlacementError", "PlacementPlan", "PriorityClass",
-    "RebalanceTrigger", "Request", "RollbackWatchdog", "ServeError",
+    "QualityMonitor", "RebalanceTrigger", "Request", "RollbackWatchdog",
+    "ServeError",
     "ServiceConfig", "ServiceDraining", "ServiceOverloaded",
     "ServiceUnavailable", "SessionEntry", "SessionError",
     "SessionExpired", "SessionOverCapacity", "SessionStore",

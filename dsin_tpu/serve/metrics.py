@@ -81,20 +81,30 @@ class Accumulator:
 
 
 class Histogram:
-    """Bounded-reservoir summary: count/mean over everything ever
-    observed, quantiles over the most recent `maxlen` samples."""
+    """Bounded-reservoir summary: count/mean (and all-time min/max)
+    over everything ever observed, quantiles over the most recent
+    `maxlen` samples. Min/max exist for the model-health signals
+    (ISSUE 13): the worst coding gap and the weakest SI-match score ARE
+    the alarm tails — a p99 over a sliding reservoir forgets the one
+    catastrophic sample an operator needs to see."""
 
     def __init__(self, maxlen: int = 4096):
         self._lock = locks_lib.RankedLock("metrics.metric")
         self._window: deque = deque(maxlen=maxlen)  # guarded-by: self._lock
         self._count = 0                    # guarded-by: self._lock
         self._sum = 0.0                    # guarded-by: self._lock
+        self._min = float("inf")           # guarded-by: self._lock
+        self._max = float("-inf")          # guarded-by: self._lock
 
     def observe(self, v: float) -> None:
         with self._lock:
             self._window.append(float(v))
             self._count += 1
             self._sum += float(v)
+            if v < self._min:
+                self._min = float(v)
+            if v > self._max:
+                self._max = float(v)
 
     def quantile(self, q: float) -> float:
         """Nearest-rank quantile over the window; 0.0 when empty."""
@@ -108,11 +118,14 @@ class Histogram:
     def summary(self) -> Dict[str, float]:
         with self._lock:
             count, total = self._count, self._sum
+            vmin, vmax = self._min, self._max
         return {
             "count": count,
             "mean": (total / count) if count else 0.0,
             "p50": self.quantile(0.50),
             "p99": self.quantile(0.99),
+            "min": vmin if count else 0.0,
+            "max": vmax if count else 0.0,
         }
 
 
@@ -223,8 +236,12 @@ def render_snapshot_text(snap: dict) -> str:
         lines.append(f"{k} {v:g}")
     for k, s in snap["histograms"].items():
         lines.append(f"{k}_count {s['count']}")
-        for stat in ("mean", "p50", "p99"):
-            lines.append(f"{k}_{stat} {s[stat]:g}")
+        # min/max guarded with `in`: fleet-merged snapshots
+        # (serve/router.py) may carry summaries from replicas that
+        # predate them
+        for stat in ("mean", "p50", "p99", "min", "max"):
+            if stat in s:
+                lines.append(f"{k}_{stat} {s[stat]:g}")
     for name, s in snap.get("locks", {}).items():
         stem = "lock_" + name.replace(".", "_")
         lines.append(f"{stem}_acquisitions_total "

@@ -1379,9 +1379,15 @@ class AggregatedMetrics:
         counters = dict(own["counters"])
         gauges = dict(own["gauges"])
         accumulators = dict(own["accumulators"])
-        # histogram partials: name -> [count_total, weighted_sum, p50s, p99s]
+        # histogram partials:
+        # name -> [count_total, weighted_sum, p50s, p99s, mins, maxs]
+        # (min/max fold across the fleet — the ISSUE 13 alarm tails,
+        # e.g. the worst coding gap any replica ever saw, must survive
+        # the merge; guarded with `in` for replicas predating them)
         hist: Dict[str, list] = {
-            k: [s["count"], s["mean"] * s["count"], [s["p50"]], [s["p99"]]]
+            k: [s["count"], s["mean"] * s["count"], [s["p50"]], [s["p99"]],
+                [s["min"]] if "min" in s else [],
+                [s["max"]] if "max" in s else []]
             for k, s in own["histograms"].items()}
         per_replica_info: Dict[str, dict] = {}
         digests: Dict[str, Optional[str]] = {}
@@ -1423,11 +1429,15 @@ class AggregatedMetrics:
             for k, v in snap.get("accumulators", {}).items():
                 accumulators[k] = accumulators.get(k, 0.0) + v
             for k, s in snap.get("histograms", {}).items():
-                part = hist.setdefault(k, [0, 0.0, [], []])
+                part = hist.setdefault(k, [0, 0.0, [], [], [], []])
                 part[0] += s["count"]
                 part[1] += s["mean"] * s["count"]
                 part[2].append(s["p50"])
                 part[3].append(s["p99"])
+                if "min" in s:
+                    part[4].append(s["min"])
+                if "max" in s:
+                    part[5].append(s["max"])
             info = snap.get("info", {})
             per_replica_info[str(rep.idx)] = info
             model = info.get("serve_model_digest") or {}
@@ -1438,8 +1448,25 @@ class AggregatedMetrics:
             k: {"count": c,
                 "mean": (wsum / c) if c else 0.0,
                 "p50": max(p50s) if p50s else 0.0,
-                "p99": max(p99s) if p99s else 0.0}
-            for k, (c, wsum, p50s, p99s) in sorted(hist.items())}
+                "p99": max(p99s) if p99s else 0.0,
+                **({"min": min(mins)} if mins else {}),
+                **({"max": max(maxs)} if maxs else {})}
+            for k, (c, wsum, p50s, p99s, mins, maxs)
+            in sorted(hist.items())}
+        # fleet model-health roll-up (ISSUE 13): the per-bucket gap/bpp
+        # histograms merge through the generic rules above; the canary
+        # verdicts are per-replica structural facts, so the aggregate
+        # names WHICH replicas' canaries are failing instead of letting
+        # a summed gauge average a sick replica away
+        canary: Dict[str, Any] = {}
+        canary_failing = []
+        for idx, info in per_replica_info.items():
+            c = info.get("serve_canary")
+            if isinstance(c, dict):
+                canary[idx] = {"status": c.get("status"),
+                               "digest": c.get("digest")}
+                if c.get("status") == "failed":
+                    canary_failing.append(int(idx))
         return {
             "info": {
                 "router": own["info"],
@@ -1448,6 +1475,12 @@ class AggregatedMetrics:
                 "replicas_scraped": len(per_replica_info),
                 "replicas_unreachable": unreachable,
                 "replicas_stale": stale,
+                "quality": {
+                    "canary": canary,
+                    "replicas_canary_failing": sorted(canary_failing),
+                    "fleet_canary_ok": (not canary_failing) if canary
+                    else None,
+                },
             },
             "counters": dict(sorted(counters.items())),
             "gauges": dict(sorted(gauges.items())),

@@ -178,6 +178,25 @@ on typed errors and worker deaths. The post-swap `RollbackWatchdog`
 (serve/swap.py) compares typed-error-rate windows around every
 `commit_swap` and calls `rollback(expect_current=...)` itself past the
 configured threshold — the ROADMAP's health-triggered rollback loop.
+
+Model health (ISSUE 13, serve/quality.py): every ops metric above stays
+green while the fleet silently ships WORSE COMPRESSION, so the paper's
+own quantities are production signals too. Encode lanes export
+per-bucket payload/wire bpp histograms and a head-sampled coding gap
+(realized payload bits vs `BottleneckCodec.ideal_bits` — the extra pass
+runs on the entropy-pool thread after the future resolved, pure numpy,
+never under a lock or in jit); SI decodes carry the winning siFinder
+match score per patch (an optional executable output — the argmax path
+is bit-identical) summarized per session with a floor alarm; and a
+golden canary prober drives pinned per-bucket inputs through the REAL
+serve path on a period, comparing output digests against goldens
+recorded in the checkpoint manifest (or a self-anchored first probe).
+The canary gates swaps: `prepare_swap` probes the STAGED bundle and a
+mismatch against the incoming manifest's goldens refuses the commit
+typed (`CanaryFailed`); a post-commit canary failure arms the
+`RollbackWatchdog` alongside the typed-error signal. Canary inputs use
+the existing bucket shapes, so budget-0 holds with every quality signal
+on (serve_bench's --quality leg gates it).
 """
 
 from __future__ import annotations
@@ -197,6 +216,7 @@ import numpy as np
 from dsin_tpu.serve import buckets as buckets_lib
 from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve import placement as placement_lib
+from dsin_tpu.serve import quality as quality_lib
 from dsin_tpu.serve import router as router_lib
 from dsin_tpu.serve import swap as swap_lib
 from dsin_tpu.serve import session as session_lib
@@ -332,6 +352,35 @@ class ServiceConfig:
     rollback_watchdog_window_s: Optional[float] = None
     rollback_watchdog_threshold: float = 0.5
     rollback_watchdog_min_requests: int = 8
+    #: model-health telemetry (ISSUE 13, serve/quality.py).
+    #: `quality_enabled=False` removes the whole layer: no bpp/gap
+    #: observation, no SI score outputs compiled into the SI
+    #: executable, no canary machinery.
+    quality_enabled: bool = True
+    #: head-sampling rate of the coding-gap pass (the PR 11
+    #: deterministic counter rotation): each sampled encode pays a
+    #: second incremental-engine scan on the entropy-pool thread, so
+    #: the default keeps the telemetry inside the bench's <=2% paired
+    #: overhead budget; benches force 1.0 to populate histograms fast.
+    quality_gap_sample_rate: float = 1.0 / 16.0
+    #: SI-match alarm: a session is alarmed once >= `si_alarm_frac` of
+    #: its observed winning match scores (after `si_alarm_min_samples`
+    #: of them) fall below `si_score_floor` — the "side image stopped
+    #: correlating" signal.
+    si_score_floor: float = 0.25
+    si_alarm_frac: float = 0.5
+    si_alarm_min_samples: int = 8
+    #: golden canary prober period; None = no background prober (swaps
+    #: still canary their staged bundle when the incoming manifest
+    #: records goldens and quality_enabled). The prober drives the
+    #: pinned per-bucket inputs through the REAL serve path.
+    canary_every_s: Optional[float] = None
+    #: seed of the deterministic canary inputs — must match the seed
+    #: the checkpoint publisher recorded goldens with
+    #: (quality.canary_inputs keys every derivation by it)
+    canary_seed: int = 0
+    #: per-op result timeout inside one canary probe
+    canary_timeout_s: float = 120.0
     #: persistent XLA compilation cache (utils/cache.py) at start(), so
     #: a restarted service re-warms from disk instead of recompiling
     persistent_cache: bool = True
@@ -422,7 +471,7 @@ def _make_batched_fns(model):
     return jax.jit(encode_fn), jax.jit(decode_fn)
 
 
-def _make_si_fns(model, for_pallas: bool):
+def _make_si_fns(model, for_pallas: bool, with_scores: bool = False):
     """The SI dataplane's two jitted functions (enable_si, ISSUE 10).
     Same contract as `_make_batched_fns`: params/batch_stats AND the
     SidePrep enter as traced arguments (`model` is the static module
@@ -437,7 +486,10 @@ def _make_si_fns(model, for_pallas: bool):
       side operands).
     * `si_decode_fn(params, batch_stats, symbols, prep)` — the per-
       request path: decode → prepped siFinder → siNet, one fused
-      executable per bucket."""
+      executable per bucket. With `with_scores` (ISSUE 13) it returns
+      `(images, best_scores (N, P))` — the SI-match quality signal; the
+      search itself is bit-identical (ops/sifinder.py), the executable
+      merely keeps the winning scores it already computed."""
     from dsin_tpu.ops import sifinder as sifinder_lib
     cfg = model.ae_config
     ph, pw = (int(v) for v in cfg.y_patch_size)
@@ -459,6 +511,11 @@ def _make_si_fns(model, for_pallas: bool):
         from dsin_tpu.models.quantizer import centers_lookup
         q = centers_lookup(params["centers"], symbols)
         x_dec, _ = model.decode(params, batch_stats, q, train=False)
+        if with_scores:
+            y_syn, scores = sifinder_lib.synthesize_side_image_prepped(
+                x_dec, prep, ph, pw, cfg, with_scores=True)
+            x_si = model.apply_sinet(params, x_dec, y_syn)
+            return jnp.clip(x_si, 0.0, 255.0), scores
         y_syn = sifinder_lib.synthesize_side_image_prepped(
             x_dec, prep, ph, pw, cfg)
         x_si = model.apply_sinet(params, x_dec, y_syn)
@@ -561,6 +618,24 @@ class CompressionService:
                 config.rollback_watchdog_window_s,
                 config.rollback_watchdog_threshold,
                 config.rollback_watchdog_min_requests)
+        # model-health telemetry (ISSUE 13): monitor + canary state are
+        # built up front like the tracer — their constructors validate
+        # the knobs (typed, cheap), and dataplane stages can always
+        # reach self.quality without None checks
+        self.quality = quality_lib.QualityMonitor(
+            metrics=self.metrics, flight=self.flight,
+            enabled=config.quality_enabled,
+            gap_sample_rate=config.quality_gap_sample_rate,
+            si_score_floor=config.si_score_floor,
+            si_alarm_frac=config.si_alarm_frac,
+            si_alarm_min_samples=config.si_alarm_min_samples)
+        self._canary = quality_lib.CanaryState(
+            config.canary_seed, self.metrics, flight=self.flight)
+        self._canary_imgs = {}        # bucket -> (img, side), pinned
+        self._canary_sids = {}        # bucket -> live canary session id
+        self._canary_thread: Optional[threading.Thread] = None
+        self._warmup_done = False
+        self._si_scores_enabled = False
         self._batcher = MicroBatcher(
             config.max_batch, config.max_wait_ms, config.max_queue,
             classes=config.priority_classes,
@@ -662,6 +737,29 @@ class CompressionService:
         if self.config.entropy_proc_timeout_s <= 0:
             raise ValueError(f"entropy_proc_timeout_s must be > 0, got "
                              f"{self.config.entropy_proc_timeout_s}")
+        # canary knobs (ISSUE 13), validated with the rest up front
+        if self.config.canary_every_s is not None \
+                and self.config.canary_every_s <= 0:
+            raise ValueError(f"canary_every_s must be > 0 (or None), got "
+                             f"{self.config.canary_every_s}")
+        if self.config.canary_timeout_s <= 0:
+            raise ValueError(f"canary_timeout_s must be > 0, got "
+                             f"{self.config.canary_timeout_s}")
+        if (self.config.canary_every_s is not None
+                and self.config.enable_si
+                and self.config.session_max < len(self.policy.buckets) + 1):
+            # the prober's pinned sessions live in the SHARED user
+            # store (one per bucket) and participate in its LRU like
+            # any client — a store sized without them would let every
+            # probe period evict live users' device-resident preps.
+            # Size session_max = expected user working set + #buckets.
+            raise ValueError(
+                f"canary_every_s with enable_si needs session_max >= "
+                f"{len(self.policy.buckets) + 1} (one pinned canary "
+                f"session per bucket + at least one user slot), got "
+                f"{self.config.session_max} — budget the prober's "
+                f"sessions into the store or disable the background "
+                f"canary")
         # SI-serving knobs (ISSUE 10), validated BEFORE the model build
         # like everything above: a config typo costs milliseconds
         self._si_enabled = bool(self.config.enable_si)
@@ -684,11 +782,14 @@ class CompressionService:
                     f"y_patch_size ({ph}, {pw}) — the siFinder patch "
                     f"grid must tile the bucket exactly; offending "
                     f"buckets: {bad}")
-            # the store's own __init__ validates the bounds
+            # the store's own __init__ validates the bounds; the evict
+            # hook keeps the SI-match tracker (ISSUE 13) from pinning
+            # stats or alarms for sessions that no longer exist
             self._sessions = session_lib.SessionStore(
                 self.config.session_max, self.config.session_max_bytes,
                 self.config.session_ttl_s, metrics=self.metrics,
-                flight=self.flight)
+                flight=self.flight,
+                on_evict=self.quality.session_gone)
         # load-aware auto-rebalance (ISSUE 8 satellite) knobs, validated
         # up front with the rest: a bad value must not leave spawned
         # worker threads behind when start() raises
@@ -728,8 +829,18 @@ class CompressionService:
                 and (si_impl in ("pallas", "pallas_interpret")
                      or (si_impl == "auto"
                          and jax.default_backend() == "tpu")))
+            # SI-match score output (ISSUE 13): compiled into the SI
+            # executable only where the search can emit scores — the
+            # XLA Pearson paths. The fused Pallas kernel folds scores
+            # on-chip and an L2 search's distances are not a
+            # correlation signal, so both keep the score-less
+            # executable (quality telemetry notes the absence).
+            self._si_scores_enabled = (
+                self.config.quality_enabled and not si_for_pallas
+                and not bool(self.model.ae_config.use_L2andLAB))
             self._si_prep_jit, self._si_decode_jit = _make_si_fns(
-                self.model, si_for_pallas)
+                self.model, si_for_pallas,
+                with_scores=self._si_scores_enabled)
             # the prior factors are y-independent, bucket-static: one
             # device upload per bucket, shared by every session
             use_prior = bool(self.model.ae_config.use_gauss_mask)
@@ -775,10 +886,22 @@ class CompressionService:
             # reused by that bundle's child-death rebuilds
             initargs = (loader_lib.make_codec_spec(codec),
                         list(self._warm_shapes))
+        # the start-time bundle keeps its checkpoint's manifest too
+        # (swapped-in bundles always did): the canary prober compares
+        # against publisher goldens from the very first model, not only
+        # after the first hot swap
+        start_manifest = None
+        if self.config.ckpt:
+            from dsin_tpu.train import checkpoint as ckpt_lib
+            try:
+                start_manifest = ckpt_lib.load_manifest(self.config.ckpt)
+            except (OSError, ValueError):
+                start_manifest = None   # legacy/corrupt: load_model_state
+                #                         already owns that verdict
         bundle = swap_lib.ModelBundle(
             0, loader_lib.params_digest((state.params, state.batch_stats)),
             state, codec, device_state, ckpt=self.config.ckpt,
-            proc_initargs=initargs)
+            proc_initargs=initargs, manifest=start_manifest)
         if initargs is not None:
             bundle.set_proc(self._make_entropy_proc(initargs))
         self._swap = swap_lib.SwapCoordinator(bundle, self.metrics)
@@ -804,6 +927,18 @@ class CompressionService:
                                             name="serve-supervisor",
                                             daemon=True)
         self._supervisor.start()
+        # golden canary (ISSUE 13): pinned deterministic inputs at the
+        # EXISTING bucket shapes (no new executables — budget-0 holds
+        # with the prober on), probed by a dedicated thread so a slow
+        # probe can never stall worker crash-restart healing
+        self._canary_imgs = quality_lib.canary_inputs(
+            self.policy.buckets, self.config.canary_seed)
+        if self.config.canary_every_s is not None \
+                and self.config.quality_enabled:
+            self._canary_thread = threading.Thread(
+                target=self._canary_loop, name="serve-canary",
+                daemon=True)
+            self._canary_thread.start()
         if self.config.metrics_port is not None:
             self._metrics_server = metrics_lib.MetricsServer(
                 self.metrics, self.health,
@@ -878,6 +1013,9 @@ class CompressionService:
             self._proc_warm = [f.result(timeout=300) for f in pings]
         compiles = recompile.compilation_count() - before
         cache_hits = recompile.cache_hit_count() - before_hits
+        # the canary prober may run from here on: every executable a
+        # probe touches exists now, so a probe can never compile
+        self._warmup_done = True
         self.metrics.gauge("serve_warmup_compiles").set(compiles)
         self.metrics.gauge("serve_buckets").set(len(self.policy.buckets))
         self.metrics.gauge("serve_executable_census").set(
@@ -910,7 +1048,10 @@ class CompressionService:
             sym = self.placement.put_batch(
                 0, np.zeros((self.config.max_batch, bh // sub, bw // sub,
                              self._bn_channels), np.int32))
-            np.asarray(self._si_decode_jit(params, bs, sym, prep))
+            # with SI-match scores on the executable returns a tuple —
+            # block on the whole output either way
+            jax.block_until_ready(self._si_decode_jit(params, bs, sym,
+                                                      prep))
             self._si_warmed = self._si_warmed | {(bh, bw)}
 
     def _warm_pair(self, bucket: Tuple[int, int], device: int,
@@ -998,7 +1139,7 @@ class CompressionService:
 
     # -- live model operations (ISSUE 9) -------------------------------------
 
-    def prepare_swap(self, ckpt_dir: str) -> dict:
+    def prepare_swap(self, ckpt_dir: str, canary: bool = True) -> dict:
         """Load + warm an incoming checkpoint into a staged ModelBundle,
         in the background of serving traffic (this runs on the CALLER's
         thread; the dataplane keeps serving the current bundle
@@ -1008,8 +1149,18 @@ class CompressionService:
         device) executable with the incoming replicas and primes a
         fresh codec (+ process pool, when that backend is on) — zero
         new XLA compiles, because executables are keyed by shapes and
-        params enter as arguments. Returns {"digest", "epoch", "ckpt",
-        "warm", "seconds"}; commit_swap() makes it live."""
+        params enter as arguments.
+
+        Golden canary gate (ISSUE 13): when the incoming manifest
+        records `canary` goldens (and quality telemetry is on), the
+        STAGED bundle is probed through the real executables and a
+        digest mismatch raises typed `CanaryFailed` — the commit is
+        refused before the degraded model answers a single request.
+        `canary=False` is the operator override (the chaos battery's
+        forced-commit scenario; the post-commit prober + rollback
+        watchdog remain the safety net). Returns {"digest", "epoch",
+        "ckpt", "warm", "canary", "seconds"}; commit_swap() makes it
+        live."""
         assert self._started, "start() + warmup() before a hot swap"
         from dsin_tpu.coding import loader as loader_lib
         epoch = self._swap.begin_prepare()
@@ -1042,6 +1193,13 @@ class CompressionService:
             if initargs is not None:
                 bundle.set_proc(self._make_entropy_proc(initargs))
             warm = self._warm_bundle(bundle)
+            canary_info = {"status": "disabled"}
+            if canary and self.config.quality_enabled:
+                # probe the staged bundle AFTER its warm (the warm
+                # already paged its replicas in, so the probe reuses
+                # every executable — zero compiles) and BEFORE it can
+                # stage: a failing canary leaves nothing to commit
+                canary_info = self._canary_check_bundle(bundle)
             self._swap.stage(bundle)
         except BaseException:
             # InjectedCrash included: the kill-during-swap chaos
@@ -1054,7 +1212,7 @@ class CompressionService:
         self.flight.record("swap_prepared", digest=digest,
                            ckpt=ckpt_dir)
         return {"digest": digest, "epoch": epoch, "ckpt": ckpt_dir,
-                "warm": warm,
+                "warm": warm, "canary": canary_info,
                 "seconds": round(time.monotonic() - t0, 3)}
 
     def _warm_bundle(self, bundle: swap_lib.ModelBundle) -> dict:
@@ -1132,13 +1290,15 @@ class CompressionService:
         self.flight.record("swap_abort")
         return self._swap.snapshot()
 
-    def swap_model(self, ckpt_dir: str) -> dict:
+    def swap_model(self, ckpt_dir: str, canary: bool = True) -> dict:
         """The one-call operator hot swap: prepare (load + manifest
-        verify + background warm) then commit. Any failure — manifest
-        mismatch, injected kill in either window — aborts back to the
-        old params; the service never stops serving. The fleet router
-        (serve/router.py) drives the two phases separately instead."""
-        info = self.prepare_swap(ckpt_dir)
+        verify + background warm + golden canary when the incoming
+        manifest records goldens) then commit. Any failure — manifest
+        mismatch, canary refusal, injected kill in either window —
+        aborts back to the old params; the service never stops serving.
+        The fleet router (serve/router.py) drives the two phases
+        separately instead. `canary=False` is the operator override."""
+        info = self.prepare_swap(ckpt_dir, canary=canary)
         try:
             self.commit_swap(expect_digest=info["digest"])
         except BaseException:
@@ -1174,6 +1334,219 @@ class CompressionService:
         if self._sessions is not None and self._sessions.live:
             self._sessions.clear(reason)
 
+    # -- golden canary (ISSUE 13, serve/quality.py) ---------------------------
+
+    def canary_goldens(self, staged: bool = False) -> dict:
+        """The `manifest_extra["canary"]` entry a checkpoint publisher
+        records (train/checkpoint.py): golden output digests of the
+        CURRENT model — or, with `staged`, of a prepared-but-
+        uncommitted bundle (the publish flow: prepare the candidate,
+        record what it SHOULD produce, abort, re-save the checkpoint
+        with the goldens)."""
+        assert self._started, "start() + warmup() before canary_goldens()"
+        bundle = self._swap.staged if staged else self._swap.current
+        if bundle is None:
+            raise swap_lib.SwapError(
+                "canary_goldens(staged=True) with nothing staged — "
+                "prepare_swap first")
+        return quality_lib.goldens_struct(
+            self.config.canary_seed, self.policy.buckets,
+            self._canary_probe_bundle(bundle))
+
+    def _canary_probe_bundle(self, bundle) -> dict:
+        """Drive the pinned canary inputs through one bundle's REAL
+        executables (the same shape-keyed programs the dataplane
+        dispatches — params enter as arguments, so probing a staged
+        bundle compiles nothing) and digest every output. Lane 0 of a
+        max_batch-padded batch, exactly how the dataplane assembles one,
+        so these digests equal what the serve path produces for the
+        same model (per-lane results are batch-composition independent;
+        tests/test_serve_quality.py pins the equality)."""
+        sub = buckets_lib.SUBSAMPLING
+        digests = {}
+        for bucket in self.policy.buckets:
+            bh, bw = bucket
+            img, side = self._canary_imgs[bucket]
+            params, bs = bundle.device_state[0]
+            x = np.zeros((self.config.max_batch, bh, bw, 3), np.float32)
+            x[0] = buckets_lib.pad_to_bucket(
+                img.astype(np.float32, copy=False), bucket)
+            symbols = np.asarray(self._encode_fn(
+                params, bs, self.placement.put_batch(0, x)))
+            vol = np.transpose(symbols[0], (2, 0, 1))
+            payload = bundle.codec.encode(vol)
+            stream = frame_stream(payload, (bh, bw), bucket)
+            entry = {"encode": quality_lib.digest_bytes(stream)}
+            vol2 = bundle.codec.decode(payload)
+            sym = np.zeros((self.config.max_batch, bh // sub, bw // sub,
+                            self._bn_channels), np.int32)
+            sym[0] = np.transpose(vol2, (1, 2, 0))
+            sym_dev = self.placement.put_batch(0, sym)
+            imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
+            out = buckets_lib.crop_from_bucket(
+                imgs[0], (bh, bw)).astype(np.uint8)
+            entry["decode"] = quality_lib.digest_bytes(out.tobytes())
+            entry["decode_si"] = None
+            if self._si_enabled:
+                prep = self._si_prep_jit(
+                    params, bs,
+                    jnp.asarray(buckets_lib.pad_to_bucket(
+                        side.astype(np.float32, copy=False), bucket)),
+                    self._si_factors[bucket])
+                si_out = self._si_decode_jit(params, bs, sym_dev, prep)
+                if self._si_scores_enabled:
+                    si_out = si_out[0]
+                si_img = buckets_lib.crop_from_bucket(
+                    np.asarray(si_out)[0], (bh, bw)).astype(np.uint8)
+                entry["decode_si"] = quality_lib.digest_bytes(
+                    si_img.tobytes())
+            digests[quality_lib.bucket_key(bucket)] = entry
+        return digests
+
+    def _canary_check_bundle(self, bundle) -> dict:
+        """Prepare-time canary: probe a staged bundle against ITS
+        manifest's goldens. A manifest without goldens skips (recorded —
+        pre-canary checkpoints keep swapping); goldens that mismatch —
+        or that cannot be compared (different canary seed, a served
+        bucket they never covered) — refuse typed `CanaryFailed`."""
+        goldens = (bundle.manifest or {}).get("canary")
+        if goldens is None:
+            self.metrics.counter("serve_canary_swap_skipped").inc()
+            return {"status": "skipped",
+                    "reason": "checkpoint manifest records no canary "
+                              "goldens"}
+        observed = self._canary_probe_bundle(bundle)
+        mismatches = quality_lib.compare_goldens(
+            goldens, observed, seed=self.config.canary_seed,
+            buckets=self.policy.buckets)
+        if mismatches:
+            self.metrics.counter("serve_canary_swap_refusals").inc()
+            self.flight.record("canary_refused_swap",
+                               digest=bundle.digest,
+                               mismatches=mismatches[:8])
+            raise quality_lib.CanaryFailed(
+                f"staged model {bundle.digest} failed its golden canary "
+                f"— its outputs are not the outputs its manifest "
+                f"promises; refusing to commit it: "
+                f"{'; '.join(mismatches[:4])}")
+        self.metrics.counter("serve_canary_swap_passes").inc()
+        return {"status": "passed", "buckets": len(observed)}
+
+    def run_canary(self) -> dict:
+        """One canary probe through the REAL serve path (submit_encode /
+        submit_decode / submit_decode_si on a pinned canary session),
+        compared against the serving model's baseline — its manifest's
+        goldens when comparable, else the self-anchored first probe of
+        this digest. A digest MISMATCH is definitive (pinned inputs,
+        deterministic executables): it fails the canary, dumps the
+        flight recorder, and arms the rollback watchdog when one is
+        judging this model. Typed serve errors during the probe (a
+        drain, a mid-probe swap expiring the canary session) are
+        infrastructure, not model quality — counted separately, never a
+        canary failure."""
+        assert self._started and self._warmup_done, \
+            "start() + warmup() before run_canary()"
+        if not self.config.quality_enabled:
+            return {"status": "disabled"}
+        if not self._canary.claim():
+            return {"status": "busy"}
+        try:
+            return self._run_canary_claimed()
+        finally:
+            self._canary.release()
+
+    def _run_canary_claimed(self) -> dict:
+        t0 = time.monotonic()
+        timeout = self.config.canary_timeout_s
+        start_digest = self.model_digest
+        bundle = self._swap.current
+        observed = {}
+        try:
+            for bucket in self.policy.buckets:
+                img, side = self._canary_imgs[bucket]
+                res = self.encode(img, timeout=timeout)
+                entry = {"encode": quality_lib.digest_bytes(res.stream)}
+                dec = self.decode(res.stream, timeout=timeout)
+                entry["decode"] = quality_lib.digest_bytes(dec.tobytes())
+                entry["decode_si"] = None
+                if self._si_enabled:
+                    si = self._canary_decode_si(bucket, side, res.stream,
+                                                timeout)
+                    entry["decode_si"] = quality_lib.digest_bytes(
+                        si.tobytes())
+                observed[quality_lib.bucket_key(bucket)] = entry
+        except (ServeError, ValueError, TimeoutError) as e:
+            # typed infrastructure trouble (drain, shed, session churn
+            # racing a swap, a probe op blowing canary_timeout_s on a
+            # stalled queue): the probe learned nothing about quality
+            self.metrics.counter("serve_canary_errors").inc()
+            result = {"status": "error", "digest": start_digest,
+                      "error": type(e).__name__}
+            self._canary.note_result(result)
+            return result
+        if self.model_digest != start_digest:
+            # a swap/rollback landed mid-probe: the digests mix two
+            # models — discard rather than judge either
+            self.metrics.counter("serve_canary_races").inc()
+            result = {"status": "raced", "digest": start_digest}
+            self._canary.note_result(result)
+            return result
+        source, mismatches = self._canary.baseline_for(
+            start_digest, bundle.manifest, self.policy.buckets, observed)
+        ms = (time.monotonic() - t0) * 1e3
+        self.metrics.counter("serve_canary_runs").inc()
+        self.metrics.histogram("serve_canary_ms").observe(ms)
+        if mismatches:
+            self.metrics.counter("serve_canary_failures").inc()
+            self.metrics.gauge("serve_canary_ok").set(0)
+            result = {"status": "failed", "digest": start_digest,
+                      "baseline": source, "mismatches": mismatches}
+            self._canary.note_result(result)
+            # the forensic + rollback wiring: dump the flight ring, and
+            # when the watchdog is judging exactly this model, canary
+            # evidence arms it (its next supervisor tick rolls back)
+            self.flight.note_death("canary_failure", digest=start_digest,
+                                   baseline=source,
+                                   mismatches=mismatches[:8])
+            if self._watchdog is not None:
+                self._watchdog.note_canary_failure(start_digest)
+            return result
+        self.metrics.gauge("serve_canary_ok").set(1)
+        result = {"status": "ok", "digest": start_digest,
+                  "baseline": source, "ms": round(ms, 1)}
+        self._canary.note_result(result)
+        return result
+
+    def _canary_decode_si(self, bucket, side, stream, timeout):
+        """SI leg of one probe on the pinned canary session — re-opened
+        once when the store expired it (LRU pressure, a swap's
+        invalidation); a second expiry inside one probe propagates as
+        the probe's typed error."""
+        sid = self._canary_sids.get(bucket)
+        if sid is None:
+            sid = self.open_session(side)
+            self._canary_sids[bucket] = sid
+        try:
+            return self.decode_si(stream, sid, timeout=timeout)
+        except session_lib.SessionExpired:
+            sid = self.open_session(side)
+            self._canary_sids[bucket] = sid
+            return self.decode_si(stream, sid, timeout=timeout)
+
+    def _canary_loop(self) -> None:
+        """The background prober thread: one run_canary per period,
+        starting only once warmup compiled the census (a pre-warm probe
+        would compile executables the warmup owns). Probe errors are
+        counted, never fatal — the prober outlives everything but
+        drain."""
+        while not self._draining.wait(self.config.canary_every_s):
+            if not self._warmup_done:
+                continue
+            try:
+                self.run_canary()
+            except Exception:   # noqa: BLE001 — the prober must survive
+                self.metrics.counter("serve_canary_errors").inc()
+
     @property
     def draining(self) -> bool:
         return self._draining.is_set()
@@ -1204,6 +1577,11 @@ class CompressionService:
             # the supervisor exits once draining is set; join it first so
             # no restart races the worker joins below
             self._supervisor.join(timeout)
+        if self._canary_thread is not None:
+            # the prober exits on the drain flag like the supervisor; a
+            # probe in flight resolves typed (the queue is closing) and
+            # is counted as a canary error, never a hang
+            self._canary_thread.join(timeout)
         with self._workers_lock:
             workers = list(self._workers)
         for t in workers:
@@ -1283,7 +1661,14 @@ class CompressionService:
                 # the SI session dataplane (ISSUE 10; absent = SI off)
                 **({"sessions": {"live": self._sessions.live,
                                  "bytes": self._sessions.bytes_used}}
-                   if self._sessions is not None else {})}
+                   if self._sessions is not None else {}),
+                # model health (ISSUE 13; absent = quality off): the
+                # last canary verdict + how many sessions are alarmed
+                **({"quality": {
+                        "canary": self._canary.last,
+                        "si_match_alarms": int(self.metrics.gauge(
+                            "serve_si_match_alarms").value)}}
+                   if self.config.quality_enabled else {})}
 
     def _deadline(self, deadline_ms: Optional[float]) -> Optional[float]:
         return (None if deadline_ms is None
@@ -1488,9 +1873,21 @@ class CompressionService:
             else sessions.next_sid()
         nbytes = sum(int(leaf.nbytes)
                      for leaf in jax.tree_util.tree_leaves(prep))
-        sessions.put(session_lib.SessionEntry(
-            sid=sid, prep=prep, bucket=bucket, nbytes=nbytes,
-            digest=bundle.digest))
+        # tracker registration BEFORE the store put: the store's evict
+        # hook is what un-registers, and it can only fire for sids the
+        # store holds — registering after the put would let a racing
+        # eviction/clear land between the two and leak a phantom
+        # tracker entry no hook will ever clean (serve/quality.py)
+        self.quality.session_open(sid)
+        try:
+            sessions.put(session_lib.SessionEntry(
+                sid=sid, prep=prep, bucket=bucket, nbytes=nbytes,
+                digest=bundle.digest))
+        except BaseException:
+            # refused (SessionOverCapacity): the sid never entered the
+            # store, so no evict hook will fire — unregister here
+            self.quality.session_gone(sid, "rejected")
+            raise
         self.metrics.counter("serve_sessions_opened").inc()
         return sid
 
@@ -2134,6 +2531,25 @@ class CompressionService:
                         shape=(h, w), bucket=rec.bucket,
                         model_digest=rec.bundle.digest))
                     self._observe_latency(req)
+                # model-health telemetry (ISSUE 13): AFTER every future
+                # resolved, still on this pool thread — the always-on
+                # bpp export plus the head-sampled coding-gap pass
+                # (pure numpy; the caller's latency never pays for it)
+                if self.quality.enabled:
+                    gap_codec = None
+                    for i, req in enumerate(rec.batch):
+                        payload, exc = payloads[i]
+                        if exc is not None:
+                            continue
+                        h, w = req.payload[1]
+                        self.quality.note_encode(
+                            rec.bucket, (h, w), len(payload),
+                            len(payload) + _FRAME_LEN)
+                        if self.quality.sample_gap():
+                            if gap_codec is None:
+                                gap_codec = self._thread_codec(rec.bundle)
+                            self.quality.observe_gap(
+                                gap_codec, vols[i], payload, rec.bucket)
             else:
                 te0 = time.monotonic()
                 self._decode_batch_lanes(
@@ -2174,9 +2590,17 @@ class CompressionService:
             t_dev = time.monotonic()
             params, bs = rec.bundle.device_state[rec.device]
             sym_dev = self.placement.put_batch(rec.device, rec.sym)
+            si_scores = None
             if rec.kind == DECODE_SI:
-                imgs = np.asarray(self._si_decode_jit(
-                    params, bs, sym_dev, rec.si_entry.prep))
+                out = self._si_decode_jit(params, bs, sym_dev,
+                                          rec.si_entry.prep)
+                if self._si_scores_enabled:
+                    # (images, winning per-patch scores) — the score
+                    # half is the SI-match quality signal (ISSUE 13)
+                    imgs = np.asarray(out[0])
+                    si_scores = np.asarray(out[1])
+                else:
+                    imgs = np.asarray(out)
             else:
                 imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
             t_dev_end = time.monotonic()
@@ -2203,6 +2627,14 @@ class CompressionService:
                     buckets_lib.crop_from_bucket(imgs[i], (h, w))
                     .astype(np.uint8))
                 self._observe_latency(r)
+            if si_scores is not None and self.quality.enabled:
+                # per-session SI-match summary, after the futures
+                # resolved; failed lanes decoded zeros — their scores
+                # are meaningless and stay out
+                for i, r in enumerate(rec.batch):
+                    if i not in rec.per_item_exc:
+                        self.quality.note_si_scores(r.session,
+                                                    si_scores[i])
         starts = [s[0] for s in spans if s[0] is not None]
         ends = [s[1] for s in spans if s[1] is not None]
         entropy_ms = (max(ends) - min(starts)) * 1e3 \
@@ -2279,10 +2711,9 @@ class CompressionService:
             params, bs, self.placement.put_batch(device, x)))
         t_ent = time.monotonic()
         from dsin_tpu.coding import loader as loader_lib
-        payloads = loader_lib.encode_batch_isolated(
-            bundle.codec,
-            [np.transpose(symbols[i], (2, 0, 1))
-             for i in range(len(batch))])
+        vols = [np.transpose(symbols[i], (2, 0, 1))
+                for i in range(len(batch))]
+        payloads = loader_lib.encode_batch_isolated(bundle.codec, vols)
         for i, r in enumerate(batch):
             payload, exc = payloads[i]
             if exc is not None:
@@ -2298,6 +2729,20 @@ class CompressionService:
                 shape=(h, w), bucket=bucket,
                 model_digest=bundle.digest))
         t_done = time.monotonic()
+        # quality telemetry after t_done: the serialized path has no
+        # pool to hide the sampled gap pass on, but it still must not
+        # bill the entropy span/metric (the serve_bench cross-check)
+        if self.quality.enabled:
+            for i, r in enumerate(batch):
+                payload, exc = payloads[i]
+                if exc is not None:
+                    continue
+                h, w = r.payload[1]
+                self.quality.note_encode(bucket, (h, w), len(payload),
+                                         len(payload) + _FRAME_LEN)
+                if self.quality.sample_gap():
+                    self.quality.observe_gap(bundle.codec, vols[i],
+                                             payload, bucket)
         # spans share the exact instants the stage metrics integrate
         # (the serve_bench cross-check holds them to each other)
         self.tracer.span_batch(batch, trace_lib.SPAN_DEVICE, t_dev,
@@ -2348,9 +2793,14 @@ class CompressionService:
         params, bs = bundle.device_state[device]
         t_dev = time.monotonic()
         sym_dev = self.placement.put_batch(device, sym)
+        si_scores = None
         if si:
-            imgs = np.asarray(self._si_decode_jit(params, bs, sym_dev,
-                                                  si_entry.prep))
+            out = self._si_decode_jit(params, bs, sym_dev, si_entry.prep)
+            if self._si_scores_enabled:
+                imgs = np.asarray(out[0])
+                si_scores = np.asarray(out[1])
+            else:
+                imgs = np.asarray(out)
         else:
             imgs = np.asarray(self._decode_fn(params, bs, sym_dev))
         t_dev_end = time.monotonic()
@@ -2372,4 +2822,8 @@ class CompressionService:
             r.future.set_result(
                 buckets_lib.crop_from_bucket(imgs[i], (h, w))
                 .astype(np.uint8))
+        if si_scores is not None and self.quality.enabled:
+            for i, r in enumerate(batch):
+                if i not in per_item_exc:
+                    self.quality.note_si_scores(r.session, si_scores[i])
         return (device_ms, entropy_ms)

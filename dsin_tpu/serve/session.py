@@ -99,7 +99,7 @@ class SessionStore:
 
     def __init__(self, max_sessions: int, max_bytes: int,
                  ttl_s: Optional[float] = None, metrics=None,
-                 clock=time.monotonic, flight=None):
+                 clock=time.monotonic, flight=None, on_evict=None):
         if max_sessions < 1:
             raise ValueError(f"max_sessions must be >= 1, "
                              f"got {max_sessions}")
@@ -116,6 +116,14 @@ class SessionStore:
         #: needs (its ring lock ranks above serve.session, so recording
         #: from under this store's lock is legal)
         self.flight = flight
+        #: optional `fn(sid, reason)` fired on EVERY way a session
+        #: leaves the store (evict/TTL/swap/clear) — the quality
+        #: monitor (serve/quality.py) drops its per-session SI-match
+        #: stats here so a dead session cannot pin tracker memory or a
+        #: stale alarm. Runs under this store's lock: the hook must
+        #: touch only ranks above serve.session (serve.quality, 19,
+        #: does).
+        self.on_evict = on_evict
         self._clock = clock
         self._lock = locks_lib.RankedLock("serve.session")
         # insertion/recency order: first = least recently used
@@ -157,6 +165,8 @@ class SessionStore:
         if self.flight is not None:
             self.flight.record("session_evict", sid=sid, reason=reason,
                                bucket=list(slot.entry.bucket))
+        if self.on_evict is not None:
+            self.on_evict(sid, reason)
         return True
 
     def _sweep_ttl_locked(self, now: float) -> None:
@@ -231,13 +241,17 @@ class SessionStore:
         """Evict everything (model hot swap / rollback / drain). Returns
         the number of sessions dropped."""
         with self._lock:
-            n = len(self._slots)
+            dropped = list(self._slots)
+            n = len(dropped)
             self._slots.clear()
             self._bytes = 0
             self._note_eviction(reason, n)
             if self.flight is not None and n:
                 self.flight.record("sessions_cleared", reason=reason,
                                    count=n)
+            if self.on_evict is not None:
+                for sid in dropped:
+                    self.on_evict(sid, reason)
             self._publish_locked()
             return n
 

@@ -166,6 +166,15 @@ class SwapCoordinator:
         with self._lock:
             return self._current
 
+    @property
+    def staged(self) -> Optional[ModelBundle]:
+        """The prepared-but-uncommitted bundle, if any — the canary
+        goldens publisher (serve/service.py `canary_goldens(staged=
+        True)`) probes it to record what an incoming model SHOULD
+        produce before anyone commits it."""
+        with self._lock:
+            return self._staged
+
     def live_epochs(self) -> List[int]:
         """Epochs a dataplane thread may still legitimately touch —
         the thread-local codec-clone caches prune against this."""
@@ -341,6 +350,15 @@ class RollbackWatchdog:
     watchdog racing an operator who already rolled back refuses typed
     instead of double-flipping models.
 
+    Canary watch (ISSUE 13): `arm` also pins the committed digest for
+    the golden canary, and keeps watching it even after a HEALTHY
+    error-rate verdict — a numerically degraded model emits wrong
+    BYTES, not typed errors, so the rate comparison can come back clean
+    while the canary is still probing. `note_canary_failure(digest)`
+    against the watched digest makes the next `evaluate` fire
+    immediately (reason "canary"); the watch clears on disarm/rollback
+    or the next arm.
+
     Pure bookkeeping: this class never touches the swap coordinator or
     metrics itself — the service samples the counters, and acts on the
     verdict OUTSIDE this object's lock (the `serve.watchdog` rank sits
@@ -363,6 +381,15 @@ class RollbackWatchdog:
         # (t, typed_errors, resolved) samples, oldest first
         self._samples: deque = deque()   # guarded-by: self._lock
         self._armed: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        # the canary watch (ISSUE 13) outlives the error-rate verdict:
+        # a healthy error rate clears `_armed` within one window, but
+        # the first canary probe of a numerically degraded model can
+        # take LONGER than that window (the errors it makes are wrong
+        # BYTES, not typed failures) — so the committed digest stays
+        # watched until disarm/rollback/next arm, and a canary failure
+        # against it fires whenever it lands
+        self._watch_digest: Optional[str] = None   # guarded-by: self._lock
+        self._canary_failed = False                # guarded-by: self._lock
 
     @staticmethod
     def _rate(errors: int, resolved: int) -> float:
@@ -397,11 +424,32 @@ class RollbackWatchdog:
                 "base_resolved": base_r,
                 "pre_rate": self._rate(pre_e, pre_r),
             }
+            self._watch_digest = digest
+            self._canary_failed = False
 
     def disarm(self) -> None:
-        """Manual swap/rollback supersedes a pending comparison."""
+        """Manual swap/rollback supersedes a pending comparison AND the
+        canary watch — never judge a model that already left."""
         with self._lock:
             self._armed = None
+            self._watch_digest = None
+            self._canary_failed = False
+
+    def note_canary_failure(self, digest: str) -> bool:
+        """Second firing signal (ISSUE 13): the golden canary observed
+        a digest mismatch on the WATCHED model (the last committed
+        digest — watched until disarm/rollback/next arm, even after the
+        error-rate comparison came back healthy). Canary evidence is
+        definitive (pinned inputs through deterministic executables),
+        so the next `evaluate` fires immediately — no error-rate window
+        to wait out. Ignored (False) when nothing is watched or the
+        failure names a different digest (a stale probe racing a
+        rollback must not condemn the model that replaced it)."""
+        with self._lock:
+            if self._watch_digest is None or self._watch_digest != digest:
+                return False
+            self._canary_failed = True
+        return True
 
     @property
     def armed(self) -> bool:
@@ -416,6 +464,21 @@ class RollbackWatchdog:
         model over); else {"fire", "pre_rate", "post_rate", "digest"}
         and the watchdog disarms."""
         with self._lock:
+            if self._canary_failed:
+                # canary evidence stands alone: fire now, regardless of
+                # traffic volume or whether the error-rate comparison
+                # already returned healthy (wrong BYTES are not typed
+                # errors — the rate never sees them)
+                digest = self._watch_digest
+                self._armed = None
+                self._watch_digest = None
+                self._canary_failed = False
+                return {
+                    "fire": True,
+                    "reason": "canary",
+                    "digest": digest,
+                    "window_s": self.window_s,
+                }
             armed = self._armed
             if armed is None:
                 return None
@@ -426,9 +489,12 @@ class RollbackWatchdog:
                 return None
             post_rate = self._rate(typed_errors - armed["base_errors"],
                                    post_resolved)
+            # the error-rate verdict is returned exactly once; the
+            # canary watch on this digest persists (see __init__)
             self._armed = None
         return {
             "fire": post_rate - armed["pre_rate"] > self.threshold,
+            "reason": "error_rate",
             "pre_rate": round(armed["pre_rate"], 4),
             "post_rate": round(post_rate, 4),
             "post_resolved": post_resolved,

@@ -167,6 +167,20 @@ def build_manifest(state, files: Optional[Dict[str, Dict[str, int]]] = None,
     if files is not None:
         manifest["files"] = dict(sorted(files.items()))
     if extra:
+        if "canary" in extra:
+            # golden canary digests (ISSUE 13, serve/quality.py): the
+            # serving fleet REFUSES a swap whose staged outputs do not
+            # match these, so publishing a malformed entry would brick
+            # every future swap of this checkpoint — validate at save,
+            # where the publisher can still fix it
+            from dsin_tpu.serve.quality import validate_goldens
+            bad = validate_goldens(extra["canary"])
+            if bad is not None:
+                raise ValueError(
+                    f"manifest_extra['canary'] is malformed ({bad}) — "
+                    f"record the structure serve/quality.py "
+                    f"goldens_struct builds (CompressionService"
+                    f".canary_goldens returns it)")
         manifest.update(extra)
     return manifest
 

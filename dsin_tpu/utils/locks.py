@@ -39,6 +39,14 @@ a thread holding rank r may only acquire ranks > r):
                               the hot-swap state machine (serve/swap.py)
       18  serve.watchdog      post-swap rollback-watchdog sample window
                               (serve/swap.py RollbackWatchdog)
+      19  serve.quality       model-health telemetry state: coding-gap
+                              sampler rotation, per-session SI-match
+                              stats, canary baselines (serve/quality.py)
+                              — above serve.session (evict hooks call
+                              in from under 16) and below the trace/
+                              metric leaves it reports into; never
+                              nested with serve.watchdog (canary
+                              verdicts are handed off outside the lock)
       20  serve.workers       worker-pool bookkeeping (serve/service.py)
       25  serve.entropy_proc  process-pool slot / child-death rebuild (serve/service.py)
       30  codec.engine        lazy incremental-engine slot (coding/codec.py)
@@ -93,6 +101,7 @@ HIERARCHY: Dict[str, int] = {
     "serve.session": 16,
     "serve.model": 17,
     "serve.watchdog": 18,
+    "serve.quality": 19,
     "serve.workers": 20,
     "serve.entropy_proc": 25,
     "codec.engine": 30,

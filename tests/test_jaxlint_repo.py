@@ -63,6 +63,7 @@ THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
     ("dsin_tpu", "serve", "swap.py"),     # hot-swap coordinator (ISSUE 9)
     ("dsin_tpu", "serve", "session.py"),  # SI session store (ISSUE 10)
     ("dsin_tpu", "serve", "trace.py"),    # tracer + flight recorder (ISSUE 11)
+    ("dsin_tpu", "serve", "quality.py"),  # model-health telemetry (ISSUE 13)
     ("dsin_tpu", "coding", "codec.py"),
     ("dsin_tpu", "coding", "incremental.py"),
     ("dsin_tpu", "coding", "rans.py"),

@@ -1,0 +1,381 @@
+"""Model-health observability (ISSUE 13, serve/quality.py).
+
+Four layers under test:
+  * the coding-gap math — `codec.coding_gap` vs a hand-computed
+    realized-bits-minus-ideal-bits on a real stream (ONE definition;
+    the serve telemetry calls the same method);
+  * the QualityMonitor — deterministic gap head-sampling, bpp export,
+    per-session SI-match summaries and the floor-alarm transitions;
+  * the golden canary — serve-path probe vs direct-bundle probe
+    equality, self-anchoring, the catch matrix per op, swap refusal
+    (`CanaryFailed`) on a bit-flipped checkpoint, and the watchdog
+    arming a forced-committed one;
+  * budget-0 — the whole telemetry layer on (gap sampling at 1.0, SI
+    scores, a canary probe) compiles nothing after warmup.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dsin_tpu.serve import (CanaryFailed, CompressionService,
+                            MetricsRegistry, QualityMonitor,
+                            RollbackWatchdog, ServiceConfig)
+from dsin_tpu.serve import quality as quality_lib
+from dsin_tpu.serve.trace import FlightRecorder
+from dsin_tpu.train import checkpoint as ckpt_lib
+
+BUCKETS = ((16, 24), (32, 48))
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("quality_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+@pytest.fixture(scope="module")
+def service(tiny_cfg_files):
+    ae_p, pc_p = tiny_cfg_files
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=BUCKETS, max_batch=2,
+        max_wait_ms=2.0, max_queue=16, workers=1, enable_si=True,
+        session_max=4,
+        # watchdog present so canary arming is exercisable; generous
+        # window — these tests drive evaluate() directly
+        rollback_watchdog_window_s=60.0)).start()
+    warm = svc.warmup()
+    assert warm["compiles"] > 0
+    yield svc
+    svc.drain()
+
+
+def _img(rng, h, w):
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+# -- coding gap ---------------------------------------------------------------
+
+def test_coding_gap_math_vs_hand_coded_stream(service):
+    codec = service.codec
+    rng = np.random.default_rng(0)
+    vol = rng.integers(0, codec.num_centers, (4, 2, 3), dtype=np.int64)
+    stream = codec.encode(vol)
+    gap = codec.coding_gap(vol, stream)
+    # hand-computed: realized payload bits (DTPC header excluded) minus
+    # the quantized-table bound of the SAME engine
+    want_bits = (len(stream) - 13) * 8
+    ideal = codec.ideal_bits(vol, mode="wavefront_np")
+    assert gap["payload_bits"] == want_bits
+    assert gap["ideal_bits"] == pytest.approx(ideal, abs=1e-3)
+    assert gap["gap_bits"] == pytest.approx(want_bits - ideal, abs=1e-3)
+    assert gap["gap_pct"] == pytest.approx(
+        100.0 * (want_bits - ideal) / ideal, abs=1e-3)
+    # the bound is a LOWER bound for the stream that engine coded
+    assert gap["gap_bits"] >= 0.0
+
+
+def test_coding_gap_refuses_mismatched_volume(service):
+    codec = service.codec
+    rng = np.random.default_rng(1)
+    vol = rng.integers(0, codec.num_centers, (4, 2, 3), dtype=np.int64)
+    stream = codec.encode(vol)
+    with pytest.raises(ValueError, match="not the volume"):
+        codec.coding_gap(vol[:2], stream)
+
+
+# -- QualityMonitor -----------------------------------------------------------
+
+def test_gap_head_sampler_is_deterministic_rotation():
+    qm = QualityMonitor(metrics=MetricsRegistry(), gap_sample_rate=0.25)
+    hits = [qm.sample_gap() for _ in range(16)]
+    assert sum(hits) == 4
+    # the rotation, not RNG: a second monitor replays the same pattern
+    qm2 = QualityMonitor(metrics=MetricsRegistry(), gap_sample_rate=0.25)
+    assert [qm2.sample_gap() for _ in range(16)] == hits
+    assert qm.set_gap_sample_rate(1.0) == 0.25
+    assert all(qm.sample_gap() for _ in range(5))
+    prev = qm.set_enabled(False)
+    assert prev is True and not qm.sample_gap()
+    with pytest.raises(ValueError):
+        QualityMonitor(metrics=MetricsRegistry(), gap_sample_rate=1.5)
+
+
+def test_si_match_alarm_transitions_and_session_cleanup():
+    m = MetricsRegistry()
+    fr = FlightRecorder(capacity=64)
+    qm = QualityMonitor(metrics=m, flight=fr, si_score_floor=0.5,
+                        si_alarm_frac=0.5, si_alarm_min_samples=4)
+    # scores for a sid that was never registered are DROPPED — a batch
+    # finishing after its session's eviction must not resurrect it
+    qm.note_si_scores("phantom", np.array([0.1, 0.1, 0.1, 0.1]))
+    assert qm.si_session_summaries() == {}
+    qm.session_open("good")
+    qm.session_open("bad")
+    # healthy session: all scores above the floor — never alarms
+    qm.note_si_scores("good", np.array([0.9, 0.8, 0.7, 0.95]))
+    assert m.counter("serve_si_match_alarm_transitions").value == 0
+    # degraded session: everything below the floor — arms at min_samples
+    qm.note_si_scores("bad", np.array([0.1, 0.05]))
+    assert not qm.si_session_summaries()["bad"]["alarmed"]
+    qm.note_si_scores("bad", np.array([0.2, 0.1]))
+    summaries = qm.si_session_summaries()
+    assert summaries["bad"]["alarmed"] is True
+    assert summaries["bad"]["frac_below_floor"] == 1.0
+    assert summaries["bad"]["min"] == pytest.approx(0.05)
+    assert m.counter("serve_si_match_alarm_transitions").value == 1
+    assert m.gauge("serve_si_match_alarms").value == 1
+    events = [e for e in fr.snapshot() if e["kind"] == "quality_alarm"]
+    assert events and events[-1]["state"] == "armed"
+    assert events[-1]["sid"] == "bad"
+    # recovery hysteresis: enough good scores to fall below frac/2
+    qm.note_si_scores("bad", np.full(32, 0.9))
+    assert qm.si_session_summaries()["bad"]["alarmed"] is False
+    assert m.counter("serve_si_match_alarm_transitions").value == 2
+    assert m.gauge("serve_si_match_alarms").value == 0
+    # store evict hook drops the stats entirely
+    qm.session_gone("bad", "lru")
+    qm.session_gone("good", "lru")
+    assert qm.si_session_summaries() == {}
+
+
+def test_service_exports_bpp_gap_and_si_score_metrics(service):
+    svc = service
+    rng = np.random.default_rng(2)
+    prev = svc.quality.set_gap_sample_rate(1.0)
+    try:
+        res = svc.encode(_img(rng, 16, 24))
+        svc.encode(_img(rng, 30, 40))
+        sid = svc.open_session(_img(rng, 16, 24))
+        svc.decode_si(res.stream, sid)
+        svc.decode_si(res.stream, sid)
+    finally:
+        svc.quality.set_gap_sample_rate(prev)
+    snap = svc.metrics.snapshot()
+    h = snap["histograms"]
+    assert h["serve_bpp_payload_16x24"]["count"] >= 1
+    assert h["serve_bpp_wire_16x24"]["count"] >= 1
+    # wire bpp carries the 21-byte DSRV frame overhead
+    assert h["serve_bpp_wire_16x24"]["mean"] > \
+        h["serve_bpp_payload_16x24"]["mean"]
+    assert h["serve_bpp_payload_32x48"]["count"] >= 1
+    gap = h["serve_coding_gap_pct_16x24"]
+    assert gap["count"] >= 1 and gap["min"] >= 0.0
+    assert snap["counters"]["serve_coding_gap_samples"] >= 2
+    # SI-match scores ride the decode_si path per session
+    assert h["serve_si_match_score"]["count"] >= 2
+    assert sid in svc.quality.si_session_summaries()
+    svc.close_session(sid)
+    # the evict hook pruned the tracker
+    assert sid not in svc.quality.si_session_summaries()
+
+
+# -- golden canary ------------------------------------------------------------
+
+def test_canary_serve_path_matches_bundle_probe_and_self_anchors(service):
+    svc = service
+    first = svc.run_canary()
+    assert first["status"] == "ok" and first["baseline"] == "anchored"
+    second = svc.run_canary()
+    assert second["status"] == "ok" and second["baseline"] == "self"
+    assert svc.metrics.counter("serve_canary_failures").value == 0
+    assert svc.metrics.gauge("serve_canary_ok").value == 1
+    # the serve-path probe and the direct-bundle probe (what
+    # prepare_swap runs against a STAGED bundle) see the same bytes:
+    # publishing goldens from one and checking the other is sound
+    goldens = svc.canary_goldens()
+    assert quality_lib.validate_goldens(goldens) is None
+    observed = svc._canary_probe_bundle(svc._swap.current)
+    assert goldens["digests"] == observed
+    src, mismatches = svc._canary.baseline_for(
+        svc.model_digest, None, svc.policy.buckets, observed)
+    assert src == "self" and mismatches == []
+    # a manifest whose goldens do not cover every served bucket is not
+    # comparable at probe time: the prober self-anchors (drift watch)
+    # instead of paging a permanent false failure — only the SWAP gate
+    # refuses partial coverage typed (compare_goldens, pinned below)
+    key0 = quality_lib.bucket_key(BUCKETS[0])
+    partial = quality_lib.goldens_struct(
+        0, [BUCKETS[0]], {key0: observed[key0]})
+    cs = quality_lib.CanaryState(0, svc.metrics)
+    src, mismatches = cs.baseline_for(
+        "elsewhere", {"canary": partial}, svc.policy.buckets, observed)
+    assert src == "anchored" and mismatches == []
+
+
+def test_canary_catch_matrix(service):
+    """Every op's digest is independently load-bearing: corrupting any
+    one of encode/decode/decode_si goldens is caught, for every
+    bucket."""
+    svc = service
+    goldens = svc.canary_goldens()
+    observed = svc._canary_probe_bundle(svc._swap.current)
+    for bucket in BUCKETS:
+        key = quality_lib.bucket_key(bucket)
+        for op in ("encode", "decode", "decode_si"):
+            assert goldens["digests"][key][op], (key, op)
+            bad = {k: dict(v) for k, v in goldens["digests"].items()}
+            bad[key][op] = "0" * 16
+            tampered = quality_lib.goldens_struct(
+                goldens["seed"], BUCKETS, bad)
+            mismatches = quality_lib.compare_goldens(
+                tampered, observed, seed=0, buckets=BUCKETS)
+            assert len(mismatches) == 1 and op in mismatches[0], \
+                (key, op, mismatches)
+    # matching goldens pass; seed skew and bucket gaps REFUSE rather
+    # than silently skip
+    assert quality_lib.compare_goldens(goldens, observed, seed=0,
+                                       buckets=BUCKETS) == []
+    assert quality_lib.compare_goldens(goldens, observed, seed=1,
+                                       buckets=BUCKETS)
+    assert quality_lib.compare_goldens(goldens, observed, seed=0,
+                                       buckets=[(64, 96)])
+
+
+def test_canary_failure_end_to_end_flight_and_watchdog(service):
+    """A serving model whose manifest promises DIFFERENT outputs fails
+    the periodic canary: metrics flip, the flight recorder gets the
+    canary_failure event, and the armed watchdog is told."""
+    svc = service
+    goldens = svc.canary_goldens()
+    bad = {k: dict(v) for k, v in goldens["digests"].items()}
+    bad[quality_lib.bucket_key(BUCKETS[0])]["encode"] = "f" * 16
+    tampered = quality_lib.goldens_struct(goldens["seed"], BUCKETS, bad)
+    bundle = svc._swap.current
+    old_manifest, old_state = bundle.manifest, svc._canary
+    svc._canary = quality_lib.CanaryState(0, svc.metrics,
+                                          flight=svc.flight)
+    bundle.manifest = {"canary": tampered}
+    errors, resolved = svc._error_counters()
+    svc._watchdog.arm(0.0, svc.model_digest, errors, resolved)
+    try:
+        fails_before = svc.metrics.counter("serve_canary_failures").value
+        result = svc.run_canary()
+        assert result["status"] == "failed"
+        assert result["baseline"] == "manifest"
+        assert any("encode" in m for m in result["mismatches"])
+        assert svc.metrics.counter("serve_canary_failures").value == \
+            fails_before + 1
+        assert svc.metrics.gauge("serve_canary_ok").value == 0
+        events = [e for e in svc.flight.snapshot()
+                  if e["kind"] == "canary_failure"]
+        assert events and events[-1]["digest"] == svc.model_digest
+        # canary evidence arms the watchdog: evaluate fires immediately
+        verdict = svc._watchdog.evaluate(0.1, *svc._error_counters())
+        assert verdict is not None and verdict["fire"] is True
+        assert verdict["reason"] == "canary"
+        assert verdict["digest"] == svc.model_digest
+    finally:
+        bundle.manifest = old_manifest
+        svc._canary = old_state
+        svc._watchdog.disarm()
+    # with the lying manifest gone the canary re-anchors and goes green
+    assert svc.run_canary()["status"] == "ok"
+    assert svc.metrics.gauge("serve_canary_ok").value == 1
+
+
+def test_watchdog_canary_arming_is_digest_conditional():
+    wd = RollbackWatchdog(window_s=10.0, threshold=0.5, min_requests=4)
+    assert wd.note_canary_failure("b") is False     # nothing armed
+    wd.arm(0.0, "b", 0, 0)
+    assert wd.note_canary_failure("other") is False  # stale probe
+    assert wd.evaluate(0.1, 0, 0) is None            # window still open
+    assert wd.note_canary_failure("b") is True
+    v = wd.evaluate(0.2, 0, 1)
+    assert v["fire"] is True and v["reason"] == "canary"
+    assert not wd.armed
+    # the error-rate path still reports its reason
+    wd.arm(0.0, "c", 0, 0)
+    v = wd.evaluate(11.0, 10, 10)
+    assert v["fire"] is True and v["reason"] == "error_rate"
+
+
+@pytest.mark.slow
+def test_swap_refused_by_canary_and_clean_swap_passes(service,
+                                                      tiny_cfg_files,
+                                                      tmp_path):
+    """The acceptance scenario at test scale: a checkpoint whose
+    manifest carries goldens commits only if the staged bundle
+    reproduces them; a bit-flipped twin carrying the SAME goldens is
+    refused typed, leaving the old model serving bit-identically."""
+    from dsin_tpu.coding.loader import load_model_state
+    # the ONE corruption recipe, shared with the chaos battery so the
+    # test and the degraded_model scenario cannot silently diverge
+    from tools.chaos_bench import _bitflip_params
+    ae_p, pc_p = tiny_cfg_files
+    svc = service
+    rng = np.random.default_rng(7)
+    probe = _img(rng, 16, 24)
+    digest_a = svc.model_digest
+    a_stream = svc.encode(probe).stream
+
+    model_b, state_b = load_model_state(ae_p, pc_p, None, BUCKETS[-1],
+                                        need_sinet=True, seed=11)
+    extra = {"pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+             "buckets": [list(b) for b in BUCKETS]}
+    ckpt_b = str(tmp_path / "ckpt_b")
+    ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra=extra)
+    # publish flow: stage the candidate, record what it SHOULD produce,
+    # abort, re-save with the goldens
+    info = svc.prepare_swap(ckpt_b)
+    assert info["canary"]["status"] == "skipped"
+    goldens = svc.canary_goldens(staged=True)
+    svc.abort_swap()
+    ckpt_lib.save_checkpoint(ckpt_b, state_b,
+                             manifest_extra={**extra, "canary": goldens})
+    # the corrupted twin: different bytes, SAME promised goldens
+    ckpt_bad = str(tmp_path / "ckpt_bad")
+    ckpt_lib.save_checkpoint(ckpt_bad, _bitflip_params(state_b),
+                             manifest_extra={**extra, "canary": goldens})
+    with pytest.raises(CanaryFailed, match="refusing to commit"):
+        svc.swap_model(ckpt_bad)
+    assert svc.model_digest == digest_a
+    assert svc.encode(probe).stream == a_stream
+    assert svc.metrics.counter("serve_canary_swap_refusals").value >= 1
+    assert svc._swap.snapshot()["swap_state"] == 0
+    # the genuine checkpoint passes its own goldens and commits
+    info = svc.swap_model(ckpt_b)
+    assert info["canary"]["status"] == "passed"
+    assert svc.model_digest != digest_a
+    svc.rollback()
+    assert svc.model_digest == digest_a
+    assert svc.encode(probe).stream == a_stream
+
+
+def test_budget0_with_quality_telemetry_on(service):
+    """The acceptance pin: gap sampling at 1.0, bpp export, SI scores,
+    and a full canary probe reuse the warmed executables — zero
+    steady-state compiles."""
+    from dsin_tpu.utils.recompile import CompilationSentinel
+    svc = service
+    rng = np.random.default_rng(9)
+    prev = svc.quality.set_gap_sample_rate(1.0)
+    try:
+        with CompilationSentinel(budget=0, label="quality steady state"):
+            res = svc.encode(_img(rng, 16, 24))
+            svc.decode(res.stream)
+            sid = svc.open_session(_img(rng, 16, 24))
+            svc.decode_si(res.stream, sid)
+            svc.close_session(sid)
+            assert svc.run_canary()["status"] == "ok"
+    finally:
+        svc.quality.set_gap_sample_rate(prev)
+
+
+def test_build_manifest_rejects_malformed_canary(service):
+    with pytest.raises(ValueError, match="canary"):
+        ckpt_lib.build_manifest(service.state,
+                                extra={"canary": {"bogus": 1}})
+    # a well-formed entry passes straight through
+    goldens = service.canary_goldens()
+    manifest = ckpt_lib.build_manifest(service.state,
+                                       extra={"canary": goldens})
+    assert manifest["canary"] == goldens

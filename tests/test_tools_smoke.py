@@ -256,6 +256,28 @@ def test_serve_bench_smoke_json_contract(tmp_path):
     assert tr["flight"]["dumps"] >= 1
     assert tr["flight"]["last_dump_path"]
     assert tr["chrome_events"] > 0
+    # ISSUE 13: the model-health leg rides the smoke run — the bench
+    # itself exits 1 on empty telemetry, a canary failure, steady-state
+    # compiles with quality on, or a blown overhead budget; re-pin the
+    # artifact shape so a silent gate removal cannot pass
+    q = report["quality"]
+    assert q["steady_compiles"] == 0, (
+        "quality telemetry recompiled — a signal minted an executable")
+    assert q["gap"]["samples"] >= 1 and q["gap"]["errors"] == 0
+    for key, hist in q["gap"]["per_bucket_pct"].items():
+        assert hist["count"] >= 1, (key, hist)
+        assert hist["min"] >= -0.5, (key, hist)
+    for key, entry in q["bpp"].items():
+        assert entry["payload"]["count"] >= 1, (key, entry)
+        # wire bpp must show the DSRV frame overhead over payload bpp
+        assert entry["wire"]["mean"] > entry["payload"]["mean"], (key,
+                                                                 entry)
+    assert q["si_match"]["score"]["count"] >= 1
+    assert q["canary"]["runs"] >= 1
+    assert q["canary"]["failures"] == 0
+    assert q["canary"]["ok"] == 1
+    assert q["canary"]["result"]["status"] == "ok"
+    assert len(q["pair_ratios"]) == q["repeats"]
 
 
 @pytest.mark.chaos
@@ -353,6 +375,29 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert ts["replicas_scraped"] >= 1
     assert se["steady_compiles"] == 0
     assert se["lock_order_inversions"] == 0
+    # ISSUE 13: the degraded-model battery rides every chaos run — pin
+    # its scenario shape so a silent removal cannot pass
+    dm = report["degraded_model"]
+    assert dm["violations"] == []
+    dsc = dm["scenarios"]
+    al = dsc["si_match_alarm"]
+    assert al["bad_session"]["alarmed"] is True
+    assert al["alarm_transitions"] >= 1 and al["alarm_events"] >= 1
+    assert al["hung_futures"] == 0 and al["untyped_errors"] == 0
+    assert al["decodes_ok"] > 0
+    cr = dsc["canary_refusal"]
+    assert cr["clean_swap_canary_passed"] is True
+    assert cr["refused"] is True and cr["swap_refusals"] >= 1
+    assert cr["serving_old_params"] is True
+    fc = dsc["forced_commit_watchdog"]
+    assert fc["fired"] is True and fc["watchdog_rollbacks"] >= 1
+    assert fc["canary_failures"] >= 1
+    assert fc["bit_identical_after"] is True
+    assert fc["digest_after"] == cr["digest_a"]
+    assert dm["steady_compiles"] == 0
+    assert dm["lock_order_inversions"] == 0
+    assert dm["flight_recorder"]["dumps"] >= 1
+    assert dm["flight_recorder"]["last_dump_events"] >= 1
     # ISSUE 11: every injected-fault battery must leave a non-empty
     # flight-recorder dump behind (the replayable incident timeline)
     fr = report["flight_recorder"]

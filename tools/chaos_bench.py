@@ -68,6 +68,23 @@ adopts new sessions. Zero steady-state compiles across all of it.
 `--sessions_only` runs just this battery (the `si-bench` stage pairs
 it with serve_bench --si_only).
 
+Degraded-model battery (ISSUE 13): every run also soaks the MODEL-HEALTH
+layer (serve/quality.py) — (1) a session opened on an UNCORRELATED side
+image must trip the SI-match floor alarm (flight `quality_alarm` event,
+transition counter) while its decodes keep resolving; (2) the golden
+canary publish flow (prepare candidate -> record goldens -> re-save) and
+its teeth: a BIT-FLIPPED twin checkpoint carrying the good model's
+goldens loads and manifest-verifies cleanly (its manifest matches its
+corrupted bytes) but is REFUSED typed `CanaryFailed` at prepare — the
+old model keeps serving bit-identically; (3) the same corrupted
+checkpoint FORCE-committed (`canary=False`) is caught by the background
+canary prober post-commit, which arms the `RollbackWatchdog` — the
+service converges back to the good model bit-identically with no
+operator in the loop. Invariants: zero hung futures, all failures
+typed, non-empty flight dumps, zero steady-state compiles.
+`--degraded_only` runs just this battery (the `quality-smoke`
+tpu_session.sh stage pairs it with serve_bench --quality).
+
 Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
 (tests/test_tools_smoke.py) and the `chaos-smoke` stage of
 tools/tpu_session.sh.
@@ -1079,6 +1096,296 @@ def run_sessions(args) -> dict:
     }
 
 
+def _bitflip_params(state):
+    """Flip mantissa bit 22 of the first 16 values of the first params
+    leaf — deterministic 'corrupted but self-consistent' damage: the
+    re-saved checkpoint's manifest matches its (corrupted) bytes, so
+    every integrity layer below the canary waves it through."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    arr = np.asarray(leaves[0]).copy()
+    flat = arr.reshape(-1)
+    n = min(16, flat.size)
+    view = flat[:n].copy().view(np.uint32)
+    view ^= np.uint32(1 << 22)
+    flat[:n] = view.view(np.float32)
+    leaves = [arr] + list(leaves[1:])
+    return state.replace(params=jax.tree_util.tree_unflatten(treedef,
+                                                             leaves))
+
+
+def run_degraded(args) -> dict:
+    """The degraded-model battery (ISSUE 13, see module docstring)."""
+    import tempfile
+
+    from dsin_tpu.coding.loader import load_model_state
+    from dsin_tpu.serve import (CanaryFailed, CompressionService,
+                                ServiceConfig)
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.utils import locks
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled — the degraded soak needs them"
+
+    # SI-capable ladder (edges divisible by the configs' (8, 12)
+    # y_patch_size), mirroring the sessions battery
+    buckets = [(16, 24), (32, 48)]
+    flight_dir = tempfile.mkdtemp(prefix="chaos_degraded_flight_")
+    cfg = ServiceConfig(
+        ae_config=args.ae_config, pc_config=args.pc_config, ckpt=args.ckpt,
+        seed=args.seed, buckets=buckets, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        workers=args.workers, entropy_workers=args.entropy_workers,
+        entropy_backend=args.entropy_backend,
+        pipeline_depth=args.pipeline_depth, enable_si=True,
+        # the canary's 2 sessions (one per bucket) + the battery's
+        # good/bad pair must coexist without LRU churn
+        session_max=8,
+        # fast background prober + short watchdog window: the forced-
+        # commit scenario must converge in CI seconds
+        canary_every_s=0.15, quality_gap_sample_rate=1.0,
+        # the alarm floor is CALIBRATED inside the battery (see the
+        # si_match_alarm scenario) — score distributions are a property
+        # of the model under test, and this battery also runs with an
+        # arbitrary --ckpt
+        si_alarm_min_samples=6,
+        rollback_watchdog_window_s=0.3,
+        rollback_watchdog_threshold=0.3,
+        rollback_watchdog_min_requests=3,
+        trace_sample_rate=1.0, flight_dir=flight_dir,
+        flight_dump_min_interval_s=0.0)
+    service = CompressionService(cfg).start()
+    warm = service.warmup()
+    rng = np.random.default_rng(args.seed + 13)
+    violations = []
+    scenarios = {}
+    inversions_before = locks.inversion_count()
+    t0 = time.monotonic()
+
+    with CompilationSentinel(budget=0, label="degraded steady state",
+                             raise_on_exceed=False) as sentinel:
+        bucket = buckets[0]
+        img = rng.integers(0, 255, (bucket[0], bucket[1], 3),
+                           dtype=np.uint8)
+        stream = service.encode(img, timeout=args.timeout_s).stream
+        digest_a = service.model_digest
+        a_stream = stream
+
+        # -- (1) corrupted side image -> SI-match alarm -------------------
+        # the score separation between a correlated side (y == x) and
+        # an uncorrelated one is a property of the MODEL under test
+        # (the random smoke model: ~0.94 vs ~0.57), so the floor is
+        # CALIBRATED: round 1 measures both distributions, the floor
+        # lands at their midpoint, round 2 (fresh sessions — closing
+        # drops the tracker stats via the evict hook) must trip the
+        # alarm on the corrupted side. A model whose scores do not
+        # separate is recorded as non-separable instead of failing a
+        # healthy service on an alarm it cannot support.
+        noise = rng.integers(0, 255, (bucket[0], bucket[1], 3),
+                             dtype=np.uint8)     # uncorrelated side
+        cal_good = service.open_session(img)     # correlated: y == x
+        cal_bad = service.open_session(noise)
+        futures = []
+        for _ in range(4):
+            futures.append(service.submit_decode_si(stream, cal_good))
+            futures.append(service.submit_decode_si(stream, cal_bad))
+        counts0, hung0 = _await_all(futures, args.timeout_s)
+        cal = service.quality.si_session_summaries()
+        good_mean = cal.get(cal_good, {}).get("mean", 0.0)
+        bad_mean = cal.get(cal_bad, {}).get("mean", 0.0)
+        service.close_session(cal_good)
+        service.close_session(cal_bad)
+        separable = good_mean - bad_mean >= 0.05
+        floor = round((good_mean + bad_mean) / 2.0, 4)
+        if separable:
+            service.quality.si_score_floor = floor
+        sid_good = service.open_session(img)
+        sid_bad = service.open_session(noise)
+        futures = []
+        for _ in range(8):
+            futures.append(service.submit_decode_si(stream, sid_good))
+            futures.append(service.submit_decode_si(stream, sid_bad))
+        counts, hung = _await_all(futures, args.timeout_s)
+        summaries = service.quality.si_session_summaries()
+        bad_sum = summaries.get(sid_bad, {})
+        transitions = service.metrics.counter(
+            "serve_si_match_alarm_transitions").value
+        if hung0 or hung:
+            violations.append(f"si_match_alarm: {hung0 + hung} hung "
+                              f"futures")
+        if counts0["untyped"] or counts["untyped"]:
+            violations.append(
+                f"si_match_alarm: {counts0['untyped'] + counts['untyped']}"
+                f" untyped errors")
+        alarm_events = [e for e in service.flight.snapshot()
+                        if e["kind"] == "quality_alarm"]
+        if separable:
+            if not bad_sum.get("alarmed"):
+                violations.append(
+                    f"si_match_alarm: uncorrelated side image never "
+                    f"tripped the calibrated floor {floor} (summary "
+                    f"{bad_sum})")
+            if not alarm_events:
+                violations.append("si_match_alarm: no quality_alarm "
+                                  "flight event recorded")
+        else:
+            print(f"CHAOS_BENCH_NOTE: si_match_alarm scores do not "
+                  f"separate on this model (good mean {good_mean}, bad "
+                  f"mean {bad_mean}) — alarm assertions skipped",
+                  file=sys.stderr)
+        scenarios["si_match_alarm"] = {
+            "decodes_ok": counts0["ok"] + counts["ok"],
+            "typed_errors": counts0["typed"] + counts["typed"],
+            "untyped_errors": counts0["untyped"] + counts["untyped"],
+            "hung_futures": hung0 + hung,
+            "calibration": {"good_mean": round(good_mean, 4),
+                            "bad_mean": round(bad_mean, 4),
+                            "floor": floor, "separable": separable},
+            "good_session": summaries.get(sid_good, {}),
+            "bad_session": bad_sum,
+            "alarm_transitions": transitions,
+            "alarm_events": len(alarm_events),
+        }
+        service.close_session(sid_good)
+        service.close_session(sid_bad)
+
+        # -- (2) canary publish flow + refusal of a bit-flipped twin ------
+        model_b, state_b = load_model_state(
+            args.ae_config, args.pc_config, None, tuple(buckets[-1]),
+            need_sinet=True, seed=args.seed + 1)
+        tmpd = tempfile.mkdtemp(prefix="chaos_degraded_")
+        extra = {
+            "pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+            "seed": args.seed + 1,
+            "buckets": [list(b) for b in buckets]}
+        ckpt_b = os.path.join(tmpd, "ckpt_b")
+        ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra=extra)
+        # publish flow: stage the candidate, record what it SHOULD
+        # produce, abort, re-save carrying the goldens
+        info = service.prepare_swap(ckpt_b)
+        goldens = service.canary_goldens(staged=True)
+        service.abort_swap()
+        ckpt_lib.save_checkpoint(
+            ckpt_b, state_b,
+            manifest_extra={**extra, "canary": goldens})
+        # positive control: the genuine checkpoint passes its goldens
+        info = service.swap_model(ckpt_b)
+        clean_passed = info.get("canary", {}).get("status") == "passed"
+        if not clean_passed:
+            violations.append(f"degraded: clean swap canary did not "
+                              f"pass: {info.get('canary')}")
+        digest_b = info["digest"]
+        service.rollback()       # back to A for the refusal scenario
+        # the corrupted twin: different bytes, SAME promised goldens —
+        # its own manifest digests match its corrupted bytes, so only
+        # the canary stands between it and production
+        ckpt_bad = os.path.join(tmpd, "ckpt_bad")
+        ckpt_lib.save_checkpoint(
+            ckpt_bad, _bitflip_params(state_b),
+            manifest_extra={**extra, "canary": goldens})
+        refused = False
+        try:
+            service.swap_model(ckpt_bad)
+        except CanaryFailed:
+            refused = True
+        except Exception as e:  # noqa: BLE001 — wrong type is a violation
+            violations.append(f"degraded: corrupted swap failed UNTYPED "
+                              f"({type(e).__name__}: {e})")
+        if not refused:
+            violations.append("degraded: the canary did NOT refuse the "
+                              "bit-flipped staged swap")
+        if service.model_digest != digest_a:
+            violations.append("degraded: service digest moved off the "
+                              "good model after the refusal")
+        if service.encode(img, timeout=args.timeout_s).stream != a_stream:
+            violations.append("degraded: old-model bit-identity lost "
+                              "after the canary refusal")
+        scenarios["canary_refusal"] = {
+            "clean_swap_canary_passed": clean_passed,
+            "digest_a": digest_a, "digest_b": digest_b,
+            "refused": refused,
+            "swap_refusals": service.metrics.counter(
+                "serve_canary_swap_refusals").value,
+            "serving_old_params": service.model_digest == digest_a,
+        }
+
+        # -- (3) forced commit -> canary arms the watchdog ----------------
+        wd_before = service.metrics.counter(
+            "serve_watchdog_rollbacks").value
+        service.swap_model(ckpt_bad, canary=False)
+        digest_bad = service.model_digest
+        fired = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if service.model_digest == digest_a:
+                fired = True
+                break
+            time.sleep(0.05)
+        wd_rollbacks = service.metrics.counter(
+            "serve_watchdog_rollbacks").value - wd_before
+        canary_failures = service.metrics.counter(
+            "serve_canary_failures").value
+        if not fired or wd_rollbacks < 1:
+            violations.append(
+                f"degraded: force-committed corrupted model was not "
+                f"rolled back by the canary-armed watchdog "
+                f"({wd_rollbacks} watchdog rollbacks, serving "
+                f"{service.model_digest})")
+        if canary_failures < 1:
+            violations.append("degraded: the background canary never "
+                              "recorded a failure on the bad model")
+        post = service.encode(img, timeout=args.timeout_s)
+        if post.stream != a_stream or post.model_digest != digest_a:
+            violations.append("degraded: good-model bit-identity lost "
+                              "after the watchdog rollback")
+        scenarios["forced_commit_watchdog"] = {
+            "digest_bad": digest_bad,
+            "fired": fired,
+            "watchdog_rollbacks": wd_rollbacks,
+            "canary_failures": canary_failures,
+            "digest_after": service.model_digest,
+            "bit_identical_after": post.stream == a_stream,
+        }
+
+    if sentinel.compilations:
+        violations.append(f"degraded battery: {sentinel.compilations} "
+                          f"steady-state compiles")
+    # every canary failure and alarm is a dump trigger: the battery must
+    # leave a replayable timeline behind
+    service.flight.flush(timeout=10.0)
+    flight_meta = service.flight.meta()
+    last_events = 0
+    if flight_meta["last_dump_path"]:
+        with open(flight_meta["last_dump_path"]) as f:
+            last_events = sum(1 for _ in f) - 1
+    if flight_meta["dumps"] < 1 or last_events < 1:
+        violations.append(
+            f"degraded battery left no non-empty flight dump "
+            f"({flight_meta['dumps']} dumps, last had {last_events} "
+            f"events)")
+    counters = service.metrics.snapshot()["counters"]
+    service.drain()
+    degraded_inversions = locks.inversion_count() - inversions_before
+    if degraded_inversions:
+        violations.append(f"{degraded_inversions} lock-order inversions "
+                          f"during the degraded battery")
+    return {
+        "warmup": warm,
+        "scenarios": scenarios,
+        "canary_counters": {k: v for k, v in counters.items()
+                            if "canary" in k},
+        "flight_recorder": {"dumps": flight_meta["dumps"],
+                            "last_dump_events": last_events,
+                            "last_dump_path":
+                                flight_meta["last_dump_path"]},
+        "steady_compiles": sentinel.compilations,
+        "lock_order_inversions": degraded_inversions,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="seeded chaos soak for dsin_tpu/serve")
@@ -1135,6 +1442,13 @@ def main(argv=None) -> int:
                         "serve.session faults, replica-death with live "
                         "sessions) — rides the fail-fast si-bench "
                         "tpu_session.sh stage")
+    p.add_argument("--degraded_only", action="store_true",
+                   help="run ONLY the degraded-model battery (SI-match "
+                        "alarm on a corrupted side image; bit-flipped "
+                        "staged params refused by the golden canary; a "
+                        "force-committed corrupted model rolled back by "
+                        "the canary-armed watchdog) — rides the "
+                        "fail-fast quality-smoke tpu_session.sh stage")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -1159,12 +1473,17 @@ def main(argv=None) -> int:
         report = {"config": {"smoke": args.smoke, "seed": args.seed},
                   "sessions": run_sessions(args),
                   "violations": []}
+    elif args.degraded_only:
+        report = {"config": {"smoke": args.smoke, "seed": args.seed},
+                  "degraded_model": run_degraded(args),
+                  "violations": []}
     else:
         report = run_chaos(args)
         report["hotswap"] = run_hotswap(args)
         report["sessions"] = run_sessions(args)
+        report["degraded_model"] = run_degraded(args)
     # every battery's violations gate the exit code like the soak's own
-    for extra in ("hotswap", "sessions"):
+    for extra in ("hotswap", "sessions", "degraded_model"):
         if extra in report:
             report["violations"] = (report["violations"]
                                     + report[extra]["violations"])
@@ -1183,6 +1502,11 @@ def main(argv=None) -> int:
         summary["sessions"] = {k: report["sessions"][k]
                                for k in ("scenarios", "steady_compiles",
                                          "violations")}
+    if "degraded_model" in report:
+        summary["degraded_model"] = {
+            k: report["degraded_model"][k]
+            for k in ("scenarios", "canary_counters", "steady_compiles",
+                      "violations")}
     summary["violations"] = report["violations"]
     print(json.dumps(summary, indent=1))
     if report["violations"]:

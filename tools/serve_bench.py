@@ -63,6 +63,16 @@ fail untyped, the churn actually evicts, and ZERO steady-state
 compiles land while sessions are created/evicted. `--si_only` runs
 just this axis — the fail-fast `si-bench` tpu_session.sh stage.
 
+Model-health axis (ISSUE 13): every run also drives the quality
+telemetry layer (serve/quality.py) through one warm SI-enabled service
+— per-bucket coding-gap and payload/wire bpp histograms populated, the
+SI-match score tracker fed, the golden canary prober green against the
+serving model — and measures the paired telemetry-on/off overhead. In
+--smoke mode the bench FAILS on empty telemetry, a canary failure, any
+steady-state compile with quality on, or overhead past the 2% budget
+(noise-escaped per the repo convention). `--quality` runs just this leg
+— the fail-fast `quality-smoke` tpu_session.sh stage.
+
 Emits a SERVE_BENCH.json trajectory artifact: totals (throughput,
 rejections by cause), latency quantiles, batch occupancy, compile
 counts, per-stage times, the device-scaling section, and a sampled time
@@ -1013,6 +1023,212 @@ def _gate_trace(section, overhead_budget: float = 0.02) -> list:
     return violations
 
 
+def _run_quality_section(args) -> dict:
+    """Model-health leg (ISSUE 13): telemetry coverage, canary health,
+    paired overhead, and budget-0, on ONE warm SI-enabled service.
+
+    * POPULATE: one mixed encode/decode/decode_si pass with the
+      coding-gap head sampler forced to 1.0, plus one explicit canary
+      probe — the gate then asserts every per-bucket gap/bpp histogram
+      and the SI-match score summary actually carry samples (telemetry
+      that exports nothing is dead code with a metric name).
+    * CANARY: the background prober runs throughout (canary_every_s)
+      and the gate holds it GREEN — runs >= 1, zero failures, ok
+      gauge up.
+    * OVERHEAD: alternating telemetry-on/off pass pairs at the
+      PRODUCTION default gap rate; the executables are identical in
+      both modes (score outputs stay compiled in), so the ratio
+      measures pure observation cost — gated at the repo's 2% budget
+      with the pair-spread noise escape and a hard broken band.
+    * BUDGET-0: the whole leg runs under CompilationSentinel(budget=0)
+      — canary inputs use the existing bucket shapes and the gap pass
+      is pure numpy, so quality telemetry must compile NOTHING.
+    """
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    svc, warm = _build_service(args, args.entropy_workers, enable_si=True,
+                               canary_every_s=0.4)
+    shapes = _parse_shapes(args.shapes)
+    rng = np.random.default_rng(args.seed + 7)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    buckets = sorted({svc.policy.bucket_for(h, w) for h, w in shapes})
+    sides = {b: rng.integers(0, 255, (b[0], b[1], 3), dtype=np.uint8)
+             for b in buckets}
+    n = args.quality_requests
+    runs = {"on": [], "off": []}
+    pair_cores = []
+    canary_result = {}
+
+    with CompilationSentinel(budget=0, label="quality steady state",
+                             raise_on_exceed=False) as sentinel:
+        streams = {}
+        for h, w in shapes:
+            res = svc.encode(images[shapes.index((h, w))], timeout=120)
+            streams[(h, w)] = (res.stream, svc.policy.bucket_for(h, w))
+        sids = {b: svc.open_session(sides[b]) for b in buckets}
+
+        def one_pass():
+            t0 = time.monotonic()
+            for i in range(n):
+                shape = shapes[i % len(shapes)]
+                stream, bucket = streams[shape]
+                if i % 3 == 0:
+                    # decouple the shape rotation from the op rotation:
+                    # i % len(images) would re-encode shape 0 forever
+                    # whenever len(shapes) divides 3, leaving the other
+                    # buckets' gap histograms to luck
+                    svc.encode(images[(i // 3) % len(images)],
+                               timeout=120)
+                elif i % 3 == 1:
+                    svc.decode(stream, timeout=120)
+                else:
+                    svc.decode_si(stream, sids[bucket], timeout=120)
+            _quiesce(svc)
+            dur = time.monotonic() - t0
+            return n / dur if dur > 0 else 0.0
+
+        # populate: every histogram the gate checks gets samples NOW
+        prev_rate = svc.quality.set_gap_sample_rate(1.0)
+        one_pass()
+        svc.quality.set_gap_sample_rate(prev_rate)
+        for _ in range(200):
+            canary_result = svc.run_canary()
+            if canary_result.get("status") in ("ok", "failed"):
+                break     # "busy" = the background prober won the claim
+            time.sleep(0.05)
+
+        # paired overhead at the production default gap rate
+        for r in range(args.quality_repeats):
+            pair_cores.append(round(_effective_cores(), 2))
+            order = ["on", "off"]
+            if r % 2:
+                order.reverse()
+            for mode in order:
+                svc.quality.set_enabled(mode == "on")
+                runs[mode].append(round(one_pass(), 3))
+        svc.quality.set_enabled(True)
+    snap = svc.metrics.snapshot()
+    si_summaries = svc.quality.si_session_summaries()
+    svc.drain()
+
+    h = snap["histograms"]
+    c = snap["counters"]
+
+    def _hist(name):
+        s = h.get(name, {"count": 0, "mean": 0.0})
+        return {k: round(float(v), 4) for k, v in s.items()}
+
+    ratios = [a / b for a, b in zip(runs["on"], runs["off"]) if b > 0]
+    return {
+        "requests_per_pass": n,
+        "repeats": args.quality_repeats,
+        "gap": {
+            "sample_rate_default": svc.config.quality_gap_sample_rate,
+            "samples": c.get("serve_coding_gap_samples", 0),
+            "errors": c.get("serve_coding_gap_errors", 0),
+            "per_bucket_pct": {
+                f"{bh}x{bw}": _hist(f"serve_coding_gap_pct_{bh}x{bw}")
+                for bh, bw in buckets},
+            "bits": _hist("serve_coding_gap_bits"),
+        },
+        "bpp": {
+            f"{bh}x{bw}": {
+                "payload": _hist(f"serve_bpp_payload_{bh}x{bw}"),
+                "wire": _hist(f"serve_bpp_wire_{bh}x{bw}"),
+            } for bh, bw in buckets},
+        "si_match": {
+            "score": _hist("serve_si_match_score"),
+            "min_score": _hist("serve_si_match_min_score"),
+            "alarms": snap["gauges"].get("serve_si_match_alarms", 0),
+            "alarm_transitions": c.get(
+                "serve_si_match_alarm_transitions", 0),
+            "sessions": si_summaries,
+        },
+        "canary": {
+            "result": canary_result,
+            "runs": c.get("serve_canary_runs", 0),
+            "failures": c.get("serve_canary_failures", 0),
+            "errors": c.get("serve_canary_errors", 0),
+            "races": c.get("serve_canary_races", 0),
+            "ok": snap["gauges"].get("serve_canary_ok", 0),
+            "probe_ms": _hist("serve_canary_ms"),
+        },
+        "runs": runs,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "pair_effective_cores": pair_cores,
+        "overhead": (round(1.0 - _median(ratios), 4) if ratios else None),
+        "steady_compiles": sentinel.compilations,
+        "warmup": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in warm.items()},
+    }
+
+
+def _gate_quality(section, overhead_budget: float = 0.02) -> list:
+    """--smoke violations for the model-health leg: zero steady-state
+    compiles with every quality signal on (hard — the acceptance pin),
+    populated gap/bpp/SI-score telemetry (hard — a metric nobody feeds
+    is not a signal), a green canary (hard), and the 2% paired overhead
+    budget with the repo's noise escape + broken band."""
+    violations = []
+    if section["steady_compiles"]:
+        violations.append(
+            f"quality leg: {section['steady_compiles']} steady-state "
+            f"compiles with telemetry on — a quality signal minted an "
+            f"executable")
+    gap = section["gap"]
+    if gap["samples"] < 1 or gap["errors"]:
+        violations.append(f"coding-gap sampler produced "
+                          f"{gap['samples']} samples, "
+                          f"{gap['errors']} errors")
+    for key, hist in gap["per_bucket_pct"].items():
+        if hist["count"] < 1:
+            violations.append(f"gap histogram for bucket {key} is empty")
+        elif hist.get("min", 0.0) < -0.5:
+            # half-a-percent slack covers the rANS state-flush
+            # accounting; a real engine disagreement is orders beyond it
+            violations.append(
+                f"bucket {key} recorded a NEGATIVE coding gap "
+                f"({hist['min']}%) — realized bits fell below the "
+                f"model's own bound, the two passes disagree")
+    for key, entry in section["bpp"].items():
+        if entry["payload"]["count"] < 1 or entry["wire"]["count"] < 1:
+            violations.append(f"bpp histograms for bucket {key} are "
+                              f"empty")
+        elif entry["wire"]["mean"] <= entry["payload"]["mean"]:
+            violations.append(f"bucket {key} wire bpp <= payload bpp — "
+                              f"frame overhead went missing")
+    if section["si_match"]["score"]["count"] < 1:
+        violations.append("SI-match score histogram is empty — the "
+                          "score output never reached the tracker")
+    canary = section["canary"]
+    if canary["runs"] < 1:
+        violations.append("the canary never ran")
+    if canary["failures"] or canary["ok"] != 1:
+        violations.append(f"canary not green: {canary['failures']} "
+                          f"failures, ok gauge {canary['ok']} "
+                          f"(last: {canary.get('result')})")
+    overhead = section.get("overhead")
+    pairs = section.get("pair_ratios") or []
+    if overhead is None or overhead > 0.25:
+        violations.append(
+            f"quality telemetry overhead {overhead} in the broken band "
+            f"(>25%): pairs {pairs}")
+    elif overhead > overhead_budget:
+        spread = (max(pairs) - min(pairs)) if pairs else 0.0
+        if spread > 0.05:
+            print(f"SERVE_BENCH_NOTE: quality overhead {overhead} over "
+                  f"the {overhead_budget} budget but pair ratios spread "
+                  f"{round(spread, 3)} — measurement noise exceeds the "
+                  f"gate's resolution this window; committed artifact "
+                  f"documents the honest number", file=sys.stderr)
+        else:
+            violations.append(
+                f"quality telemetry overhead {overhead} exceeds the "
+                f"{overhead_budget} budget with stable pairs {pairs}")
+    return violations
+
+
 def _parse_mix(spec: str) -> dict:
     """'interactive:0.3 bulk:0.7' -> {class: share} (normalized)."""
     mix = {}
@@ -1510,6 +1726,18 @@ def main(argv=None) -> int:
                    help="run ONLY the request-tracing leg (overhead + "
                         "budget-0 + span-vs-accumulator cross-check); "
                         "the leg also rides every full/--smoke run")
+    p.add_argument("--quality_requests", type=int, default=24,
+                   help="requests per model-health pass (the mixed "
+                        "encode/decode/decode_si stream, ISSUE 13)")
+    p.add_argument("--quality_repeats", type=int, default=3,
+                   help="alternating telemetry-on/off pass pairs; the "
+                        "reported overhead is 1 - median pair ratio")
+    p.add_argument("--quality", dest="quality_only", action="store_true",
+                   help="run ONLY the model-health leg (gap/bpp/SI-"
+                        "score coverage + canary green + paired "
+                        "overhead + budget-0) — the quality-smoke "
+                        "tpu_session.sh stage; the leg also rides "
+                        "every full/--smoke run")
     p.add_argument("--out", default="SERVE_BENCH.json")
     p.add_argument("--smoke_model", action="store_true",
                    help="use the built-in tiny model configs but keep "
@@ -1546,9 +1774,11 @@ def main(argv=None) -> int:
         args.frontdoor_requests = 200   # ~1.7s window: a real backlog
         args.si_requests = 20   # per-mode pass stays seconds-fast
         args.trace_requests = 18   # 6 per op kind, seconds per pass
+        args.quality_requests = 18
 
     only_flags = [f for f in ("devices_only", "backends_only",
-                              "frontdoor_only", "si_only", "trace_only")
+                              "frontdoor_only", "si_only", "trace_only",
+                              "quality_only")
                   if getattr(args, f)]
     if len(only_flags) > 1:
         print(f"SERVE_BENCH_FAILED: {only_flags} are mutually "
@@ -1560,7 +1790,8 @@ def main(argv=None) -> int:
         # frontdoor_only/si_only never run the device axis, so they
         # never force host devices
         args.devices = ("" if (args.backends_only or args.frontdoor_only
-                               or args.si_only or args.trace_only)
+                               or args.si_only or args.trace_only
+                               or args.quality_only)
                         else "1 2" if args.smoke else "1 2 4 8")
     axis = [int(v) for v in args.devices.split()]
     if any(n < 1 for n in axis):
@@ -1663,6 +1894,21 @@ def main(argv=None) -> int:
             },
             "trace": _run_trace_section(args),
         }
+    elif args.quality_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "quality_requests": args.quality_requests,
+                "quality_repeats": args.quality_repeats,
+                "smoke": args.smoke,
+            },
+            "quality": _run_quality_section(args),
+        }
     else:
         report = run_bench(args)
         report["config"]["entropy_backend"] = args.entropy_backend
@@ -1691,13 +1937,18 @@ def main(argv=None) -> int:
         # tracing on, and the span-vs-accumulator cross-check
         report["config"]["trace_requests"] = args.trace_requests
         report["trace"] = _run_trace_section(args)
+        # model health (ISSUE 13): rides every run — the smoke gate
+        # holds populated gap/bpp/SI-score telemetry, a green canary,
+        # the 2% paired overhead budget, and budget-0 with quality on
+        report["config"]["quality_requests"] = args.quality_requests
+        report["quality"] = _run_quality_section(args)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
     summary_keys = ("load", "latency_ms", "batch_occupancy",
                     "steady_compiles", "pipeline", "entropy_backends",
-                    "devices", "frontdoor", "si", "trace")
+                    "devices", "frontdoor", "si", "trace", "quality")
     print(json.dumps({k: report[k] for k in summary_keys if k in report},
                      indent=1))
     if args.smoke and args.devices_only:
@@ -1726,6 +1977,12 @@ def main(argv=None) -> int:
         return 0
     if args.smoke and args.trace_only:
         violations = _gate_trace(report["trace"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
+    if args.smoke and args.quality_only:
+        violations = _gate_quality(report["quality"])
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1
@@ -1785,6 +2042,8 @@ def main(argv=None) -> int:
             violations.extend(_gate_si(report["si"]))
         if "trace" in report:
             violations.extend(_gate_trace(report["trace"]))
+        if "quality" in report:
+            violations.extend(_gate_quality(report["quality"]))
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -170,6 +170,33 @@ si-bench)
     exit 1
   fi
   ;;
+quality-smoke)
+  # model-health smoke before chip time (ISSUE 13): serve_bench's
+  # --quality leg (per-bucket coding-gap + bpp histograms populated,
+  # SI-match scores flowing, golden canary GREEN, <=2% paired
+  # telemetry overhead, budget-0 with quality on) plus chaos_bench's
+  # degraded_model battery (bit-flipped staged params refused typed by
+  # the canary; a force-committed one rolled back by the canary-armed
+  # watchdog, bit-identically back on the good model; corrupted side
+  # image trips the SI-match alarm). Both exit 1 on violation; seconds
+  # on CPU.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --quality \
+    --devices "" --out artifacts/quality_bench.json \
+    > artifacts/quality_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/quality_bench.log
+    echo "TPU_SESSION_FAILED: quality-smoke (queue aborted before chip stages)"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke --degraded_only \
+    --out artifacts/quality_degraded_chaos.json \
+    > artifacts/quality_degraded_chaos.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/quality_degraded_chaos.log
+    echo "TPU_SESSION_FAILED: quality-smoke (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -241,7 +268,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
