@@ -7,7 +7,11 @@ Layering (each module stands alone below the next):
                    backpressure, drain (pure stdlib threading)
     router.py    — front door: per-class admission control + the
                    shared-nothing multi-replica router (spawned
-                   service processes, /healthz-fed eviction)
+                   service processes, /healthz-fed eviction, runtime
+                   add/drain replica fleet mutation)
+    autoscale.py — elastic-fleet control plane (ISSUE 14): windowed
+                   autoscale + fleet-health policies (pure) and the
+                   Autoscaler loop that calls add/drain/rollback
     placement.py — bucket ladder -> device mesh assignment (replica
                    policy + per-device shardings via parallel/mesh.py)
     session.py   — side-information session cache: LRU/TTL/byte-bounded
@@ -28,6 +32,12 @@ Driven by tools/serve_bench.py (open-loop load + --devices scaling axis,
 SERVE_BENCH.json).
 """
 
+from dsin_tpu.serve.autoscale import (Autoscaler, AutoscaleConfig,
+                                      AutoscaleError, AutoscalePolicy,
+                                      FleetHealthPolicy,
+                                      FleetHealthSignals, ScaleSignals,
+                                      health_from_snapshot,
+                                      signals_from_snapshot)
 from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, DeadlineExceeded,
                                     Future, MicroBatcher, PriorityClass,
                                     Request, ServeError, ServiceDraining,
@@ -41,8 +51,8 @@ from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
                                       PlacementPlan, RebalanceTrigger,
                                       plan_placement)
 from dsin_tpu.serve.router import (AdmissionController, AggregatedMetrics,
-                                   AggregatedTraces, FleetSwapError,
-                                   FrontDoorRouter)
+                                   AggregatedTraces, FleetScaleError,
+                                   FleetSwapError, FrontDoorRouter)
 from dsin_tpu.serve.service import (CompressionService, EncodeResult,
                                     ServiceConfig)
 from dsin_tpu.serve.session import (SessionEntry, SessionError,
@@ -57,9 +67,13 @@ from dsin_tpu.utils.integrity import IntegrityError
 __all__ = [
     "BULK", "INTERACTIVE",
     "AdmissionController", "AggregatedMetrics", "AggregatedTraces",
+    "Autoscaler", "AutoscaleConfig", "AutoscaleError",
+    "AutoscalePolicy",
     "BucketPolicy", "CanaryFailed", "CompressionService",
     "DeadlineExceeded",
-    "DevicePlacement", "EncodeResult", "FleetSwapError",
+    "DevicePlacement", "EncodeResult", "FleetHealthPolicy",
+    "FleetHealthSignals", "FleetScaleError", "FleetSwapError",
+    "ScaleSignals",
     "FlightRecorder", "FrontDoorRouter", "Future",
     "IntegrityError", "ManifestMismatch", "MetricsRegistry",
     "MetricsServer", "MicroBatcher", "ModelBundle", "NoBucketFits",
@@ -70,6 +84,7 @@ __all__ = [
     "ServiceUnavailable", "SessionEntry", "SessionError",
     "SessionExpired", "SessionOverCapacity", "SessionStore",
     "SwapCoordinator", "SwapError", "TraceContext", "Tracer",
-    "crop_from_bucket", "default_priority_classes", "pad_to_bucket",
-    "plan_placement",
+    "crop_from_bucket", "default_priority_classes",
+    "health_from_snapshot", "pad_to_bucket",
+    "plan_placement", "signals_from_snapshot",
 ]

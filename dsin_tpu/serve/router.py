@@ -89,6 +89,29 @@ failed to advance since the previous scrape (or whose capture
 timestamp is old) is flagged in `replicas_stale` and EXCLUDED from the
 merge instead of silently contributing frozen numbers.
 
+Elastic fleet (ISSUE 14): the replica set is MUTABLE at runtime.
+`add_replica()` spawns one cold replica and admits it to the rotation
+only after the full warm-before-admit handshake — the child builds +
+warms its whole executable census (the persistent compile cache makes
+that cheap) and answers its `params_digest`, which must equal the
+fleet's or the newcomer is killed and refused typed (`FleetScaleError`)
+BEFORE a single request can route to it: scale-up can never split the
+fleet or compile in steady state. `drain_replica()` is the graceful
+inverse: the victim leaves the dispatch rotation immediately (state
+"draining" — `_pick` skips it, pinned SI submits answer typed
+`SessionExpired` at the door), its in-flight work finishes on it within
+a bounded window, its pinned sessions typed-fail through the SAME
+"replica leaves rotation" path a crash uses (`_leave_rotation`: pin
+orphaning and in-flight re-dispatch are literally one code path for
+death and drain), then the process is reaped. One scale op at a time
+(`FleetScaleError`), and scale ops are mutually exclusive with fleet
+swaps — a replica admitted mid-commit could land on either side of the
+digest. `serve/autoscale.py` closes the loop: its Autoscaler watches
+the aggregated signals and calls add/drain itself, and its fleet-health
+watchdog drives `rollback(expect_digest=...)` — CONDITIONAL per
+replica, so it converges with (never fights) a per-replica
+RollbackWatchdog that already rolled its own service back.
+
 Tracing (ISSUE 11): the router mints the front-door `TraceContext`
 (serve/trace.py) at `_submit` — its head sampling decision rides the
 pipe with every (re)dispatch and is honored replica-side, so one trace
@@ -123,6 +146,7 @@ from dsin_tpu.serve import trace as trace_lib
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, ServeError,
                                     ServiceOverloaded, ServiceUnavailable)
 from dsin_tpu.serve.session import SessionExpired
+from dsin_tpu.serve.swap import SwapError
 from dsin_tpu.utils import locks as locks_lib
 
 #: pipe ops that drive the two-phase hot swap instead of carrying a
@@ -147,6 +171,14 @@ class FleetSwapError(RuntimeError):
     def __init__(self, msg: str, per_replica: Optional[Dict] = None):
         super().__init__(msg)
         self.per_replica = dict(per_replica or {})
+
+
+class FleetScaleError(RuntimeError):
+    """A runtime fleet mutation (add_replica/drain_replica) was refused
+    or failed: the newcomer built a DIFFERENT model than the fleet
+    serves (it was killed before it could take traffic), a second scale
+    op raced the first, a scale op raced a fleet swap, or a drain would
+    empty the fleet. The current rotation keeps serving either way."""
 
 
 def default_admission_limits(config) -> Dict[str, int]:
@@ -213,6 +245,24 @@ class AdmissionController:
         with self._lock:
             self._outstanding[cls] = max(0, self._outstanding[cls] - 1)
 
+    def set_limits(self, limits: Mapping[str, int]) -> None:
+        """Resize the per-class caps in place (ISSUE 14: the router
+        rescales its derived aggregate caps when the fleet grows or
+        shrinks — scaled-up capacity behind the old cap would shed the
+        very load the scale-up was fired to absorb). The CLASS SET is
+        fixed at construction; shrinking below the current outstanding
+        simply sheds new admits until the backlog drains."""
+        bad = {c: n for c, n in limits.items() if int(n) < 1}
+        if bad:
+            raise ValueError(f"admission limits must be >= 1: {bad}")
+        with self._lock:
+            if set(map(str, limits)) != set(self._outstanding):
+                raise ValueError(
+                    f"admission classes are fixed at construction "
+                    f"(have {sorted(self._outstanding)}, got "
+                    f"{sorted(map(str, limits))})")
+            self.limits = {str(c): int(n) for c, n in limits.items()}
+
     def attach(self, cls: str, future: Future) -> None:
         """Release the class slot the moment `future` resolves (runs on
         the resolving thread; the admission rung ranks above the
@@ -252,6 +302,7 @@ def _replica_main(conn, config, replica_id: int) -> None:
     interleave and never run under a ranked lock) until "stop" or
     router death (EOF), then a graceful drain."""
     from dsin_tpu.serve.service import CompressionService
+    from dsin_tpu.utils import recompile
     try:
         cfg = replace(config, metrics_port=0)
         service = CompressionService(cfg).start()
@@ -260,6 +311,11 @@ def _replica_main(conn, config, replica_id: int) -> None:
                 "healthz_port": service._metrics_server.port,
                 "warmup_compiles": warm["compiles"],
                 "warmup_cache_hits": warm["cache_hits"],
+                # this child's ABSOLUTE compile count the moment it is
+                # warm (ISSUE 14): serve_bench's autoscale leg gates
+                # `serve_xla_compiles(end of serving life) - this == 0`
+                # per replica — the exact warm-before-admit evidence
+                "compiles_at_ready": recompile.compilation_count(),
                 # the service's cached bundle digest IS
                 # coding/loader.py params_digest over (params,
                 # batch_stats) — one digest story everywhere
@@ -507,20 +563,34 @@ class FrontDoorRouter:
         if admission_limits is None:
             # default: every replica can hold a full class queue plus
             # its pipelines in flight (shared derivation with the
-            # service's own gate) — the cap is on the AGGREGATE backlog
+            # service's own gate) — the cap is on the AGGREGATE
+            # backlog, and it RESCALES with the live fleet (ISSUE 14:
+            # add/drain/death re-derive it; an operator-given explicit
+            # map never moves)
+            self._admission_per_replica: Optional[Dict[str, int]] = \
+                dict(default_admission_limits(config))
             admission_limits = {
                 c: self.num_replicas * per_replica
                 for c, per_replica in
-                default_admission_limits(config).items()}
+                self._admission_per_replica.items()}
+        else:
+            self._admission_per_replica = None
         self.admission = AdmissionController(admission_limits,
                                              metrics=self.metrics)
         self._launcher = launcher or _spawn_launcher
         self._lock = locks_lib.RankedLock("serve.frontdoor")
-        self._replicas: List[_Replica] = []   # fixed after start()
+        # APPEND-ONLY at runtime (ISSUE 14): a drained/dead replica
+        # keeps its slot (its idx stays a stable key for pins, metrics,
+        # per-replica info) in a terminal state; add_replica appends.
+        self._replicas: List[_Replica] = []   # guarded-by: self._lock
         self._state: Dict[int, str] = {}   # guarded-by: self._lock
         self._fails: Dict[int, int] = {}   # guarded-by: self._lock
         self._rr: Dict[str, int] = {}      # guarded-by: self._lock
         self._rid = 0                      # guarded-by: self._lock
+        # one runtime scale op (add/drain) at a time; also excludes
+        # fleet swaps (a replica admitted mid-commit could land on
+        # either side of the digest)
+        self._scaling = False              # guarded-by: self._lock
         # sid -> replica idx: the session-affinity pin table (ISSUE 10)
         self._sessions: Dict[str, int] = {}  # guarded-by: self._lock
         self._stop = threading.Event()
@@ -558,13 +628,16 @@ class FrontDoorRouter:
             return self
         import multiprocessing
         ctx = multiprocessing.get_context("spawn")
+        replicas = []
         for i in range(self.num_replicas):
             proc, conn = self._launcher(self.config, i, ctx)
-            self._replicas.append(_Replica(i, proc, conn))
+            replicas.append(_Replica(i, proc, conn))
+        with self._lock:
+            self._replicas = replicas
         deadline = time.monotonic() + self.start_timeout_s
         digests = []
         try:
-            for rep in self._replicas:
+            for rep in replicas:
                 rep.info = self._wait_ready(rep, deadline)
                 digests.append(rep.info.get("params_digest"))
         except BaseException:
@@ -578,10 +651,10 @@ class FrontDoorRouter:
                 f"answer the same request with different bytes")
         self.params_digest = digests[0]
         with self._lock:
-            for rep in self._replicas:
+            for rep in replicas:
                 self._state[rep.idx] = "live"
                 self._fails[rep.idx] = 0
-        for rep in self._replicas:
+        for rep in replicas:
             rep.reader = threading.Thread(
                 target=self._reader, args=(rep,),
                 name=f"router-reader-{rep.idx}", daemon=True)
@@ -589,7 +662,7 @@ class FrontDoorRouter:
         self._poller = threading.Thread(target=self._poll_loop,
                                         name="router-health", daemon=True)
         self._poller.start()
-        self.metrics.gauge("serve_router_replicas").set(self.num_replicas)
+        self._publish_replica_gauges()
         if self.metrics_port is not None:
             self._metrics_server = metrics_lib.MetricsServer(
                 self.aggregate, self.health,
@@ -597,6 +670,33 @@ class FrontDoorRouter:
                 trace=self.traces.http_snapshot).start()
         self._started = True
         return self
+
+    def _all_replicas(self) -> List[_Replica]:
+        """Snapshot of the replica list (append-only, but iterating the
+        live list while add_replica appends is still a data race)."""
+        with self._lock:
+            return list(self._replicas)
+
+    def _publish_replica_gauges(self) -> None:
+        with self._lock:
+            states = [self._state.get(rep.idx) for rep in self._replicas]
+            live = sum(1 for s in states if s == "live")
+            if self._admission_per_replica is not None:
+                # the aggregate admission cap tracks the LIVE fleet: a
+                # scaled-up fleet behind the old cap would shed exactly
+                # the load the scale-up was meant to absorb. Applied
+                # UNDER the frontdoor lock (4 -> admission 14, legal)
+                # so two concurrent publishers cannot apply stale live
+                # counts last-writer-wins.
+                self.admission.set_limits(
+                    {c: max(1, live) * per for c, per in
+                     self._admission_per_replica.items()})
+            # gauges too: publishes only happen on scale/death events,
+            # so a last-writer-wins stale count would stand until the
+            # NEXT fleet mutation (4 -> metrics 90, legal)
+            self.metrics.gauge("serve_router_replicas").set(live)
+            self.metrics.gauge("serve_router_replicas_total").set(
+                len(states))
 
     def _wait_ready(self, rep: _Replica, deadline: float) -> dict:
         while True:
@@ -624,7 +724,7 @@ class FrontDoorRouter:
                     f"{rep.proc.exitcode}) during startup")
 
     def _kill_all(self) -> None:
-        for rep in self._replicas:
+        for rep in self._all_replicas():
             if rep.proc is not None and rep.proc.is_alive():
                 rep.proc.terminate()
             try:
@@ -792,10 +892,10 @@ class FrontDoorRouter:
         assert self._started, "start() the router first"
         with self._lock:
             idx = self._sessions.pop(session_id, None)
+            rep = None if idx is None else self._replicas[idx]
         self._publish_pins()
-        if idx is None:
+        if rep is None:
             return False
-        rep = self._replicas[idx]
         pending = _Pending("session_close", session_id, "control", None, 0)
         if not self._send_pinned(rep, "session_close", pending):
             self._on_disconnect(rep)
@@ -836,7 +936,8 @@ class FrontDoorRouter:
         self.admission.attach(cls, pending.future)
         self._attach_trace(pending, "decode_si", cls)
         self._swap_gate.wait(_SWAP_GATE_TIMEOUT_S)
-        rep = self._replicas[idx]
+        with self._lock:
+            rep = self._replicas[idx]
         if not self._send_pinned(rep, "decode_si", pending):
             self._on_disconnect(rep)
             exc = SessionExpired(
@@ -936,25 +1037,51 @@ class FrontDoorRouter:
         self._on_disconnect(rep)
 
     def _on_disconnect(self, rep: _Replica) -> None:
-        """First observer of a dead replica marks it and reroutes its
-        in-flight requests (idempotent: later observers find the state
-        already 'dead' and an empty map). Futures resolve exactly once:
-        ownership transfers by popping from the in-flight map."""
+        """Transport loss: classify it and run the ONE leave-rotation
+        path. Only a replica that was already TOLD to stop
+        ('stopping', or terminal 'drained') leaves as a graceful
+        drain; EOF while merely 'draining' (the in-flight grace
+        window, before the stop was sent) is a real crash — it must
+        count as a death and trigger the flight dump."""
         with self._lock:
-            already = self._state.get(rep.idx) == "dead"
-            self._state[rep.idx] = "dead"
+            reason = ("drain"
+                      if self._state.get(rep.idx) in ("stopping",
+                                                      "drained")
+                      else "death")
+        self._leave_rotation(rep, reason=reason)
+
+    def _leave_rotation(self, rep: _Replica, *, reason: str) -> None:
+        """THE one path a replica leaves the rotation by — crash/EOF
+        ('death') and graceful scale-down ('drain') share it end to end
+        (ISSUE 14 satellite: the two used to be separate code, so pin
+        orphaning and in-flight handling could drift). First observer
+        marks the terminal state and owns the cleanup (idempotent:
+        later observers find it terminal and an empty map); session
+        pins drop with `serve_router_session_orphans` accounting and
+        in-flight requests resolve exactly once — rerouted, expired, or
+        typed — identically in both paths. Futures resolve exactly
+        once: ownership transfers by popping from the in-flight map."""
+        terminal = "drained" if reason == "drain" else "dead"
+        with self._lock:
+            already = self._state.get(rep.idx) in ("dead", "drained")
+            self._state[rep.idx] = terminal
         if already:
             return
         draining = self._stop.is_set()
         if not draining:
-            self.metrics.counter("serve_router_replica_deaths").inc()
-            # replica death is a flight-dump trigger (ISSUE 11): the
-            # router's ring holds the routing/shed decisions that led
-            # up to it
-            self.flight.note_death("replica_death", replica=rep.idx)
-        # drop the dead replica's session pins FIRST: a submit racing
-        # this death must find no pin (typed SessionExpired at the
-        # door), never a pin pointing at a corpse
+            if reason == "drain":
+                # graceful exits are flight events, not deaths: the
+                # scaler's own decision trail must not read as crashes
+                self.flight.record("scale_down", replica=rep.idx)
+            else:
+                self.metrics.counter("serve_router_replica_deaths").inc()
+                # replica death is a flight-dump trigger (ISSUE 11):
+                # the router's ring holds the routing/shed decisions
+                # that led up to it
+                self.flight.note_death("replica_death", replica=rep.idx)
+        # drop the replica's session pins FIRST: a submit racing this
+        # exit must find no pin (typed SessionExpired at the door),
+        # never a pin pointing at a corpse/drained store
         with self._lock:
             orphan_sids = [sid for sid, i in self._sessions.items()
                            if i == rep.idx]
@@ -971,13 +1098,13 @@ class FrontDoorRouter:
             if pending.future.done():
                 continue
             if pending.op == "decode_si":
-                # the session's prep lived only in the dead replica —
-                # rerouting would hit a store that never heard of it;
+                # the session's prep lived only in the departed replica
+                # — rerouting would hit a store that never heard of it;
                 # fail typed with the one recovery that works
                 pending.future.set_exception(SessionExpired(
-                    f"replica {rep.idx} died holding this SI request — "
-                    f"its session's prep died with it; re-open the "
-                    f"session"))
+                    f"replica {rep.idx} left the rotation ({reason}) "
+                    f"holding this SI request — its session's prep "
+                    f"went with it; re-open the session"))
                 continue
             if pending.op in CONTROL_OPS:
                 # a swap phase is pinned to ITS replica — rerouting a
@@ -1010,6 +1137,202 @@ class FrontDoorRouter:
             pending.future.set_exception(ServiceUnavailable(
                 f"replica {rep.idx} went away with this request in "
                 f"flight" + ("" if draining else " (no retry left)")))
+        self._publish_replica_gauges()
+
+    # -- elastic fleet: runtime replica mutation (ISSUE 14) -------------------
+
+    def add_replica(self, timeout_s: Optional[float] = None) -> dict:
+        """Spawn ONE cold replica and admit it to the rotation — but
+        only after the full warm-before-admit handshake: the child
+        builds + warms its entire executable census (the persistent
+        compile cache makes a cold start cheap) and answers its
+        `params_digest`, which must equal the fleet's. A mismatch (or a
+        startup failure) kills the newcomer and raises typed
+        `FleetScaleError` BEFORE it could take a single request: the
+        fleet never splits and never compiles in steady state on
+        scale-up. Returns the admitted replica's ready info (idx, pid,
+        healthz port, warmup compile/cache-hit counts)."""
+        assert self._started, "start() the router before scaling"
+        self._claim_scale("add_replica")
+        try:
+            import multiprocessing
+            ctx = multiprocessing.get_context("spawn")
+            with self._lock:
+                idx = len(self._replicas)
+            try:
+                proc, conn = self._launcher(self.config, idx, ctx)
+            except Exception as e:  # noqa: BLE001 — typed contract
+                raise FleetScaleError(
+                    f"replica {idx} could not be launched for "
+                    f"scale-up ({type(e).__name__}: {e})") from e
+            rep = _Replica(idx, proc, conn)
+            deadline = time.monotonic() + (self.start_timeout_s
+                                           if timeout_s is None
+                                           else float(timeout_s))
+            try:
+                rep.info = self._wait_ready(rep, deadline)
+            except BaseException as e:
+                self._reap(rep, stop_first=True)
+                raise FleetScaleError(
+                    f"replica {idx} failed to start for scale-up: "
+                    f"{e}") from e
+            digest = rep.info.get("params_digest")
+            if self.params_digest is not None \
+                    and digest != self.params_digest:
+                self._reap(rep, stop_first=True)
+                self.metrics.counter("serve_router_digest_skew").inc()
+                raise FleetScaleError(
+                    f"scale-up replica {idx} built model {digest!r} but "
+                    f"the fleet serves {self.params_digest!r} — killed "
+                    f"before it could answer a request (re-point the "
+                    f"config's checkpoint or re-swap the fleet first)")
+            # ADMIT: only now does the replica become routable
+            if self.params_digest is None:
+                # the fleet digest was UNKNOWN (an all-skipped
+                # conditional rollback): adopt the newcomer's — it just
+                # passed the same build the rest of the fleet did
+                self.params_digest = digest
+            with self._lock:
+                self._replicas.append(rep)
+                self.num_replicas = len(self._replicas)
+                self._state[rep.idx] = "live"
+                self._fails[rep.idx] = 0
+            rep.reader = threading.Thread(
+                target=self._reader, args=(rep,),
+                name=f"router-reader-{rep.idx}", daemon=True)
+            rep.reader.start()
+            self.metrics.counter("serve_router_scale_ups").inc()
+            self.flight.record("scale_up", replica=rep.idx,
+                               digest=digest,
+                               warmup_compiles=rep.info.get(
+                                   "warmup_compiles"))
+            self._publish_replica_gauges()
+            return dict(rep.info, replica=rep.idx)
+        finally:
+            with self._lock:
+                self._scaling = False
+
+    def drain_replica(self, idx: Optional[int] = None,
+                      timeout_s: float = 30.0) -> dict:
+        """Gracefully remove one replica from the fleet. The victim
+        (given, or auto-picked: fewest session pins, then fewest
+        in-flight, then the newest) leaves the dispatch rotation
+        IMMEDIATELY (state 'draining': `_pick` skips it and pinned SI
+        submits answer typed SessionExpired at the door), its in-flight
+        work gets up to `timeout_s` to finish on it, then it exits
+        through the SAME leave-rotation path a crash uses — stragglers
+        re-dispatch / typed-fail identically, pinned sessions orphan
+        with the same accounting — and the process is reaped. Refused
+        typed when it would empty the fleet."""
+        assert self._started, "start() the router before scaling"
+        self._claim_scale("drain_replica")
+        try:
+            with self._lock:
+                live = [rep for rep in self._replicas
+                        if self._state.get(rep.idx) == "live"]
+                if idx is not None:
+                    victim = next((rep for rep in self._replicas
+                                   if rep.idx == idx), None)
+                    if victim is None or \
+                            self._state.get(idx) != "live":
+                        raise FleetScaleError(
+                            f"replica {idx} is not live "
+                            f"({self._state.get(idx, 'unknown')!r}) — "
+                            f"nothing to drain")
+                else:
+                    pins: Dict[int, int] = {}
+                    for _sid, i in self._sessions.items():
+                        pins[i] = pins.get(i, 0) + 1
+                    depth: Dict[int, int] = {}
+                    for rep in live:
+                        with rep.lock:   # 4 -> 6: legal nesting
+                            depth[rep.idx] = len(rep.inflight)
+                    victim = min(
+                        live, default=None,
+                        key=lambda rep: (pins.get(rep.idx, 0),
+                                         depth[rep.idx], -rep.idx))
+                if victim is None or len(live) <= 1:
+                    raise FleetScaleError(
+                        f"refusing to drain replica "
+                        f"{getattr(victim, 'idx', idx)}: it is the last "
+                        f"live replica ({len(live)} live) — the fleet "
+                        f"must keep serving")
+                # out of the rotation NOW: no new dispatch picks it,
+                # pinned submits answer typed at the door
+                self._state[victim.idx] = "draining"
+            self._publish_replica_gauges()
+            # bounded grace for in-flight work to resolve ON the victim
+            deadline = time.monotonic() + timeout_s
+            inflight_left = 0
+            while time.monotonic() < deadline:
+                with victim.lock:
+                    inflight_left = len(victim.inflight)
+                if inflight_left == 0:
+                    break
+                time.sleep(0.01)
+            # graceful stop: the child drains its service and answers
+            # "bye"; the reader's EOF handling routes into
+            # _leave_rotation(reason="drain") — stragglers (a wedged
+            # victim) re-dispatch there exactly like a death's orphans.
+            # 'stopping' marks that the EOF is now EXPECTED: a crash
+            # BEFORE this point (state still 'draining') classifies as
+            # a death, never a routine scale-down.
+            with self._lock:
+                if self._state.get(victim.idx) == "draining":
+                    self._state[victim.idx] = "stopping"
+            with victim.lock:
+                try:
+                    victim.conn.send(("stop", None, None, None, None))
+                except (OSError, ValueError, BrokenPipeError):
+                    pass
+            if victim.reader is not None:
+                victim.reader.join(timeout=timeout_s)
+            self._leave_rotation(victim, reason="drain")  # idempotent
+            self._reap(victim, timeout_s=timeout_s)
+            self.metrics.counter("serve_router_scale_downs").inc()
+            self._publish_replica_gauges()
+            return {"replica": victim.idx,
+                    "inflight_at_stop": inflight_left}
+        finally:
+            with self._lock:
+                self._scaling = False
+
+    def _claim_scale(self, op: str) -> None:
+        with self._lock:
+            if self._scaling:
+                raise FleetScaleError(
+                    f"{op}: a fleet scale op is already in flight — "
+                    f"one at a time")
+            if self._swapping:
+                raise FleetScaleError(
+                    f"{op}: a fleet swap is in flight — a replica "
+                    f"admitted or drained mid-commit could split the "
+                    f"fleet; retry after the swap settles")
+            self._scaling = True
+
+    def _reap(self, rep: _Replica, timeout_s: float = 10.0,
+              stop_first: bool = False) -> None:
+        """Retire one replica's process and close its pipe. The
+        post-drain path already told the child to stop; the
+        refused-newcomer paths pass `stop_first` so the (healthy,
+        still-serving) child gets a graceful exit to react to instead
+        of burning the whole join timeout. Terminate is always followed
+        by a join — a SIGTERMed child whose status is never collected
+        is a zombie until router shutdown."""
+        if stop_first:
+            try:
+                rep.conn.send(("stop", None, None, None, None))
+            except (OSError, ValueError, BrokenPipeError):
+                pass
+        if rep.proc is not None:
+            rep.proc.join(timeout=timeout_s)
+            if rep.proc.is_alive():
+                rep.proc.terminate()
+                rep.proc.join(timeout=5.0)
+        try:
+            rep.conn.close()
+        except OSError:
+            pass
 
     # -- fleet-coordinated hot swap (ISSUE 9) --------------------------------
 
@@ -1078,6 +1401,11 @@ class FrontDoorRouter:
             if self._swapping:
                 raise FleetSwapError("a fleet swap is already in flight "
                                      "— one at a time")
+            if self._scaling:
+                raise FleetSwapError(
+                    "a fleet scale op (add/drain replica) is in flight "
+                    "— a swap racing it could commit past a replica "
+                    "entering or leaving the rotation; retry shortly")
             self._swapping = True
         try:
             reps = self._live_replicas()
@@ -1154,36 +1482,100 @@ class FrontDoorRouter:
             with self._lock:
                 self._swapping = False
 
-    def rollback(self, timeout_s: float = 60.0) -> dict:
+    def rollback(self, timeout_s: float = 60.0,
+                 expect_digest: Optional[str] = None) -> dict:
         """Fleet-wide instant rollback (every replica re-instates its
         warm previous bundle) under the same dispatch gate. Partial
         failure raises FleetSwapError — the operator must know the
-        fleet split rather than discover it as bit-identity flakes."""
+        fleet split rather than discover it as bit-identity flakes.
+
+        `expect_digest` makes it CONDITIONAL per replica (ISSUE 14, the
+        fleet-health driver's mode): each replica rolls back only if
+        its serving digest IS the sick one; a replica already off it —
+        typically because its OWN RollbackWatchdog fired first — refuses
+        typed and is reported as skipped rather than failed, so the
+        fleet driver converges with (never fights) a per-replica
+        watchdog."""
         assert self._started, "start() the router before rollback"
-        reps = self._live_replicas()
-        if not reps:
-            raise ServiceUnavailable("no live replica to roll back")
-        self._swap_gate.clear()
+        # a rollback is a fleet digest transition like a swap: claim
+        # the same exclusivity so a scale op cannot admit/drain a
+        # replica across the flip (the newcomer would be validated
+        # against the pre-rollback digest)
+        with self._lock:
+            if self._swapping:
+                raise FleetSwapError("a fleet swap/rollback is already "
+                                     "in flight — one at a time")
+            if self._scaling:
+                raise FleetSwapError(
+                    "a fleet scale op (add/drain replica) is in flight "
+                    "— a rollback racing it could flip the digest "
+                    "under an admit; retry shortly")
+            self._swapping = True
         try:
-            results, errors = self._broadcast(reps, "rollback", None,
-                                              timeout_s)
+            reps = self._live_replicas()
+            if not reps:
+                raise ServiceUnavailable("no live replica to roll back")
+            self._swap_gate.clear()
+            try:
+                results, errors = self._broadcast(
+                    reps, "rollback", expect_digest, timeout_s)
+            finally:
+                self._swap_gate.set()
+            # every replica that rolled back invalidated its session
+            # store
+            self._drop_all_pins("rollback")
+            skipped = {}
+            if expect_digest is not None:
+                # ONLY the conditional refusal counts as converged:
+                # "this replica is not serving the sick digest" —
+                # already rolled back (its own watchdog won the race)
+                # or it never committed. Any OTHER SwapError (e.g.
+                # "nothing to roll back to" from a replica that IS
+                # serving the sick model with no prev bundle) is a
+                # real failure — treating it as skipped would report
+                # success over a split fleet.
+                skipped = {i: e for i, e in errors.items()
+                           if isinstance(e, SwapError)
+                           and "conditional rollback refused" in str(e)}
+                for i in skipped:
+                    del errors[i]
+            digests = {info["digest"] for info in results.values()}
+            if errors or len(digests) > 1 \
+                    or (not results and not skipped):
+                self.metrics.counter("serve_router_swap_aborts").inc()
+                outcome = {i: f"rolled back to {results[i]['digest']}"
+                           for i in results}
+                outcome.update({i: f"skipped: {e}"
+                                for i, e in skipped.items()})
+                outcome.update({i: e for i, e in errors.items()})
+                raise FleetSwapError(
+                    f"fleet rollback did not converge (digests "
+                    f"{sorted(digests)!r}, {len(errors)} failure(s), "
+                    f"{len(skipped)} skipped)", per_replica=outcome)
+            if digests:
+                self.params_digest = digests.pop()
+            elif skipped:
+                # EVERY replica had already rolled itself back: the
+                # fleet is off the sick digest but nobody told this
+                # router which digest it converged on — learn it from
+                # /healthz instead of keeping the sick name (a stale
+                # params_digest would refuse every healthy scale-up
+                # newcomer). When the polls cannot resolve it (timeout,
+                # split answers), record UNKNOWN rather than the sick
+                # digest — the health poller re-learns it from the next
+                # successful poll, and an unknown digest admits rather
+                # than wedging every future scale-up on a stale value.
+                polled = {d for ok, d in (self._healthz_ok(rep)
+                                          for rep in reps) if ok and d}
+                self.params_digest = (polled.pop() if len(polled) == 1
+                                      else None)
+            self.metrics.counter("serve_router_rollbacks").inc()
+            return {"digest": self.params_digest,
+                    "replicas": sorted(results),
+                    "skipped": sorted(skipped)}
         finally:
-            self._swap_gate.set()
-        # every replica that rolled back invalidated its session store
-        self._drop_all_pins("rollback")
-        digests = {info["digest"] for info in results.values()}
-        if errors or len(digests) != 1:
-            self.metrics.counter("serve_router_swap_aborts").inc()
-            outcome = {i: f"rolled back to {results[i]['digest']}"
-                       for i in results}
-            outcome.update({i: e for i, e in errors.items()})
-            raise FleetSwapError(
-                f"fleet rollback did not converge (digests "
-                f"{sorted(digests)!r}, {len(errors)} failure(s))",
-                per_replica=outcome)
-        self.params_digest = digests.pop()
-        self.metrics.counter("serve_router_rollbacks").inc()
-        return {"digest": self.params_digest, "replicas": sorted(results)}
+            with self._lock:
+                self._swapping = False
 
     # -- health -------------------------------------------------------------
 
@@ -1211,18 +1603,30 @@ class FrontDoorRouter:
         slow, still completes); one healthy poll readmits it. 'dead'
         (transport gone) is terminal — there is nobody to talk to."""
         while not self._stop.wait(self.poll_every_s):
-            for rep in self._replicas:
+            for rep in self._all_replicas():
                 with self._lock:
                     state = self._state.get(rep.idx)
-                if state == "dead":
+                if state in ("dead", "drained", "draining", "stopping"):
+                    # terminal (nobody to talk to) or already leaving
+                    # the rotation on purpose — polling it could only
+                    # readmit a replica mid-drain
                     continue
                 # no locks across the poll
                 ok, digest = self._healthz_ok(rep)
                 with self._lock:
-                    if self._state.get(rep.idx) == "dead":
+                    if self._state.get(rep.idx) in ("dead", "drained",
+                                                    "draining",
+                                                    "stopping"):
                         continue
                     if ok:
                         self._fails[rep.idx] = 0
+                        if (self.params_digest is None
+                                and digest is not None
+                                and self._state[rep.idx] == "live"):
+                            # an all-skipped conditional rollback left
+                            # the fleet digest UNKNOWN — re-learn it
+                            # from the first live replica that answers
+                            self.params_digest = digest
                         if self._state[rep.idx] == "evicted":
                             if (digest is not None
                                     and self.params_digest is not None
@@ -1251,7 +1655,13 @@ class FrontDoorRouter:
             states = {str(rep.idx): self._state.get(rep.idx, "unknown")
                       for rep in self._replicas}
         live = sum(1 for s in states.values() if s == "live")
-        status = ("ok" if live == len(states)
+        # drained/draining/stopping replicas are leaving the fleet ON
+        # PURPOSE (ISSUE 14): they are not degradation — only non-live
+        # replicas that are still SUPPOSED to be serving count against
+        # the status (a routine scale-down must not page anyone)
+        expected = sum(1 for s in states.values()
+                       if s not in ("drained", "draining", "stopping"))
+        status = ("ok" if live and live == expected
                   else "degraded" if live else "unhealthy")
         return {"status": status, "live": live, "replicas": states,
                 "outstanding": self.admission.outstanding(),
@@ -1270,16 +1680,17 @@ class FrontDoorRouter:
             self._metrics_server = None
         if self._poller is not None:
             self._poller.join(timeout=timeout_s)
-        for rep in self._replicas:
+        replicas = self._all_replicas()
+        for rep in replicas:
             with rep.lock:
                 try:
                     rep.conn.send(("stop", None, None, None, None))
                 except (OSError, ValueError, BrokenPipeError):
                     pass
-        for rep in self._replicas:
+        for rep in replicas:
             if rep.reader is not None:
                 rep.reader.join(timeout=timeout_s)
-        for rep in self._replicas:
+        for rep in replicas:
             if rep.proc is not None:
                 rep.proc.join(timeout=timeout_s)
                 if rep.proc.is_alive():
@@ -1404,12 +1815,47 @@ class AggregatedMetrics:
                 return self._scrape(rep)
             except Exception:   # noqa: BLE001 — a dead scrape is data
                 return None
-        replicas = list(self._router._replicas)
+        replicas = self._router._all_replicas()
+        with self._router._lock:
+            replica_states = {str(rep.idx):
+                              self._router._state.get(rep.idx, "unknown")
+                              for rep in replicas}
+        # per-replica occupancy (ISSUE 14 satellite): the scaler's
+        # primary input, published as a structural fact instead of
+        # being hand-derived from counters. The router-side outstanding
+        # depth (its in-flight map) is available even for a replica
+        # whose scrape fails; the replica-side queue depth and batch
+        # occupancy join it where the scrape answers.
+        occupancy: Dict[str, dict] = {}
+        for rep in replicas:
+            with rep.lock:
+                outstanding = len(rep.inflight)
+            occupancy[str(rep.idx)] = {
+                "state": replica_states[str(rep.idx)],
+                "outstanding": outstanding,
+                "queue_depth": None,
+                "batch_occupancy_mean": None,
+            }
+        replica_errors: Dict[str, dict] = {}
+        # scrape only replicas that can still answer: a long-lived
+        # autoscaled fleet accretes terminally dead/drained slots in
+        # the append-only list, and paying a doomed HTTP timeout per
+        # retired replica on EVERY snapshot (while permanently
+        # polluting replicas_unreachable) would mask a genuinely
+        # unreachable LIVE replica. Their identity stays in
+        # replica_digests/replica_states/replica_occupancy.
+        targets = [rep for rep in replicas
+                   if replica_states[str(rep.idx)]
+                   not in ("dead", "drained")]
+        for rep in replicas:
+            if rep not in targets:
+                digests[str(rep.idx)] = (rep.info or {}).get(
+                    "params_digest")
         with ThreadPoolExecutor(
-                max_workers=max(1, len(replicas))) as pool:
-            snaps = list(pool.map(_safe_scrape, replicas))
+                max_workers=max(1, len(targets) or 1)) as pool:
+            snaps = list(pool.map(_safe_scrape, targets))
         now = time.time()
-        for rep, snap in zip(replicas, snaps):
+        for rep, snap in zip(targets, snaps):
             if snap is None:
                 unreachable.append(rep.idx)
                 digests[str(rep.idx)] = (rep.info or {}).get(
@@ -1444,6 +1890,22 @@ class AggregatedMetrics:
             digests[str(rep.idx)] = (model.get("digest")
                                      or (rep.info or {}).get(
                                          "params_digest"))
+            occ = occupancy[str(rep.idx)]
+            occ["queue_depth"] = snap.get("gauges", {}).get(
+                "serve_queue_depth")
+            bo = snap.get("histograms", {}).get("serve_batch_occupancy")
+            if bo:
+                occ["batch_occupancy_mean"] = bo.get("mean")
+            # per-replica typed-error evidence (ISSUE 14): the fleet
+            # health driver needs the SKEW across replicas — a summed
+            # counter cannot say whether one replica or the whole
+            # model is sick
+            replica_errors[str(rep.idx)] = {
+                "typed_errors": snap.get("counters", {}).get(
+                    "serve_typed_errors", 0),
+                "resolved": snap.get("counters", {}).get(
+                    "serve_resolved", 0),
+            }
         histograms = {
             k: {"count": c,
                 "mean": (wsum / c) if c else 0.0,
@@ -1471,6 +1933,8 @@ class AggregatedMetrics:
             "info": {
                 "router": own["info"],
                 "replica_digests": digests,
+                "replica_states": replica_states,
+                "replica_occupancy": occupancy,
                 "per_replica": per_replica_info,
                 "replicas_scraped": len(per_replica_info),
                 "replicas_unreachable": unreachable,
@@ -1480,6 +1944,7 @@ class AggregatedMetrics:
                     "replicas_canary_failing": sorted(canary_failing),
                     "fleet_canary_ok": (not canary_failing) if canary
                     else None,
+                    "replica_errors": replica_errors,
                 },
             },
             "counters": dict(sorted(counters.items())),
@@ -1537,7 +2002,13 @@ class AggregatedTraces:
                 return self._scrape(rep, trace_id)
             except Exception:   # noqa: BLE001 — a dead scrape is data
                 return None
-        replicas = list(self._router._replicas)
+        all_replicas = self._router._all_replicas()
+        with self._router._lock:
+            # retired (dead/drained) replicas cannot answer: scraping
+            # them pays a doomed timeout per snapshot forever
+            replicas = [rep for rep in all_replicas
+                        if self._router._state.get(rep.idx)
+                        not in ("dead", "drained")]
         scraped = 0
         unreachable = []
         parts = [own]

@@ -27,6 +27,11 @@ The repo lock hierarchy (rank ascending = acquire order outer->inner;
 a thread holding rank r may only acquire ranks > r):
 
     rank  name                where
+       2  serve.autoscale     autoscaler control-loop state (serve/autoscale.py)
+                              — OUTERMOST serve rank: one tick may hold
+                              it across router.add_replica/drain_replica/
+                              rollback calls, which acquire
+                              serve.frontdoor (4) and serve.replica (6)
        4  serve.frontdoor     router replica table / per-class rr state (serve/router.py)
        6  serve.replica       per-replica pipe send + in-flight map (serve/router.py)
       10  serve.batcher       MicroBatcher's condition (serve/batcher.py)
@@ -91,6 +96,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 #: the repo-wide lock hierarchy: name -> rank. See the module docstring
 #: for the rationale per rung.
 HIERARCHY: Dict[str, int] = {
+    "serve.autoscale": 2,
     "serve.frontdoor": 4,
     "serve.replica": 6,
     "serve.batcher": 10,

@@ -1,0 +1,236 @@
+"""Manifest-driven checkpoint GC (ISSUE 14): `gc_checkpoints` retires
+ONLY digests no fleet member references — live, staged, and prev slots
+all count, unmanifested dirs are never touched, the newest checkpoints
+survive regardless, and the kill-window `refresh` re-check keeps a
+digest that becomes referenced between the listing and the rm. Plus
+the tools/ckpt_gc.py reference-gathering and CLI contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dsin_tpu.train.checkpoint import gc_checkpoints
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fake_ckpt(root, name, digest, step=0, manifest=True):
+    """A checkpoint dir as GC sees one: manifest (identity) + meta
+    (completeness) + a payload byte. GC never parses the msgpacks, so
+    fabricated dirs keep the suite model-free and fast."""
+    d = root / name
+    d.mkdir()
+    (d / "payload.msgpack").write_bytes(b"x" * 64)
+    if manifest:
+        (d / "manifest.json").write_text(json.dumps(
+            {"manifest_version": 1, "step": step,
+             "params_digest": digest}))
+    (d / "meta.json").write_text(json.dumps({"step": step}))
+    return d
+
+
+def test_gc_retires_only_unreferenced_digests(tmp_path):
+    _fake_ckpt(tmp_path, "ckpt_live", "dlive", step=3)
+    _fake_ckpt(tmp_path, "ckpt_prev", "dprev", step=2)
+    _fake_ckpt(tmp_path, "ckpt_staged", "dstaged", step=1)
+    _fake_ckpt(tmp_path, "ckpt_old", "dold", step=0)
+    report = gc_checkpoints(
+        str(tmp_path), {"dlive", "dprev", "dstaged"}, keep_latest=0)
+    assert [r["dir"] for r in report["retired"]] == ["ckpt_old"]
+    assert report["bytes_freed"] > 0
+    assert not (tmp_path / "ckpt_old").exists()
+    # every referenced slot class survived — live, staged, AND prev
+    survivors = {k["dir"] for k in report["kept"]}
+    assert survivors == {"ckpt_live", "ckpt_prev", "ckpt_staged"}
+    for name in survivors:
+        assert (tmp_path / name).exists()
+
+
+def test_gc_never_deletes_an_unmanifested_dir(tmp_path):
+    _fake_ckpt(tmp_path, "legacy", "ignored", manifest=False)
+    corrupt = _fake_ckpt(tmp_path, "rotted", "dr")
+    (corrupt / "manifest.json").write_text("{not json")
+    report = gc_checkpoints(str(tmp_path), set(), keep_latest=0)
+    assert report["retired"] == []
+    assert sorted(report["unidentified"]) == ["legacy", "rotted"]
+    assert (tmp_path / "legacy").exists()
+    assert (tmp_path / "rotted").exists()
+
+
+def test_gc_keep_latest_survives_unreferenced(tmp_path):
+    for i in range(4):
+        _fake_ckpt(tmp_path, f"ckpt_{i}", f"d{i}", step=i)
+    report = gc_checkpoints(str(tmp_path), set(), keep_latest=2)
+    # newest two (by step) kept; the two oldest retired
+    assert {k["dir"] for k in report["kept"]} == {"ckpt_3", "ckpt_2"}
+    assert {r["dir"] for r in report["retired"]} == {"ckpt_0", "ckpt_1"}
+
+
+def test_gc_dry_run_deletes_nothing(tmp_path):
+    _fake_ckpt(tmp_path, "ckpt_a", "da", step=1)
+    _fake_ckpt(tmp_path, "ckpt_b", "db", step=0)
+    report = gc_checkpoints(str(tmp_path), {"da"}, keep_latest=0,
+                            dry_run=True)
+    assert [r["dir"] for r in report["retired"]] == ["ckpt_b"]
+    assert (tmp_path / "ckpt_b").exists()
+
+
+def test_gc_skips_inflight_tmp_dirs_and_considers_prev_rotations(
+        tmp_path):
+    _fake_ckpt(tmp_path, "ckpt", "dlive", step=5)
+    _fake_ckpt(tmp_path, "ckpt.prev-000001", "dold", step=4)
+    _fake_ckpt(tmp_path, "ckpt.tmp-1234", "dstaging", step=6)
+    report = gc_checkpoints(str(tmp_path), {"dlive"}, keep_latest=0)
+    assert {r["dir"] for r in report["retired"]} == {"ckpt.prev-000001"}
+    assert (tmp_path / "ckpt.tmp-1234").exists()   # an in-flight save's
+
+
+def test_gc_kill_window_refresh_keeps_a_just_staged_digest(tmp_path):
+    """THE kill-window contract: a digest that becomes referenced
+    between the GC's listing and its rm (a fleet prepare staging
+    exactly this candidate) is re-checked immediately before deletion
+    and KEPT."""
+    _fake_ckpt(tmp_path, "ckpt_live", "dlive", step=2)
+    _fake_ckpt(tmp_path, "ckpt_candidate", "dcand", step=1)
+    _fake_ckpt(tmp_path, "ckpt_dead", "ddead", step=0)
+    calls = []
+
+    def refresh():
+        # the fleet stages 'dcand' mid-GC: the re-poll must save it
+        calls.append(True)
+        return {"dlive", "dcand"}
+
+    report = gc_checkpoints(str(tmp_path), {"dlive"}, keep_latest=0,
+                            refresh=refresh)
+    assert calls, "refresh was never consulted before a deletion"
+    assert (tmp_path / "ckpt_candidate").exists()
+    kept = {k["dir"]: k["why"] for k in report["kept"]}
+    assert kept["ckpt_candidate"] == "referenced_at_delete"
+    assert {r["dir"] for r in report["retired"]} == {"ckpt_dead"}
+
+
+def test_gc_unreachable_refresh_fails_toward_keeping(tmp_path):
+    """The reference source going unreachable at the deletion edge
+    (refresh raises or returns None) must KEEP the candidate — deleting
+    against the stale pre-scraped set is exactly the blind GC the
+    initial scrape refuses."""
+    _fake_ckpt(tmp_path, "ckpt_live", "dlive", step=1)
+    _fake_ckpt(tmp_path, "ckpt_cand", "dcand", step=0)
+    report = gc_checkpoints(str(tmp_path), {"dlive"}, keep_latest=0,
+                            refresh=lambda: None)
+    assert report["retired"] == []
+    assert (tmp_path / "ckpt_cand").exists()
+    kept = {k["dir"]: k["why"] for k in report["kept"]}
+    assert kept["ckpt_cand"] == "reference_source_unreachable"
+
+    def boom():
+        raise OSError("fleet went away")
+
+    report = gc_checkpoints(str(tmp_path), {"dlive"}, keep_latest=0,
+                            refresh=boom)
+    assert report["retired"] == [] and (tmp_path / "ckpt_cand").exists()
+
+
+# -- reference gathering from /metrics snapshots ------------------------------
+
+def test_blind_spots_counts_unobservable_replicas():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from ckpt_gc import blind_spots
+    assert blind_spots({}) == 0
+    assert blind_spots({"info": {"replicas_unreachable": [],
+                                 "replicas_stale": []}}) == 0
+    # a partially-blind scrape (unreachable or stale replicas) must be
+    # visible to the refusal gate: those replicas' current/prev/staged
+    # digests are simply absent from the reference set
+    assert blind_spots({"info": {"replicas_unreachable": [1],
+                                 "replicas_stale": [2, 3]}}) == 3
+
+
+def test_referenced_digests_handles_router_and_service_shapes():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from ckpt_gc import referenced_digests
+    router_snap = {"info": {
+        "replica_digests": {"0": "h0", "1": None},
+        "per_replica": {
+            "0": {"serve_model_digest": {
+                "digest": "cur", "prev_digest": "prv",
+                "staged_digest": "stg"}},
+            "1": {},
+        },
+    }}
+    assert referenced_digests(router_snap) == {"h0", "cur", "prv",
+                                               "stg"}
+    service_snap = {"info": {"serve_model_digest": {
+        "digest": "a", "prev_digest": None, "staged_digest": "b"}}}
+    assert referenced_digests(service_snap) == {"a", "b"}
+    assert referenced_digests({}) == set()
+
+
+def _run_tool(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_gc.py"),
+         *args], capture_output=True, text=True, cwd=REPO)
+
+
+def test_ckpt_gc_cli_smoke(tmp_path):
+    _fake_ckpt(tmp_path, "ckpt_keep", "dk", step=1)
+    _fake_ckpt(tmp_path, "ckpt_drop", "dd", step=0)
+    r = _run_tool("--root", str(tmp_path), "--keep", "dk",
+                  "--keep_latest", "0")
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(r.stdout)
+    assert [x["dir"] for x in report["retired"]] == ["ckpt_drop"]
+    assert report["referenced"] == ["dk"]
+    assert not (tmp_path / "ckpt_drop").exists()
+    assert (tmp_path / "ckpt_keep").exists()
+
+
+def test_ckpt_gc_cli_refuses_to_gc_blind(tmp_path):
+    _fake_ckpt(tmp_path, "ckpt_a", "da")
+    r = _run_tool("--root", str(tmp_path))
+    assert r.returncode == 2
+    assert "no reference source" in r.stderr
+    assert (tmp_path / "ckpt_a").exists()
+
+
+def test_ckpt_gc_cli_refuses_unreachable_metrics(tmp_path):
+    _fake_ckpt(tmp_path, "ckpt_a", "da")
+    r = _run_tool("--root", str(tmp_path), "--metrics_url",
+                  "http://127.0.0.1:1/metrics", "--timeout_s", "0.2")
+    assert r.returncode == 2
+    assert "refusing to GC blind" in r.stderr
+    assert (tmp_path / "ckpt_a").exists()
+
+
+@pytest.mark.slow
+def test_gc_against_a_real_saved_checkpoint(tmp_path):
+    """End-to-end with real save_checkpoint artifacts: the manifest
+    digest GC reads IS the one the fleet handshake compares, so a
+    digest taken from a saved manifest protects that dir."""
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+
+    from dsin_tpu.coding.loader import load_model_state
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(tmp_path / "ae"), str(tmp_path / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    root = tmp_path / "ckpts"
+    root.mkdir()
+    digests = []
+    for seed in (1, 2):
+        _model, state = load_model_state(ae_p, pc_p, None, (16, 24),
+                                         need_sinet=False, seed=seed)
+        d = str(root / f"ckpt_s{seed}")
+        ckpt_lib.save_checkpoint(d, state)
+        digests.append(ckpt_lib.load_manifest(d)["params_digest"])
+    report = gc_checkpoints(str(root), {digests[0]}, keep_latest=0)
+    assert [r["digest"] for r in report["retired"]] == [digests[1]]
+    assert (root / "ckpt_s1").exists()
+    assert not (root / "ckpt_s2").exists()

@@ -396,15 +396,24 @@ def test_aggregated_metrics_flags_stale_replicas():
     server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     try:
+        from dsin_tpu.utils import locks as locks_lib
+
         class _Rep:
             idx = 0
             info = {"healthz_port": server.server_address[1],
                     "params_digest": "dd"}
+            lock = locks_lib.RankedLock("serve.replica")
+            inflight = {}
 
         class _StubRouter:
             metrics = metrics_lib.MetricsRegistry()
             _replicas = [_Rep()]
+            _lock = locks_lib.RankedLock("serve.frontdoor")
+            _state = {0: "live"}
             health_timeout_s = 2.0
+
+            def _all_replicas(self):
+                return list(self._replicas)
 
         agg = AggregatedMetrics(_StubRouter())
         first = agg.snapshot()

@@ -398,6 +398,35 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert dm["lock_order_inversions"] == 0
     assert dm["flight_recorder"]["dumps"] >= 1
     assert dm["flight_recorder"]["last_dump_events"] >= 1
+    # ISSUE 14: the elastic-fleet battery rides every chaos run — pin
+    # its scenario shape so a silent removal cannot pass
+    au = report["autoscale"]
+    assert au["violations"] == []
+    asc = au["scenarios"]
+    up = asc["scale_up_burst"]
+    assert up["scaled_to"] == 2 and up["scale_ups"] >= 1
+    assert up["hung_futures"] == 0 and up["untyped_errors"] == 0
+    assert up["completed_ok"] > 0
+    sick = asc["sick_model_fleet_rollback"]
+    assert sick["fired"] is True and sick["fleet_rollbacks"] >= 1
+    assert sick["canary_failing_seen"] >= 2   # the roll-up carried it
+    assert sick["digest_after"] == sick["digest_a"] != sick["digest_bad"]
+    assert set(sick["per_replica_digests"].values()) == \
+        {sick["digest_a"]}
+    assert sick["bit_identical_after"] is True
+    dn = asc["drain_down_idle"]
+    assert dn["drained_to"] == 1 and dn["scale_downs"] >= 1
+    assert dn["session_orphans"] >= 1
+    assert dn["orphaned_session_expired_typed"] is True
+    assert dn["survivor_session_ok"] is True
+    dd = asc["death_during_scale_up"]
+    assert dd["admitted"] is True
+    assert dd["hung_futures"] == 0 and dd["untyped_errors"] == 0
+    assert dd["post_admit_steady_compiles"] == 0
+    assert au["steady_compiles"] == 0
+    assert au["lock_order_inversions"] == 0
+    assert au["flight_recorder"]["dumps"] >= 1
+    assert au["flight_recorder"]["last_dump_events"] >= 1
     # ISSUE 11: every injected-fault battery must leave a non-empty
     # flight-recorder dump behind (the replayable incident timeline)
     fr = report["flight_recorder"]

@@ -709,6 +709,11 @@ class _ThreadReplicas:
         self.dead = {}
         self.threads = {}
         self.services = {}
+        self.warmups = {}
+        #: swap-prepare canary override (the autoscale battery's forced
+        #: sick-model commit needs the replica-side prepare probe OFF,
+        #: mirroring run_degraded's canary=False forced commit)
+        self.prepare_canary = True
 
     def launcher(self, config, idx, ctx):
         import threading
@@ -732,7 +737,7 @@ class _ThreadReplicas:
             # replica's (the stitched-trace scenario's transport)
             service = CompressionService(
                 _replace(self._make_config(), metrics_port=0)).start()
-            service.warmup()
+            self.warmups[idx] = service.warmup()
         except BaseException as e:  # noqa: BLE001 — router needs the cause
             conn.send(("failed", idx, _picklable_exc(e)))
             conn.close()
@@ -770,6 +775,23 @@ class _ThreadReplicas:
             op, rid, payload, priority, deadline_ms = msg[:5]
             trace = msg[5] if len(msg) > 5 else None
             try:
+                if op in ("swap_prepare", "swap_commit", "swap_abort",
+                          "rollback"):
+                    # hot-swap control ops (the autoscale battery's
+                    # fleet swap/rollback ride thread replicas too);
+                    # inline is fine at battery scale — the router's
+                    # phase timeouts bound a slow prepare
+                    if op == "swap_prepare":
+                        res = service.prepare_swap(
+                            payload, canary=self.prepare_canary)
+                    elif op == "swap_commit":
+                        res = service.commit_swap(expect_digest=payload)
+                    elif op == "swap_abort":
+                        res = service.abort_swap()
+                    else:
+                        res = service.rollback(expect_current=payload)
+                    outq.put(("ok", rid, res))
+                    continue
                 if op == "session_open":
                     outq.put(("ok", rid, service.open_session(payload)))
                     continue
@@ -1386,6 +1408,347 @@ def run_degraded(args) -> dict:
     }
 
 
+def run_autoscale(args) -> dict:
+    """The elastic-fleet battery (ISSUE 14): the signal-driven
+    autoscaler scales a REAL (thread-replica) fleet up under burst
+    load, the fleet-health driver rolls a canary-failing model back
+    fleet-wide via the two-phase conditional rollback, idleness drains
+    the fleet back down (orphaning pinned SI sessions typed through
+    the shared leave-rotation path), and a replica death during a
+    scale-up leaves zero hung futures. Budget-0 holds across the
+    swap/rollback/drain phases; a newly admitted replica compiles
+    nothing after its warm-before-admit warmup."""
+    import tempfile
+    import threading
+
+    from dsin_tpu.coding.loader import load_model_state
+    from dsin_tpu.serve import ServeError, ServiceConfig, SessionExpired
+    from dsin_tpu.serve.autoscale import (Autoscaler, AutoscaleConfig,
+                                          FleetHealthPolicy)
+    from dsin_tpu.serve.router import FrontDoorRouter
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.utils import locks
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled — the autoscale soak needs them"
+
+    # SI-capable ladder (edges divisible by the configs' y_patch_size),
+    # quality + background canary ON (the fleet-health driver's input),
+    # per-replica rollback watchdog OFF (default): THIS battery is
+    # about the FLEET-level rollback, which must act alone here
+    buckets = [(16, 24), (32, 48)]
+    flight_dir = tempfile.mkdtemp(prefix="chaos_autoscale_flight_")
+
+    def make_config():
+        return ServiceConfig(
+            ae_config=args.ae_config, pc_config=args.pc_config,
+            ckpt=args.ckpt, seed=args.seed, buckets=buckets,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue=args.max_queue, workers=args.workers,
+            entropy_workers=args.entropy_workers,
+            entropy_backend=args.entropy_backend,
+            pipeline_depth=args.pipeline_depth, enable_si=True,
+            session_max=8, canary_every_s=0.15,
+            quality_gap_sample_rate=1.0,
+            trace_sample_rate=1.0)
+
+    replicas = _ThreadReplicas(make_config)
+    router = FrontDoorRouter(
+        make_config(), replicas=1, launcher=replicas.launcher,
+        poll_every_s=0.2, flight_dir=flight_dir).start()
+    rng = np.random.default_rng(args.seed + 17)
+    img = rng.integers(0, 255, (buckets[0][0], buckets[0][1], 3),
+                       dtype=np.uint8)
+    violations = []
+    scenarios = {}
+    inversions_before = locks.inversion_count()
+    t0 = time.monotonic()
+    digest_a = router.params_digest
+    a_stream = router.encode(img, timeout=args.timeout_s).stream
+
+    # -- (1) burst load forces a scale-up (the REAL control loop) -----
+    scaler = Autoscaler(router, AutoscaleConfig(
+        min_replicas=1, max_replicas=2, check_every_s=0.05,
+        outstanding_high=4.0, outstanding_low=0.5, shed_high=1,
+        hysteresis_checks=2, idle_checks=1000,   # this phase never drains
+        up_cooldown_s=0.5, down_cooldown_s=3600.0)).start()
+    futures = []
+    deadline = time.monotonic() + args.timeout_s
+    while router.health()["live"] < 2 and time.monotonic() < deadline:
+        try:
+            futures.append(router.submit_encode(img))
+        except ServeError:
+            pass                     # admission sheds are typed load
+        time.sleep(args.submit_gap_s)
+    scaler.stop()
+    scaled_to = router.health()["live"]
+    counts, hung = _await_all(futures, args.timeout_s)
+    if scaled_to < 2:
+        violations.append("scale_up_burst: the autoscaler never "
+                          "scaled the fleet up under burst load")
+    if hung:
+        violations.append(f"scale_up_burst: {hung} hung futures")
+    if counts["untyped"]:
+        violations.append(f"scale_up_burst: {counts['untyped']} "
+                          f"untyped errors")
+    scenarios["scale_up_burst"] = {
+        "submitted": len(futures), "scaled_to": scaled_to,
+        "completed_ok": counts["ok"], "typed_errors": counts["typed"],
+        "untyped_errors": counts["untyped"], "hung_futures": hung,
+        "scale_ups": router.metrics.counter(
+            "serve_router_scale_ups").value,
+        "new_replica_warmup": replicas.warmups.get(1),
+    }
+
+    # -- (2) sick-model fleet rollback via the canary roll-up ---------
+    # publish flow mirrors run_degraded: record the GOOD candidate's
+    # goldens, then commit a bit-flipped twin that PROMISES them —
+    # replica-side prepare canary disabled (the forced commit), so the
+    # background prober is the only thing left to catch it, fleet-wide
+    model_b, state_b = load_model_state(
+        args.ae_config, args.pc_config, None, tuple(buckets[-1]),
+        need_sinet=True, seed=args.seed + 1)
+    tmpd = tempfile.mkdtemp(prefix="chaos_autoscale_")
+    extra = {"pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+             "seed": args.seed + 1,
+             "buckets": [list(b) for b in buckets]}
+    ckpt_b = os.path.join(tmpd, "ckpt_b")
+    ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra=extra)
+    publisher = replicas.services[0]
+    publisher.prepare_swap(ckpt_b, canary=False)
+    goldens = publisher.canary_goldens(staged=True)
+    publisher.abort_swap()
+    ckpt_bad = os.path.join(tmpd, "ckpt_bad")
+    ckpt_lib.save_checkpoint(
+        ckpt_bad, _bitflip_params(state_b),
+        manifest_extra={**extra, "canary": goldens})
+    with CompilationSentinel(budget=0, label="autoscale steady state",
+                             raise_on_exceed=False) as sentinel:
+        replicas.prepare_canary = False
+        swap_info = router.swap_model(ckpt_bad)
+        replicas.prepare_canary = True
+        digest_bad = swap_info["digest"]
+        # MEASURE the canary roll-up flowing before arming the driver:
+        # the scenario's evidence is that `replicas_canary_failing`
+        # actually carried the signal, not that a rollback happened by
+        # some other route
+        canary_seen = 0
+        deadline = time.monotonic() + args.timeout_s
+        while time.monotonic() < deadline:
+            q = router.aggregate.snapshot()["info"].get("quality", {})
+            canary_seen = max(canary_seen,
+                              len(q.get("replicas_canary_failing", [])))
+            if canary_seen >= 2:
+                break
+            time.sleep(0.1)
+        if canary_seen < 2:
+            violations.append(
+                f"sick_model_fleet_rollback: the canary roll-up never "
+                f"reported both replicas failing (saw {canary_seen})")
+        # the fleet-health driver: a fresh control loop whose scale
+        # policy is pinned shut (min == max == live) — only the
+        # unanimous-canary verdict can act here
+        health_scaler = Autoscaler(
+            router, AutoscaleConfig(min_replicas=2, max_replicas=2,
+                                    check_every_s=0.1),
+            health_policy=FleetHealthPolicy(hysteresis_checks=2,
+                                            cooldown_s=10.0)).start()
+        fired = False
+        deadline = time.monotonic() + args.timeout_s
+        while time.monotonic() < deadline:
+            if router.params_digest == digest_a:
+                fired = True
+                break
+            time.sleep(0.05)
+        health_scaler.stop()
+        fleet_rollbacks = router.metrics.counter(
+            "serve_autoscale_fleet_rollbacks").value
+        per_replica_digests = {i: s.model_digest
+                               for i, s in replicas.services.items()}
+        if not fired or fleet_rollbacks < 1:
+            violations.append(
+                f"sick_model_fleet_rollback: the canary roll-up did "
+                f"not drive a fleet rollback ({fleet_rollbacks} fleet "
+                f"rollbacks, router digest {router.params_digest})")
+        if any(d != digest_a for d in per_replica_digests.values()):
+            violations.append(
+                f"sick_model_fleet_rollback: fleet did not converge on "
+                f"the good model: {per_replica_digests}")
+        post = router.encode(img, timeout=args.timeout_s)
+        bit_identical = post.stream == a_stream
+        if not bit_identical:
+            violations.append("sick_model_fleet_rollback: good-model "
+                              "bit-identity lost after the rollback")
+        scenarios["sick_model_fleet_rollback"] = {
+            "digest_a": digest_a, "digest_bad": digest_bad,
+            "fired": fired, "fleet_rollbacks": fleet_rollbacks,
+            "canary_failing_seen": canary_seen,
+            "digest_after": router.params_digest,
+            "per_replica_digests": {str(k): v for k, v in
+                                    per_replica_digests.items()},
+            "bit_identical_after": bit_identical,
+        }
+
+        # -- (3) idle drains the fleet down; pinned sessions orphan
+        # typed through the shared leave-rotation path ----------------
+        sids = [router.open_session(img) for _ in range(2)]
+        with router._lock:
+            pin_of = {sid: router._sessions[sid] for sid in sids}
+        orphans_before = router.metrics.counter(
+            "serve_router_session_orphans").value
+        drain_scaler = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, max_replicas=2, check_every_s=0.05,
+            outstanding_high=1e9,            # this phase never scales up
+            outstanding_low=2.0, idle_checks=3,
+            up_cooldown_s=0.0, down_cooldown_s=0.0)).start()
+        deadline = time.monotonic() + args.timeout_s
+        while router.health()["live"] > 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        drain_scaler.stop()
+        drained_to = router.health()["live"]
+        states = router.health()["replicas"]
+        drained_idx = [int(i) for i, s in states.items()
+                       if s == "drained"]
+        if drained_to != 1 or not drained_idx:
+            violations.append(f"drain_down_idle: fleet did not drain "
+                              f"to 1 ({states})")
+        orphan_delta = router.metrics.counter(
+            "serve_router_session_orphans").value - orphans_before
+        orphaned_typed = survivor_ok = None
+        stream = router.encode(img, timeout=args.timeout_s).stream
+        for sid in sids:
+            if pin_of[sid] in drained_idx:
+                try:
+                    router.decode_si(stream, sid,
+                                     timeout=args.timeout_s)
+                    orphaned_typed = False
+                except SessionExpired:
+                    orphaned_typed = True
+                except Exception:   # noqa: BLE001 — wrong type = violation
+                    orphaned_typed = False
+            else:
+                try:
+                    router.decode_si(stream, sid,
+                                     timeout=args.timeout_s)
+                    survivor_ok = True
+                except Exception:   # noqa: BLE001 — survivor must serve
+                    survivor_ok = False
+        if orphaned_typed is False:
+            violations.append("drain_down_idle: the drained replica's "
+                              "pinned session did not expire TYPED")
+        if survivor_ok is False:
+            violations.append("drain_down_idle: the survivor's pinned "
+                              "session stopped serving")
+        if orphaned_typed is True and orphan_delta < 1:
+            violations.append("drain_down_idle: a session was orphaned "
+                              "without serve_router_session_orphans "
+                              "accounting")
+        scenarios["drain_down_idle"] = {
+            "drained_to": drained_to, "drained_replicas": drained_idx,
+            "scale_downs": router.metrics.counter(
+                "serve_router_scale_downs").value,
+            "session_orphans": orphan_delta,
+            "orphaned_session_expired_typed": orphaned_typed,
+            "survivor_session_ok": survivor_ok,
+        }
+    if sentinel.compilations:
+        violations.append(f"autoscale battery: {sentinel.compilations} "
+                          f"steady-state compiles across "
+                          f"swap/rollback/drain")
+
+    # -- (4) replica death DURING a scale-up --------------------------
+    # the one live replica dies while the newcomer is still warming:
+    # in-flight work fails typed (no survivor holds it), the admit
+    # still completes, and the admitted replica serves — compiling
+    # NOTHING after its own warmup
+    live_now = [int(i) for i, s in router.health()["replicas"].items()
+                if s == "live"]
+    live_idx = live_now[0]
+    futures = []
+    for _ in range(4):
+        try:
+            futures.append(router.submit_encode(img))
+        except ServeError:
+            pass
+    adder = {}
+    t = threading.Thread(target=lambda: adder.update(
+        info=router.add_replica()), name="chaos-scaleup")
+    t.start()
+    time.sleep(0.05)                  # the newcomer is building/warming
+    replicas.kill(live_idx)           # ... and the only live replica dies
+    t.join(args.timeout_s)
+    admitted = (not t.is_alive()) and "info" in adder
+    counts, hung = _await_all(futures, args.timeout_s)
+    if not admitted:
+        violations.append("death_during_scale_up: add_replica did not "
+                          "complete after the fleet died under it")
+    if hung:
+        violations.append(f"death_during_scale_up: {hung} hung futures")
+    if counts["untyped"]:
+        violations.append(f"death_during_scale_up: {counts['untyped']} "
+                          f"untyped errors")
+    post_admit_compiles = None
+    if admitted:
+        new_idx = adder["info"]["replica"]
+        with CompilationSentinel(budget=0, label="post-admit tail",
+                                 raise_on_exceed=False) as tail:
+            tail_res = [router.encode(img, timeout=args.timeout_s)
+                        for _ in range(3)]
+        post_admit_compiles = tail.compilations
+        if post_admit_compiles:
+            violations.append(
+                f"death_during_scale_up: {post_admit_compiles} "
+                f"steady-state compiles AFTER the admit — warm-before-"
+                f"admit did not hold")
+        if any(res.stream != a_stream for res in tail_res):
+            violations.append("death_during_scale_up: the admitted "
+                              "replica's streams are not bit-identical "
+                              "to the fleet's")
+    scenarios["death_during_scale_up"] = {
+        "admitted": admitted,
+        "new_replica": adder.get("info", {}).get("replica"),
+        "typed_errors": counts["typed"],
+        "untyped_errors": counts["untyped"], "hung_futures": hung,
+        "replica_deaths": router.metrics.counter(
+            "serve_router_replica_deaths").value,
+        "post_admit_steady_compiles": post_admit_compiles,
+    }
+
+    router.flight.flush(timeout=10.0)
+    flight_meta = router.flight.meta()
+    last_events = 0
+    if flight_meta["last_dump_path"]:
+        with open(flight_meta["last_dump_path"]) as f:
+            last_events = sum(1 for _ in f) - 1
+    if flight_meta["dumps"] < 1 or last_events < 1:
+        violations.append(
+            f"autoscale battery left no non-empty flight dump "
+            f"({flight_meta['dumps']} dumps, last had {last_events} "
+            f"events)")
+    counters = router.metrics.snapshot()["counters"]
+    router.drain(timeout_s=60)
+    autoscale_inversions = locks.inversion_count() - inversions_before
+    if autoscale_inversions:
+        violations.append(f"{autoscale_inversions} lock-order "
+                          f"inversions during the autoscale battery")
+    return {
+        "scenarios": scenarios,
+        "autoscale_counters": {
+            k: v for k, v in counters.items()
+            if "autoscale" in k or "scale" in k or "rollback" in k},
+        "flight_recorder": {"dumps": flight_meta["dumps"],
+                            "last_dump_events": last_events,
+                            "last_dump_path":
+                                flight_meta["last_dump_path"]},
+        "steady_compiles": sentinel.compilations,
+        "lock_order_inversions": autoscale_inversions,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="seeded chaos soak for dsin_tpu/serve")
@@ -1449,6 +1812,13 @@ def main(argv=None) -> int:
                         "force-committed corrupted model rolled back by "
                         "the canary-armed watchdog) — rides the "
                         "fail-fast quality-smoke tpu_session.sh stage")
+    p.add_argument("--autoscale_only", action="store_true",
+                   help="run ONLY the elastic-fleet battery (burst "
+                        "load forces a scale-up, idle drains back "
+                        "down, replica death during scale-up, "
+                        "sick-model fleet rollback via the canary "
+                        "roll-up) — rides the fail-fast "
+                        "autoscale-bench tpu_session.sh stage")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -1477,13 +1847,18 @@ def main(argv=None) -> int:
         report = {"config": {"smoke": args.smoke, "seed": args.seed},
                   "degraded_model": run_degraded(args),
                   "violations": []}
+    elif args.autoscale_only:
+        report = {"config": {"smoke": args.smoke, "seed": args.seed},
+                  "autoscale": run_autoscale(args),
+                  "violations": []}
     else:
         report = run_chaos(args)
         report["hotswap"] = run_hotswap(args)
         report["sessions"] = run_sessions(args)
         report["degraded_model"] = run_degraded(args)
+        report["autoscale"] = run_autoscale(args)
     # every battery's violations gate the exit code like the soak's own
-    for extra in ("hotswap", "sessions", "degraded_model"):
+    for extra in ("hotswap", "sessions", "degraded_model", "autoscale"):
         if extra in report:
             report["violations"] = (report["violations"]
                                     + report[extra]["violations"])
@@ -1507,6 +1882,11 @@ def main(argv=None) -> int:
             k: report["degraded_model"][k]
             for k in ("scenarios", "canary_counters", "steady_compiles",
                       "violations")}
+    if "autoscale" in report:
+        summary["autoscale"] = {
+            k: report["autoscale"][k]
+            for k in ("scenarios", "autoscale_counters",
+                      "steady_compiles", "violations")}
     summary["violations"] = report["violations"]
     print(json.dumps(summary, indent=1))
     if report["violations"]:

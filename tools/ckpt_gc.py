@@ -1,0 +1,169 @@
+"""Manifest-driven checkpoint GC driver (ISSUE 14).
+
+A long-lived fleet accretes checkpoint directories: every retrain
+publishes one, every hot swap leaves the displaced version's dir
+behind as rollback insurance, replication mirrors them across hosts.
+Nothing ever deleted them, because nothing could answer "is any fleet
+member still referencing this digest?" — until the aggregated /metrics
+started carrying every replica's live/staged/prev digests (PR 9's
+`serve_model_digest` info entry, fleet-merged in `per_replica`).
+
+This tool closes that loop:
+
+    python tools/ckpt_gc.py --root /ckpts \\
+        --metrics_url http://127.0.0.1:9090/metrics?format=json
+    python tools/ckpt_gc.py --root /ckpts --keep aaaa --keep bbbb
+    python tools/ckpt_gc.py --root /ckpts ... --dry_run
+
+It gathers the referenced digest set (every replica's CURRENT, STAGED,
+and PREV bundle — prev counts: rollback re-instates it from memory,
+but a restarted replica can only re-load it from disk), then calls
+`train/checkpoint.py gc_checkpoints`, which deletes ONLY directories
+whose manifest `params_digest` is unreferenced — and, when a metrics
+URL is given, RE-POLLS it immediately before each deletion (the
+kill-window re-check: a digest the fleet stages mid-GC survives).
+Unmanifested dirs are never touched. Exit 0 on success (retired or
+not), 2 on bad arguments / unreachable metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import Set
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def referenced_digests(snapshot: dict) -> Set[str]:
+    """Every checkpoint digest ANY fleet member references, from one
+    /metrics?format=json snapshot — fleet-aggregated (router) and
+    single-service shapes both supported:
+
+    * router aggregate: `info.replica_digests` (the handshake view,
+      present even for unreachable replicas) plus each
+      `info.per_replica[i].serve_model_digest`'s current/prev/staged;
+    * single service: `info.serve_model_digest` current/prev/staged.
+    """
+    out: Set[str] = set()
+    info = snapshot.get("info", {})
+
+    def _from_model(model: dict) -> None:
+        for key in ("digest", "prev_digest", "staged_digest"):
+            d = model.get(key)
+            if d:
+                out.add(d)
+
+    _from_model(info.get("serve_model_digest") or {})
+    for d in (info.get("replica_digests") or {}).values():
+        if d:
+            out.add(d)
+    for rep_info in (info.get("per_replica") or {}).values():
+        _from_model(rep_info.get("serve_model_digest") or {})
+    return out
+
+
+def blind_spots(snapshot: dict) -> int:
+    """Replicas whose digests this snapshot could NOT see: unreachable
+    or stale scrapes contribute only their startup handshake digest —
+    their current/prev/staged slots are missing, so GC over such a
+    snapshot could delete a checkpoint a live replica is serving."""
+    info = snapshot.get("info", {})
+    return (len(info.get("replicas_unreachable") or [])
+            + len(info.get("replicas_stale") or []))
+
+
+def _scrape(url: str, timeout_s: float) -> dict:
+    if "format=json" not in url:
+        url += ("&" if "?" in url else "?") + "format=json"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="retire checkpoint dirs no fleet member references")
+    p.add_argument("--root", required=True,
+                   help="directory whose checkpoint subdirs are "
+                        "GC candidates")
+    p.add_argument("--metrics_url", default=None,
+                   help="a router's aggregated /metrics (or a single "
+                        "service's) — scraped for the referenced digest "
+                        "set, and RE-scraped before each deletion")
+    p.add_argument("--keep", action="append", default=[],
+                   help="digest to keep regardless (repeatable); with "
+                        "no --metrics_url this is the whole reference "
+                        "set")
+    p.add_argument("--keep_latest", type=int, default=1,
+                   help="newest N complete checkpoints survive "
+                        "regardless of references")
+    p.add_argument("--timeout_s", type=float, default=5.0)
+    p.add_argument("--dry_run", action="store_true",
+                   help="report what would be retired, delete nothing")
+    p.add_argument("--force", action="store_true",
+                   help="GC even when some replicas' digests were "
+                        "unobservable (unreachable/stale scrapes) — "
+                        "refused by default: a partially-blind "
+                        "reference set can delete a checkpoint a "
+                        "hidden replica is serving")
+    args = p.parse_args(argv)
+
+    from dsin_tpu.train.checkpoint import gc_checkpoints
+
+    referenced = set(args.keep)
+    refresh = None
+    if args.metrics_url:
+        try:
+            snap = _scrape(args.metrics_url, args.timeout_s)
+        except Exception as e:  # noqa: BLE001 — refusal, not a crash
+            print(f"CKPT_GC_FAILED: cannot scrape {args.metrics_url}: "
+                  f"{type(e).__name__}: {e} — refusing to GC blind "
+                  f"(pass --keep digests to GC without a fleet)",
+                  file=sys.stderr)
+            return 2
+        hidden = blind_spots(snap)
+        if hidden and not args.force:
+            # a scrape that ANSWERED can still be partially blind: an
+            # unreachable/stale replica's current/prev/staged digests
+            # are simply absent from the reference set
+            print(f"CKPT_GC_FAILED: {hidden} replica(s) were "
+                  f"unreachable/stale in the scrape — their serving "
+                  f"digests are invisible, so this GC could delete a "
+                  f"checkpoint they depend on; retry when the fleet "
+                  f"answers, or pass --force", file=sys.stderr)
+            return 2
+        referenced |= referenced_digests(snap)
+
+        def refresh():
+            # at the deletion edge an unreachable or partially-blind
+            # fleet returns None: gc_checkpoints then KEEPS the
+            # candidate (deleting against the stale pre-scraped set
+            # would be exactly the blind GC the initial scrape refuses)
+            try:
+                fresh = _scrape(args.metrics_url, args.timeout_s)
+            except Exception:   # noqa: BLE001 — keep, never crash
+                return None
+            if blind_spots(fresh) and not args.force:
+                return None
+            return referenced_digests(fresh)
+    elif not referenced:
+        print("CKPT_GC_FAILED: no reference source — pass "
+              "--metrics_url and/or --keep (an empty reference set "
+              "would retire every unprotected checkpoint)",
+              file=sys.stderr)
+        return 2
+
+    report = gc_checkpoints(args.root, referenced,
+                            keep_latest=args.keep_latest,
+                            dry_run=args.dry_run, refresh=refresh)
+    report["referenced"] = sorted(referenced)
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1520,6 +1520,189 @@ def _gate_frontdoor(section, scaling_floor: float = 1.3) -> list:
     return violations
 
 
+def _run_autoscale_section(args) -> dict:
+    """Elastic-fleet axis (ISSUE 14): scale 1 -> N -> 1 under open-loop
+    load through REAL spawn replicas — runtime `add_replica`
+    (warm-before-admit: the newcomer joins the rotation only after its
+    own census warm and digest handshake) and graceful `drain_replica`
+    under traffic, with PER-REPLICA compile accounting: each replica's
+    `serve_xla_compiles` at the end of its serving life must equal its
+    `compiles_at_ready` handshake value — zero steady-state compiles
+    across every admit and drain — and the fleet must emit
+    bit-identical streams at every size. The add/drain calls are driven
+    directly (a deterministic bench); the POLICY loop that issues them
+    in production is unit-tested (tests/test_serve_autoscale.py) and
+    chaos-gated (chaos_bench --autoscale_only)."""
+    import urllib.request
+
+    from dsin_tpu.serve import ServeError
+    from dsin_tpu.serve.router import FrontDoorRouter
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    classes = _frontdoor_classes(args, args.max_queue)
+    cfg = _service_config(args, args.entropy_workers, classes=classes)
+    shapes = _parse_shapes(args.shapes)
+    rng = np.random.default_rng(args.seed + 5)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    probes = images[:2]
+    top = max(2, int(args.replicas))
+    chunk = max(8, args.frontdoor_requests // 3)
+    period = 1.0 / args.frontdoor_rate
+
+    def _gauge(rep_info):
+        port = (rep_info or {}).get("healthz_port")
+        if port is None:
+            return None
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=json",
+                    timeout=5.0) as resp:
+                snap = json.loads(resp.read().decode("utf-8"))
+            return snap.get("gauges", {}).get("serve_xla_compiles")
+        except Exception:   # noqa: BLE001 — reported as missing
+            return None
+
+    futures = []
+    load = {"shed": 0}
+
+    def _chunk(router):
+        t0 = time.monotonic()
+        for i in range(chunk):
+            _pace(i, t0, period)
+            try:
+                futures.append(router.submit_encode(
+                    images[i % len(images)]))
+            except ServeError:
+                load["shed"] += 1
+
+    probe_streams = {}
+
+    def _probe(router, tag, fleet_n):
+        probe_streams[tag] = [
+            [router.encode(im, timeout=180.0).stream for im in probes]
+            for _ in range(fleet_n)]
+
+    out = {"top_replicas": top, "admits": [], "drains": [],
+           "per_replica_steady_compiles": {}, "bit_identical": None}
+    # the router PROCESS does no jax — a sentinel pins that the scale
+    # machinery itself never compiles here; replica-side budget-0 is
+    # the per-replica accounting below
+    with CompilationSentinel(budget=0, label="autoscale router process",
+                             raise_on_exceed=False) as sentinel:
+        router = FrontDoorRouter(cfg, replicas=1).start()
+        try:
+            _probe(router, "start_1", 1)
+            _chunk(router)
+            for _n in range(2, top + 1):
+                t_admit = time.monotonic()
+                info = router.add_replica()
+                out["admits"].append({
+                    "replica": info["replica"],
+                    "admit_s": round(time.monotonic() - t_admit, 3),
+                    "warmup_compiles": info.get("warmup_compiles"),
+                    "warmup_cache_hits": info.get("warmup_cache_hits"),
+                    "compiles_at_ready": info.get("compiles_at_ready"),
+                })
+            _probe(router, "top", top)
+            _chunk(router)
+            steady = out["per_replica_steady_compiles"]
+
+            def _account(idx):
+                rep_info = router._replicas[idx].info or {}
+                g = _gauge(rep_info)
+                car = rep_info.get("compiles_at_ready")
+                steady[str(idx)] = (None if g is None or car is None
+                                    else int(g) - int(car))
+
+            while router.health()["live"] > 1:
+                live = [int(i) for i, s in
+                        router.health()["replicas"].items()
+                        if s == "live"]
+                # scrape BEFORE the drain: a drained replica's
+                # endpoint dies with it
+                for i in live:
+                    _account(i)
+                dr = router.drain_replica()
+                out["drains"].append(dr)
+            _probe(router, "end_1", 1)
+            _chunk(router)
+            for i, s in router.health()["replicas"].items():
+                if s == "live":
+                    _account(int(i))
+            completed = failed = rejected_inflight = 0
+            for f in futures:
+                try:
+                    exc = f.exception(timeout=180.0)
+                except TimeoutError:
+                    failed += 1
+                    continue
+                if exc is None:
+                    completed += 1
+                elif isinstance(exc, ServeError):
+                    rejected_inflight += 1
+                else:
+                    failed += 1
+            snap = router.metrics.snapshot()["counters"]
+        finally:
+            router.drain(timeout_s=60)
+    ref = probe_streams["start_1"][0]
+    out["bit_identical"] = all(row == ref for fleet in
+                               probe_streams.values() for row in fleet)
+    out.update({
+        "submitted": len(futures), "completed": completed,
+        "failed": failed, "shed_at_door": load["shed"],
+        "rejected_inflight": rejected_inflight,
+        "scale_ups": snap.get("serve_router_scale_ups", 0),
+        "scale_downs": snap.get("serve_router_scale_downs", 0),
+        "replica_deaths": snap.get("serve_router_replica_deaths", 0),
+        "router_process_compiles": sentinel.compilations,
+    })
+    return out
+
+
+def _gate_autoscale(section) -> list:
+    """--smoke violations for the elastic-fleet leg: the fleet must
+    actually have scaled 1 -> N -> 1, every admitted/drained replica's
+    steady-state compile count must be ZERO (warm-before-admit), the
+    fleet must stay bit-identical at every size, and nothing may hang
+    or fail untyped."""
+    violations = []
+    if section["scale_ups"] != section["top_replicas"] - 1:
+        violations.append(
+            f"autoscale: expected {section['top_replicas'] - 1} "
+            f"scale-ups, saw {section['scale_ups']}")
+    if section["scale_downs"] != section["top_replicas"] - 1:
+        violations.append(
+            f"autoscale: expected {section['top_replicas'] - 1} "
+            f"scale-downs, saw {section['scale_downs']}")
+    if section["failed"]:
+        violations.append(f"autoscale: {section['failed']} untyped/"
+                          f"hung requests across the scale cycle")
+    if section["completed"] == 0:
+        violations.append("autoscale: no request completed")
+    if section["replica_deaths"]:
+        violations.append(f"autoscale: {section['replica_deaths']} "
+                          f"replica deaths during a graceful cycle")
+    if section["bit_identical"] is not True:
+        violations.append("autoscale: fleet streams diverged across "
+                          "scale-up/drain (bit-identity lost)")
+    for idx, n in section["per_replica_steady_compiles"].items():
+        if n is None:
+            violations.append(
+                f"autoscale: replica {idx} left no compile evidence "
+                f"(metrics scrape failed or it served no batch)")
+        elif n > 0:
+            violations.append(
+                f"autoscale: replica {idx} compiled {n} time(s) in "
+                f"steady state — warm-before-admit did not hold")
+    if section["router_process_compiles"]:
+        violations.append(
+            f"autoscale: the router process itself compiled "
+            f"{section['router_process_compiles']} time(s)")
+    return violations
+
+
 def run_bench(args) -> dict:
     """Serialized-vs-pipelined comparison with an interleaved-repeats
     methodology: both services are built and warmed once, then the same
@@ -1732,6 +1915,15 @@ def main(argv=None) -> int:
     p.add_argument("--quality_repeats", type=int, default=3,
                    help="alternating telemetry-on/off pass pairs; the "
                         "reported overhead is 1 - median pair ratio")
+    p.add_argument("--autoscale", dest="autoscale_only",
+                   action="store_true",
+                   help="run ONLY the elastic-fleet leg (ISSUE 14): "
+                        "scale 1 -> N -> 1 spawn replicas under "
+                        "open-loop load via runtime "
+                        "add_replica/drain_replica, gating zero "
+                        "steady-state compiles across every admit and "
+                        "drain plus fleet bit-identity — the fail-fast "
+                        "autoscale-bench tpu_session.sh stage")
     p.add_argument("--quality", dest="quality_only", action="store_true",
                    help="run ONLY the model-health leg (gap/bpp/SI-"
                         "score coverage + canary green + paired "
@@ -1778,7 +1970,7 @@ def main(argv=None) -> int:
 
     only_flags = [f for f in ("devices_only", "backends_only",
                               "frontdoor_only", "si_only", "trace_only",
-                              "quality_only")
+                              "quality_only", "autoscale_only")
                   if getattr(args, f)]
     if len(only_flags) > 1:
         print(f"SERVE_BENCH_FAILED: {only_flags} are mutually "
@@ -1791,7 +1983,8 @@ def main(argv=None) -> int:
         # never force host devices
         args.devices = ("" if (args.backends_only or args.frontdoor_only
                                or args.si_only or args.trace_only
-                               or args.quality_only)
+                               or args.quality_only
+                               or args.autoscale_only)
                         else "1 2" if args.smoke else "1 2 4 8")
     axis = [int(v) for v in args.devices.split()]
     if any(n < 1 for n in axis):
@@ -1909,6 +2102,22 @@ def main(argv=None) -> int:
             },
             "quality": _run_quality_section(args),
         }
+    elif args.autoscale_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "frontdoor_rate_rps": args.frontdoor_rate,
+                "frontdoor_requests": args.frontdoor_requests,
+                "replicas": args.replicas,
+                "smoke": args.smoke,
+            },
+            "autoscale": _run_autoscale_section(args),
+        }
     else:
         report = run_bench(args)
         report["config"]["entropy_backend"] = args.entropy_backend
@@ -1927,6 +2136,10 @@ def main(argv=None) -> int:
         if not args.smoke:
             report["config"]["replicas"] = args.replicas
             report["frontdoor"]["replicas"] = _run_frontdoor_replicas(args)
+            # elastic fleet (ISSUE 14): spawns full replica processes
+            # like the replica axis, so it rides only the full
+            # (artifact) run and the dedicated --autoscale stage
+            report["autoscale"] = _run_autoscale_section(args)
         # session-cached SI serving (ISSUE 10): rides every run — the
         # smoke gate holds the warm-vs-per-request-prep speedup floor
         # (host-weather escape) and zero compiles under session churn
@@ -1948,7 +2161,8 @@ def main(argv=None) -> int:
     os.replace(tmp, args.out)   # temp+rename: never truncate the artifact
     summary_keys = ("load", "latency_ms", "batch_occupancy",
                     "steady_compiles", "pipeline", "entropy_backends",
-                    "devices", "frontdoor", "si", "trace", "quality")
+                    "devices", "frontdoor", "si", "trace", "quality",
+                    "autoscale")
     print(json.dumps({k: report[k] for k in summary_keys if k in report},
                      indent=1))
     if args.smoke and args.devices_only:
@@ -1983,6 +2197,12 @@ def main(argv=None) -> int:
         return 0
     if args.smoke and args.quality_only:
         violations = _gate_quality(report["quality"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
+    if args.smoke and args.autoscale_only:
+        violations = _gate_autoscale(report["autoscale"])
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1
@@ -2044,6 +2264,8 @@ def main(argv=None) -> int:
             violations.extend(_gate_trace(report["trace"]))
         if "quality" in report:
             violations.extend(_gate_quality(report["quality"]))
+        if "autoscale" in report:
+            violations.extend(_gate_autoscale(report["autoscale"]))
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

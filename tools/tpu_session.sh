@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -197,6 +197,36 @@ quality-smoke)
     exit 1
   fi
   ;;
+autoscale-bench)
+  # fail fast (ISSUE 14): the elastic-fleet leg — serve_bench scales
+  # 1 -> N -> 1 REAL spawn replicas under open-loop load via runtime
+  # add_replica/drain_replica and must show zero steady-state compiles
+  # across every admit and drain (per-replica compile accounting
+  # against the compiles_at_ready handshake), fleet bit-identity at
+  # every size, and zero untyped/hung requests; chaos_bench's
+  # autoscale battery then soaks the CONTROL LOOP itself — burst load
+  # forces a scale-up, idleness drains back down (pinned SI sessions
+  # orphan typed through the shared leave-rotation path), a replica
+  # dies during a scale-up, and a canary-failing model is rolled back
+  # fleet-wide by the conditional two-phase rollback. Both exit 1 on
+  # violation; seconds on CPU.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --autoscale \
+    --devices "" --out artifacts/autoscale_bench.json \
+    > artifacts/autoscale_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/autoscale_bench.log
+    echo "TPU_SESSION_FAILED: autoscale-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke --autoscale_only \
+    --out artifacts/autoscale_chaos.json \
+    > artifacts/autoscale_chaos.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/autoscale_chaos.log
+    echo "TPU_SESSION_FAILED: autoscale-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -268,7 +298,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
