@@ -826,6 +826,7 @@ class FrontDoorRouter:
         with rep.lock:
             rep.inflight[rid] = pending
             try:
+                # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- conn.send under serve.replica(6): the child recv-loop drains unconditionally and admission bounds in-flight frames, so the pipe buffer cannot back up; a dead child raises BrokenPipeError instead of blocking
                 rep.conn.send((op, rid, pending.payload, pending.priority,
                                pending.remaining_ms(), pending.trace))
                 return True
@@ -992,6 +993,7 @@ class FrontDoorRouter:
                     # replacement replica must not restart the clock
                     # (the trace context rides every (re)dispatch, so
                     # a rerouted request keeps one stitched timeline)
+                    # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- conn.send under serve.replica(6): child recv-loop drains unconditionally, admission bounds in-flight; dead child -> BrokenPipeError, not a stuck write
                     rep.conn.send((pending.op, rid, pending.payload,
                                    pending.priority,
                                    pending.remaining_ms(),
@@ -1282,6 +1284,7 @@ class FrontDoorRouter:
                     self._state[victim.idx] = "stopping"
             with victim.lock:
                 try:
+                    # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- tiny one-tuple stop frame under serve.replica(6); the drained child is idle and recv-blocked, and a dead one raises instead of blocking
                     victim.conn.send(("stop", None, None, None, None))
                 except (OSError, ValueError, BrokenPipeError):
                     pass
@@ -1347,6 +1350,7 @@ class FrontDoorRouter:
         with rep.lock:
             rep.inflight[rid] = pending
             try:
+                # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- control-op send under serve.replica(6): one small tuple, child recv-loop always draining; pipe death surfaces as BrokenPipeError below
                 rep.conn.send((op, rid, payload, None, None))
                 sent = True
             except (OSError, ValueError, BrokenPipeError):
@@ -1684,6 +1688,7 @@ class FrontDoorRouter:
         for rep in replicas:
             with rep.lock:
                 try:
+                    # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- shutdown stop frame under serve.replica(6): tiny tuple, and the subsequent reader.join(timeout) bounds how long a wedged child can be waited on
                     rep.conn.send(("stop", None, None, None, None))
                 except (OSError, ValueError, BrokenPipeError):
                     pass

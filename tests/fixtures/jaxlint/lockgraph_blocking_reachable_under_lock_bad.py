@@ -1,0 +1,44 @@
+"""Bad: blocking calls reachable through the call graph while a ranked
+lock is held — invisible to the per-file blocking-call-under-lock rule
+because the block and the lock live in different functions."""
+
+HIERARCHY = {"pool.work": 20}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def slow_fetch(conn):
+    return conn.recv()          # pipe read: blocks until the peer writes
+
+
+def relay(conn):
+    return slow_fetch(conn)
+
+
+class Worker:
+    def __init__(self):
+        self._lock = RankedLock("pool.work")
+
+    def step(self, conn):
+        with self._lock:
+            return relay(conn)   # conn.recv two hops down
+
+    def _wait(self, fut):
+        return fut.result()      # future wait
+
+    def harvest(self, fut):
+        with self._lock:
+            return self._wait(fut)
+
+    def push(self, conn, item):
+        with self._lock:
+            conn.send(item)      # lexical pipe write under the lock

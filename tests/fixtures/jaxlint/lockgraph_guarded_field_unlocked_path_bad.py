@@ -1,0 +1,42 @@
+"""Bad: `# guarded-by:` fields touched inside *_locked helpers that are
+reachable from callers without the guard — the _locked suffix is a
+caller-holds-the-lock contract, and these callers break it."""
+
+HIERARCHY = {"pool.state": 20}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Store:
+    def __init__(self):
+        self._lock = RankedLock("pool.state")
+        self._items = {}     # guarded-by: _lock
+        self._high_water = 0  # guarded-by: _lock
+
+    def _bump_locked(self, key):
+        self._items[key] = self._items.get(key, 0) + 1
+
+    def _rollup_locked(self):
+        self._high_water = max(self._high_water, len(self._items))
+
+    def _maintain_locked(self):
+        self._rollup_locked()
+
+    def bump_fast(self, key):
+        return self._bump_locked(key)   # guard not held
+
+    def sweep(self):
+        return self._maintain_locked()  # two hops, still unguarded
+
+    def bump(self, key):
+        with self._lock:
+            return self._bump_locked(key)   # contract honored

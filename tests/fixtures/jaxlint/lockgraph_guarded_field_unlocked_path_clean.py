@@ -1,0 +1,33 @@
+"""Clean: every caller of a *_locked helper holds the guard, plus one
+justified suppression for a pre-publication call."""
+
+HIERARCHY = {"pool.state": 20}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Store:
+    def __init__(self):
+        self._lock = RankedLock("pool.state")
+        self._items = {}  # guarded-by: _lock
+
+    def _bump_locked(self, key):
+        self._items[key] = self._items.get(key, 0) + 1
+
+    def bump(self, key):
+        with self._lock:
+            return self._bump_locked(key)
+
+    def bootstrap(self, key):
+        # jaxlint: disable=lockgraph-guarded-field-unlocked-path -- constructor-phase seeding: store not yet published to any thread
+        # so _items cannot be raced before the first publication
+        return self._bump_locked(key)

@@ -1,0 +1,40 @@
+"""Bad: rank inversions — one local nesting, one through the call
+graph. Self-contained: the module carries its own HIERARCHY so the
+lockgraph pass analyzes it without the repo's locks.py."""
+
+HIERARCHY = {"pool.low": 10, "pool.high": 20}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Inner:
+    def __init__(self):
+        self._lock = RankedLock("pool.low")
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+
+class Outer:
+    def __init__(self):
+        self._lock = RankedLock("pool.high")
+        self._inner = Inner()
+
+    def direct_bad(self):
+        with self._lock:
+            with self._inner._lock:   # rank 10 under rank 20: inversion
+                return 0
+
+    def tick(self):
+        with self._lock:
+            return self._inner.poke()  # call path re-acquires rank 10

@@ -1,0 +1,42 @@
+"""Clean: the same shape acquired in rank order, plus one justified
+suppression — the suppressed-clean half of the golden pair."""
+
+HIERARCHY = {"pool.low": 10, "pool.high": 20}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Inner:
+    def __init__(self):
+        self._lock = RankedLock("pool.high")
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+
+class Outer:
+    def __init__(self):
+        self._lock = RankedLock("pool.low")
+        self._inner = Inner()
+
+    def tick(self):
+        with self._lock:
+            return self._inner.poke()  # 10 then 20: strictly increasing
+
+    def teardown(self):
+        with self._inner._lock:
+            # jaxlint: disable=lockgraph-rank-inversion -- shutdown path:
+            # pool.low(10) under pool.high(20) runs single-threaded after
+            # every worker has joined, so no second thread can cross-order
+            with self._lock:
+                return 0

@@ -1,0 +1,18 @@
+"""Bad: ranked-lock constructions the static hierarchy cannot resolve —
+a non-literal name, a name missing from HIERARCHY, and an ad-hoc rank=
+outside tests."""
+
+HIERARCHY = {"pool.known": 10}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+
+def make(name):
+    return RankedLock(name)              # non-literal name
+
+
+MYSTERY = RankedLock("pool.unknown")     # not in HIERARCHY
+ADHOC = RankedLock("pool.known", rank=7)  # ad-hoc rank outside tests

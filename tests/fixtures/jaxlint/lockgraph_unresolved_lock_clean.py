@@ -1,0 +1,16 @@
+"""Clean: literal names from HIERARCHY, plus one justified suppression
+for a deliberately out-of-band scratch lock."""
+
+HIERARCHY = {"pool.known": 10}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+
+GOOD = RankedLock("pool.known")
+
+# jaxlint: disable=lockgraph-unresolved-lock -- bench-only scratch lock
+# with a sentinel rank; it is never co-held with hierarchy locks
+SCRATCH = RankedLock("pool.scratch", rank=99)

@@ -20,10 +20,11 @@ RULE_NAMES = sorted(RULES_BY_NAME)
 
 
 def _lint_fixture(name):
+    # lint_paths (not lint_source) so the whole-repo lockgraph pass
+    # runs too — its fixtures are self-contained single files carrying
+    # their own HIERARCHY literal
     path = os.path.join(FIXDIR, name)
-    with open(path) as f:
-        source = f.read()
-    active, suppressed = lint_source(source, path)
+    active, suppressed, _ = lint_paths([path])
     return active, suppressed
 
 
@@ -146,7 +147,7 @@ def test_lint_paths_walks_fixture_dir(tmp_path):
     (tmp_path / "__pycache__").mkdir()
     (tmp_path / "__pycache__" / "junk.py").write_text("import nope(")
     findings, suppressed, files = lint_paths([str(tmp_path)])
-    assert files == 1 and suppressed == 0
+    assert files == 1 and not suppressed
     assert [f.rule for f in findings] == ["host-call-in-jit"]
 
 
@@ -170,6 +171,45 @@ def test_cli_json_format(tmp_path, capsys):
     payload = json.loads(capsys.readouterr().out)
     assert payload["findings"][0]["rule"] == "host-call-in-jit"
     assert payload["files"] == 1
+
+
+def test_cli_json_findings_schema_is_stable(tmp_path, capsys):
+    """The machine-readable contract CI consumes: every finding is
+    exactly {rule, path, line, message, suppressed}; suppressed
+    findings are present with the flag set but do not drive exit 1."""
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SNIPPET.format(""))
+    ok = tmp_path / "ok.py"
+    ok.write_text(BAD_SNIPPET.format(
+        "  # jaxlint: disable=host-call-in-jit -- exercised by tests"))
+    assert run([str(bad), str(ok), "--format", "json"]) == EXIT_FINDINGS
+    payload = json.loads(capsys.readouterr().out)
+    assert all(sorted(row) == ["line", "message", "path", "rule",
+                               "suppressed"]
+               for row in payload["findings"])
+    flags = [(row["path"], row["suppressed"])
+             for row in payload["findings"]]
+    assert (str(bad), False) in flags
+    assert (str(ok), True) in flags
+    assert payload["suppressed"] == 1
+    # a fully-suppressed tree is exit 0 even though JSON lists the row
+    assert run([str(ok), "--format", "json"]) == EXIT_CLEAN
+
+
+def test_list_suppressions_json_schema(tmp_path, capsys):
+    import json
+    ok = tmp_path / "ok.py"
+    ok.write_text(BAD_SNIPPET.format(
+        "  # jaxlint: disable=host-call-in-jit -- exercised by tests"))
+    assert run(["--list-suppressions", "--format", "json",
+                str(ok)]) == EXIT_CLEAN
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["stale"] == 0
+    (row,) = payload["suppressions"]
+    assert sorted(row) == ["line", "path", "reason", "rules", "stale"]
+    assert row["rules"] == ["host-call-in-jit"]
+    assert row["reason"] == "exercised by tests"
 
 
 def test_list_rules_names_all_rules(capsys):
@@ -227,6 +267,108 @@ def test_concurrency_flag_runs_only_the_family(tmp_path):
     assert run(["--concurrency", "--select", "raw-lock-construction",
                 str(mixed)], out=buf2) == EXIT_FINDINGS
     assert "raw-lock-construction" in buf2.getvalue()
+
+
+# -- lockgraph (whole-repo interprocedural family) ----------------------------
+
+def test_expected_counts_on_lockgraph_bad_fixtures():
+    """Pin exact firing counts for the lockgraph fixtures: one local
+    nesting + one call-path inversion; two reachable blocking calls
+    plus one lexical pipe write; three unguarded paths to guarded
+    fields (two fields share one call site); three unresolvable
+    constructions."""
+    active, _ = _lint_fixture("lockgraph_rank_inversion_bad.py")
+    assert len([f for f in active
+                if f.rule == "lockgraph-rank-inversion"]) == 2
+    active, _ = _lint_fixture(
+        "lockgraph_blocking_reachable_under_lock_bad.py")
+    assert len([f for f in active
+                if f.rule == "lockgraph-blocking-reachable-under-lock"
+                ]) == 3
+    active, _ = _lint_fixture(
+        "lockgraph_guarded_field_unlocked_path_bad.py")
+    assert len([f for f in active
+                if f.rule == "lockgraph-guarded-field-unlocked-path"
+                ]) == 3
+    active, _ = _lint_fixture("lockgraph_unresolved_lock_bad.py")
+    assert len([f for f in active
+                if f.rule == "lockgraph-unresolved-lock"]) == 3
+
+
+def test_lockgraph_inversion_reports_the_full_path():
+    """The finding must carry the call chain, not just the endpoint —
+    that is what makes an interprocedural report actionable."""
+    active, _ = _lint_fixture("lockgraph_rank_inversion_bad.py")
+    paths = [f for f in active if f.rule == "lockgraph-rank-inversion"
+             and "->" in f.message]
+    assert paths, active
+    (f,) = paths
+    assert "Outer.tick" in f.message and "Inner.poke" in f.message
+    assert "rank 10" in f.message and "rank 20" in f.message
+
+
+def test_lockgraph_flag_runs_only_the_family(tmp_path):
+    """--lockgraph fires on an interprocedural hazard and stays silent
+    on JAX rules; composed with --concurrency both families run."""
+    import io
+    fix = os.path.join(FIXDIR, "lockgraph_rank_inversion_bad.py")
+    buf = io.StringIO()
+    assert run(["--lockgraph", fix], out=buf) == EXIT_FINDINGS
+    out = buf.getvalue()
+    assert "lockgraph-rank-inversion" in out
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        "import threading\n"
+        "import jax\n"
+        "import numpy as np\n\n"
+        "LOCK = threading.Lock()\n\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.mean(x)\n")
+    buf2 = io.StringIO()
+    assert run(["--lockgraph", str(mixed)], out=buf2) == EXIT_CLEAN
+    assert "host-call-in-jit" not in buf2.getvalue()
+    buf3 = io.StringIO()
+    assert run(["--lockgraph", "--concurrency", str(mixed)],
+               out=buf3) == EXIT_FINDINGS
+    assert "raw-lock-construction" in buf3.getvalue()
+    # family ∩ --select that names no family rule is still an error
+    assert run(["--lockgraph", "--select", "host-call-in-jit",
+                str(mixed)]) == EXIT_INTERNAL
+
+
+def test_lockgraph_partial_walk_finds_hierarchy_on_disk(tmp_path):
+    """Linting a subtree that does not include a HIERARCHY literal must
+    still resolve ranks — the analyzer climbs to dsin_tpu/utils/locks.py
+    from the walked files (how the serve/-only gate stays sound)."""
+    pkg = tmp_path / "dsin_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "locks.py").write_text('HIERARCHY = {"a.outer": 1, '
+                                  '"a.inner": 2}\n')
+    sub = tmp_path / "dsin_tpu" / "serve"
+    sub.mkdir()
+    (sub / "mod.py").write_text(
+        "class RankedLock:\n"
+        "    def __init__(self, name, rank=None):\n"
+        "        self.name = name\n"
+        "    def __enter__(self):\n"
+        "        return self\n"
+        "    def __exit__(self, *exc):\n"
+        "        return False\n\n\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._hi = RankedLock(\"a.inner\")\n"
+        "        self._lo = RankedLock(\"a.outer\")\n"
+        "        self._oops = RankedLock(\"a.absent\")\n\n"
+        "    def bad(self):\n"
+        "        with self._hi:\n"
+        "            with self._lo:\n"
+        "                return 0\n")
+    findings, _, _ = lint_paths([str(sub)])
+    rules = sorted({f.rule for f in findings
+                    if f.rule.startswith("lockgraph")})
+    assert rules == ["lockgraph-rank-inversion",
+                     "lockgraph-unresolved-lock"], findings
 
 
 def test_list_suppressions_audit_mode(tmp_path, capsys):

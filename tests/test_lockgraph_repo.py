@@ -1,0 +1,113 @@
+"""Three-way lock-hierarchy drift detection (ISSUE 16).
+
+A lock exists in three places: the README rank table (what we tell
+humans), `locks.py HIERARCHY` (what the runtime enforces), and the
+`RankedLock(...)` construction sites in dsin_tpu/ (what the code
+does). A new lock that skips any of the three must fail CI with a
+message naming the missing row — and the committed
+artifacts/lockgraph.json must match what the analyzer derives from
+the current sources, so the review artifact cannot go stale.
+"""
+
+import json
+import os
+import re
+
+from dsin_tpu.utils.locks import HIERARCHY
+from tools.jaxlint.lockgraph import analyze_paths, render_dot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_TARGETS = [os.path.join(REPO, p)
+                for p in ("dsin_tpu", "tools", "bench.py",
+                          "__graft_entry__.py")]
+
+#: | 4 | `serve.frontdoor` | ... |
+_ROW_RE = re.compile(r"^\|\s*(\d+)\s*\|\s*`([\w.]+)`\s*\|")
+
+
+def _readme_rank_table():
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    rows = {}
+    in_table = False
+    for i, line in enumerate(lines):
+        if line.startswith("| rank | lock |"):
+            in_table = True
+            continue
+        if in_table:
+            m = _ROW_RE.match(line)
+            if m:
+                rows[m.group(2)] = int(m.group(1))
+            elif not line.startswith("|---"):
+                in_table = False
+    return rows
+
+
+def test_readme_rank_table_matches_hierarchy():
+    readme = _readme_rank_table()
+    assert readme, "README rank table not found — header row changed?"
+    missing_from_readme = sorted(set(HIERARCHY) - set(readme))
+    assert not missing_from_readme, (
+        f"locks.py HIERARCHY has locks the README rank table does not "
+        f"document — add rows for: {missing_from_readme}")
+    ghost_rows = sorted(set(readme) - set(HIERARCHY))
+    assert not ghost_rows, (
+        f"README documents locks that no longer exist in locks.py "
+        f"HIERARCHY — drop rows for: {ghost_rows}")
+    wrong = {n: (readme[n], HIERARCHY[n]) for n in HIERARCHY
+             if readme[n] != HIERARCHY[n]}
+    assert not wrong, (
+        f"README rank != HIERARCHY rank (readme, hierarchy): {wrong}")
+
+
+def test_every_hierarchy_lock_is_constructed():
+    """Static construction-site scan == HIERARCHY. A row nothing
+    constructs is dead weight in the ordering story; a construction
+    with a name outside HIERARCHY is already a lint finding, but pin
+    the set equality here too so the failure names the lock."""
+    analysis = analyze_paths([os.path.join(REPO, "dsin_tpu")])
+    constructed = set(analysis.constructed)
+    never_built = sorted(set(HIERARCHY) - constructed)
+    assert not never_built, (
+        f"HIERARCHY rows no RankedLock/RankedCondition construction "
+        f"in dsin_tpu/ uses — retire or wire up: {never_built}")
+    unranked = sorted(constructed - set(HIERARCHY))
+    assert not unranked, (
+        f"lock names constructed in dsin_tpu/ but missing from "
+        f"HIERARCHY — add rows for: {unranked}")
+
+
+def test_committed_lockgraph_artifact_is_fresh():
+    """artifacts/lockgraph.json must equal what the analyzer derives
+    from the current sources (deterministic build: sorted keys, no
+    timestamps, repo-relative paths) — regenerate with
+    `python -m tools.jaxlint --lockgraph --emit-lockgraph
+    artifacts/lockgraph <gate paths>`."""
+    path = os.path.join(REPO, "artifacts", "lockgraph.json")
+    assert os.path.exists(path), (
+        "artifacts/lockgraph.json is not committed — run the "
+        "--emit-lockgraph invocation above")
+    with open(path, encoding="utf-8") as f:
+        committed = json.load(f)
+    fresh = analyze_paths(LINT_TARGETS).build_graph()
+    assert committed["hierarchy"] == fresh["hierarchy"], (
+        "committed artifact hierarchy drifted from locks.py")
+    assert committed == fresh, (
+        "artifacts/lockgraph.json is stale — regenerate it (diff keys: "
+        f"{[k for k in fresh if committed.get(k) != fresh[k]]})")
+    dot_path = os.path.join(REPO, "artifacts", "lockgraph.dot")
+    assert os.path.exists(dot_path)
+    with open(dot_path, encoding="utf-8") as f:
+        assert f.read() == render_dot(fresh), (
+            "artifacts/lockgraph.dot is stale — regenerate it")
+
+
+def test_artifact_edges_respect_the_hierarchy():
+    """Every observed outer->inner nesting edge in the artifact must be
+    rank-increasing — the graph is the proof object reviewers read, so
+    it must itself certify the ordering."""
+    fresh = analyze_paths(LINT_TARGETS).build_graph()
+    assert fresh["edges"], "no nesting edges observed — resolver broken?"
+    bad = [e for e in fresh["edges"]
+           if HIERARCHY[e["outer"]] >= HIERARCHY[e["inner"]]]
+    assert not bad, f"rank-inverted edges in the lock graph: {bad}"

@@ -24,19 +24,30 @@ EXIT_INTERNAL = 2
 
 
 def lint_paths(paths: Sequence[str], config: Optional[LintConfig] = None
-               ) -> Tuple[List[Finding], int, int]:
-    """Lint files/directories. Returns (findings, suppressed_count,
-    files_count). Raises on unreadable paths (CLI maps that to exit 2)."""
+               ) -> Tuple[List[Finding], List[Finding], int]:
+    """Lint files/directories. Returns (findings, suppressed_findings,
+    files_count). Raises on unreadable paths (CLI maps that to exit 2).
+
+    Per-file rules run file by file; if any lockgraph rule is enabled,
+    the whole-repo interprocedural pass runs once over every walked
+    file together and its findings merge in."""
+    from tools.jaxlint.lockgraph import LOCKGRAPH_RULE_NAMES, lint_repo
     config = config or LintConfig()
     findings: List[Finding] = []
-    suppressed = 0
+    suppressed: List[Finding] = []
     files = config.iter_files(paths)
+    sources: List[Tuple[str, str]] = []
     for path in files:
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
+        sources.append((path, source))
         active, sup = lint_source(source, path, config)
         findings.extend(active)
-        suppressed += len(sup)
+        suppressed.extend(sup)
+    if any(n in LOCKGRAPH_RULE_NAMES for n in config.enabled_rules()):
+        repo_active, repo_sup = lint_repo(sources, config)
+        findings.extend(repo_active)
+        suppressed.extend(repo_sup)
     return findings, suppressed, len(files)
 
 
@@ -80,6 +91,16 @@ def _parse_args(argv: Optional[Sequence[str]]) -> argparse.Namespace:
                         "family (lock discipline, guarded fields, "
                         "blocking calls under locks, thread-local "
                         "escapes)")
+    p.add_argument("--lockgraph", action="store_true",
+                   help="run only the whole-repo interprocedural "
+                        "lockgraph family (rank inversions, blocking "
+                        "calls and guarded-field touches reachable "
+                        "through the call graph, unresolved lock "
+                        "constructions); combines with --concurrency")
+    p.add_argument("--emit-lockgraph", metavar="PREFIX", default="",
+                   help="write the derived lock-order graph to "
+                        "PREFIX.json and PREFIX.dot (implies the "
+                        "lockgraph analysis pass)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit 0")
     p.add_argument("--list-suppressions", action="store_true",
@@ -102,29 +123,43 @@ def run(argv: Optional[Sequence[str]] = None,
             print(reporting.format_rules(), file=out)
             return EXIT_CLEAN
         select = tuple(s for s in args.select.split(",") if s)
+        family: tuple = ()
         if args.concurrency:
             from tools.jaxlint.concurrency import CONCURRENCY_RULE_NAMES
+            family += tuple(CONCURRENCY_RULE_NAMES)
+        if args.lockgraph:
+            from tools.jaxlint.lockgraph import LOCKGRAPH_RULE_NAMES
+            family += tuple(LOCKGRAPH_RULE_NAMES)
+        if family:
             if select:
-                select = tuple(n for n in CONCURRENCY_RULE_NAMES
-                               if n in select)
+                select = tuple(n for n in family if n in select)
                 if not select:
                     # an empty intersection must not silently widen to
                     # "all rules" (LintConfig treats empty select as
                     # everything-enabled)
-                    print("--concurrency intersected with --select "
-                          "names no concurrency rule; nothing would "
-                          "run", file=sys.stderr)
+                    print("the requested rule family intersected with "
+                          "--select names no rule; nothing would run",
+                          file=sys.stderr)
                     return EXIT_INTERNAL
             else:
-                select = tuple(CONCURRENCY_RULE_NAMES)
+                select = family
         config = LintConfig(
             select=select,
             ignore=tuple(s for s in args.ignore.split(",") if s))
         if args.list_suppressions:
             rows, stale = audit_suppressions(args.paths, config)
-            print(reporting.format_suppressions(rows, stale), file=out)
+            fmt = (reporting.format_suppressions_json
+                   if args.format == "json"
+                   else reporting.format_suppressions)
+            print(fmt(rows, stale), file=out)
             return EXIT_FINDINGS if stale else EXIT_CLEAN
         findings, suppressed, files = lint_paths(args.paths, config)
+        if args.emit_lockgraph:
+            from tools.jaxlint import lockgraph
+            analysis = lockgraph.analyze_paths(args.paths, config)
+            for path in lockgraph.emit_artifacts(analysis,
+                                                 args.emit_lockgraph):
+                print(f"jaxlint: wrote {path}", file=sys.stderr)
         fmt = (reporting.format_json if args.format == "json"
                else reporting.format_text)
         print(fmt(findings, suppressed, files), file=out)

@@ -1,0 +1,1184 @@
+"""lockgraph: whole-repo interprocedural lock-order verification.
+
+PR 5's threadlint rules are intra-function: they see `with self._lock:`
+and what sits lexically inside it. The runtime half
+(dsin_tpu/utils/locks.py) sees every acquire — but only on the paths a
+test or chaos soak actually drives. This module closes the gap between
+the two: it promotes the rank hierarchy from a runtime assertion to a
+statically checked property of the WHOLE program, so an inversion on a
+path chaos_bench never exercises is a lint finding, not a latent
+deadlock.
+
+The pass (one per lint invocation, over every walked file together):
+
+1. **Hierarchy + construction sites.** `HIERARCHY` is parsed out of
+   the lock wrapper module (config.lock_modules; disk fallback to
+   `dsin_tpu/utils/locks.py` when a partial walk omits it). Every
+   `RankedLock(...)`/`RankedCondition(...)` construction resolves to a
+   (name, rank); non-literal names, names missing from the hierarchy,
+   and ad-hoc `rank=` constructions outside tests are
+   `lockgraph-unresolved-lock` findings.
+
+2. **Call graph + per-function summaries.** Module-qualified defs,
+   `self.method` resolved through the enclosing class (and its repo
+   bases), attribute receivers resolved through `self.x = Class(...)`
+   type seeds, locals through `v = Class(...)` / `v = self.x`.
+   Per function: locks acquired via `with <lock>:` (the repo's only
+   acquire idiom — verified by grep: no bare `.acquire()` on ranked
+   locks outside the wrapper), the lock set HELD at every call site,
+   blocking calls (threadlint's set, plus `.send()`/`.recv()` on
+   pipe/conn receivers — the replica transport idiom), and guarded
+   fields touched without their guard.
+
+3. **Interprocedural propagation.** Transitive may-acquire /
+   may-block / touches-unguarded sets flow over the call graph; each
+   finding reports the full call path, anchored at the call site
+   where the held lock meets the reachable hazard (that line is where
+   the fix — or the justified suppression — belongs):
+
+   * `lockgraph-rank-inversion` — a call path on which a rank <= a
+     held rank may be acquired (the shape every cross-thread deadlock
+     needs; the static twin of LockOrderViolation).
+   * `lockgraph-blocking-reachable-under-lock` — a blocking call
+     reachable while a ranked lock is held (PR 5's convoy rule,
+     extended through the call graph).
+   * `lockgraph-guarded-field-unlocked-path` — a `# guarded-by:`
+     field touched in a `*_locked` function reachable from a caller
+     without the guard in its held set (the `_locked` suffix is a
+     caller-holds-the-lock CONTRACT; this rule verifies the callers).
+
+Known conservatism (documented, deliberate — each gap under-reports
+rather than spamming):
+
+* Dynamic dispatch through untyped receivers is not followed; a
+  method call resolves only when the receiver's class is known
+  (self, typed attrs, typed locals). No unique-name guessing.
+* Callbacks, `Thread(target=...)`, executor submissions and
+  `add_done_callback` bodies are NOT call edges: they run on other
+  threads/times, so the spawner's held set does not apply. Their
+  bodies are still analyzed as functions with an empty held set.
+* `with` is the only acquire form modeled; `Condition.wait()` (which
+  releases its lock) is not a blocking call, matching threadlint.
+* Same-rung instance identity is name-level: holding ONE
+  `metrics.metric` leaf discharges a guard on another instance's
+  field. The runtime check has the same granularity.
+
+The derived lock-order graph (nodes = lock names + ranks, edges =
+observed outer->inner nestings with a witness site) is emitted as a
+committed artifact — artifacts/lockgraph.json + .dot — so reviewers
+see the hierarchy the code actually implements; a drift test pins it
+against HIERARCHY and the README rank table.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.framework import (Finding, Rule, Suppressions,
+                                     _statement_start_lines, dotted_name)
+from tools.jaxlint.concurrency import (BLOCKING_DOTTED, BLOCKING_METHODS,
+                                       GUARDED_RE, QUEUEISH_RE)
+
+RANKED_FACTORIES = frozenset({"RankedLock", "RankedCondition"})
+
+#: receivers whose `.send()`/`.recv()` is a (potentially indefinitely)
+#: blocking pipe operation — the replica/entropy-pool transport idiom
+PIPEISH_RE = re.compile(r"(conn|pipe)s?$", re.IGNORECASE)
+PIPE_METHODS = frozenset({"send", "recv"})
+
+#: call-path hops rendered before truncation (cycles are cut anyway)
+MAX_PATH_HOPS = 12
+
+ROOT_PACKAGES = ("dsin_tpu", "tools")
+
+
+def _is_test_path(path: str) -> bool:
+    # stem-only on purpose: lint fixtures live under tests/fixtures/
+    # but are analyzed as production code
+    stem = os.path.splitext(os.path.basename(path))[0]
+    return stem.startswith("test_") or stem == "conftest"
+
+
+def _norm_raw(expr: str) -> str:
+    """`self._mu` and a `# guarded-by: _mu` annotation name the same
+    instance lock — compare them with the receiver stripped."""
+    return expr[5:] if expr.startswith("self.") else expr
+
+
+def _display(path: str) -> str:
+    """Repo-relative display path for messages/artifacts."""
+    parts = path.replace(os.sep, "/").split("/")
+    for root in ROOT_PACKAGES:
+        if root in parts:
+            return "/".join(parts[parts.index(root):])
+    return parts[-1]
+
+
+def _module_name(path: str) -> str:
+    parts = _display(path).split("/")
+    parts[-1] = os.path.splitext(parts[-1])[0]
+    if parts[-1] == "__init__":
+        parts = parts[:-1] or [parts[0]]
+    return ".".join(parts)
+
+
+# -- held-lock entries --------------------------------------------------------
+# ("L", lockname)            a resolved ranked lock
+# ("R", class_qname, expr)   an unresolved lock-ish expression, matched
+#                            raw (and only within the same class)
+
+def _held_names(held: Tuple) -> List[str]:
+    return [h[1] for h in held if h[0] == "L"]
+
+
+# -- per-module collection ----------------------------------------------------
+
+@dataclass
+class _Class:
+    qname: str
+    module: str
+    name: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    attr_seeds: List[Tuple[str, str]] = field(default_factory=list)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    guarded: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class _Module:
+    path: str
+    name: str
+    stem: str
+    tree: ast.Module
+    source: str
+    imports: Dict[str, str] = field(default_factory=dict)
+    funcs: Dict[str, ast.AST] = field(default_factory=dict)
+    classes: Dict[str, _Class] = field(default_factory=dict)
+    locks: Dict[str, str] = field(default_factory=dict)
+    var_seeds: List[Tuple[str, str]] = field(default_factory=list)
+    var_types: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class _Func:
+    qname: str
+    module: str
+    cls: Optional[str]           # class qname, or None
+    name: str
+    path: str
+    line: int
+    node: ast.AST
+    # (lockname, line, held)
+    acquires: List[Tuple[str, int, Tuple]] = field(default_factory=list)
+    # (targets, line, held)
+    calls: List[Tuple[Tuple[str, ...], int, Tuple]] = field(
+        default_factory=list)
+    # (desc, line)
+    blocking: List[Tuple[str, int]] = field(default_factory=list)
+    # (desc, line, held) — pipe send/recv lexically under a lock;
+    # reported here (not left to threadlint) because the per-file
+    # blocking rule predates the pipe transport and does not model it
+    pipe_lexical: List[Tuple[str, int, Tuple]] = field(
+        default_factory=list)
+    # (field, guard_key, line) — touches WITHOUT the guard held
+    touches: List[Tuple[str, Tuple, int]] = field(default_factory=list)
+
+
+def _ranked_construction(node: ast.Call) -> Optional[Tuple]:
+    """(lockname|None, explicit_rank: bool) for RankedLock/Condition
+    construction calls, else None."""
+    dn = dotted_name(node.func)
+    if not dn or dn.split(".")[-1] not in RANKED_FACTORIES:
+        return None
+    name: Optional[str] = None
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        name = node.args[0].value
+    for kw in node.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            name = kw.value.value
+    explicit_rank = len(node.args) > 1 or any(
+        kw.arg == "rank" for kw in node.keywords)
+    return name, explicit_rank
+
+
+def _parse_hierarchy(tree: ast.Module) -> Optional[Dict[str, int]]:
+    """A top-level `HIERARCHY = {str: int, ...}` literal, else None."""
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if not (isinstance(target, ast.Name)
+                and target.id == "HIERARCHY"):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Dict):
+            continue
+        out: Dict[str, int] = {}
+        ok = True
+        for k, v in zip(value.keys, value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, int):
+                out[k.value] = v.value
+            else:
+                ok = False
+        if ok and out:
+            return out
+    return None
+
+
+def _collect_module(path: str, source: str, tree: ast.Module) -> _Module:
+    mod = _Module(path=path, name=_module_name(path),
+                  stem=os.path.splitext(os.path.basename(path))[0],
+                  tree=tree, source=source)
+    pkg = mod.name.rsplit(".", 1)[0] if "." in mod.name else ""
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    mod.imports[alias.asname] = alias.name
+                else:
+                    head = alias.name.split(".")[0]
+                    mod.imports[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                up = pkg.split(".") if pkg else []
+                up = up[:len(up) - (node.level - 1)] if node.level > 1 \
+                    else up
+                base = ".".join(up + ([node.module] if node.module
+                                      else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                mod.imports[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+
+    ann_by_line: Dict[int, str] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = GUARDED_RE.search(text)
+        if m:
+            ann_by_line[i] = m.group(1).strip()
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.funcs[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            cls = _Class(qname=f"{mod.name}.{node.name}",
+                         module=mod.name, name=node.name, node=node)
+            cls.bases = [b for b in (dotted_name(x) for x in node.bases)
+                         if b]
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    cls.methods.setdefault(item.name, item)
+            for meth in cls.methods.values():
+                for sub in ast.walk(meth):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    self_attrs = [
+                        t.attr for t in targets
+                        if isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"]
+                    if not self_attrs:
+                        continue
+                    value = sub.value
+                    if isinstance(value, ast.Call):
+                        rc = _ranked_construction(value)
+                        if rc and rc[0]:
+                            for a in self_attrs:
+                                cls.lock_attrs.setdefault(a, rc[0])
+                        elif rc is None:
+                            fn = dotted_name(value.func)
+                            if fn:
+                                for a in self_attrs:
+                                    cls.attr_seeds.append((a, fn))
+                    end = getattr(sub, "end_lineno", sub.lineno) \
+                        or sub.lineno
+                    guard = next((ann_by_line[ln]
+                                  for ln in range(sub.lineno, end + 1)
+                                  if ln in ann_by_line), None)
+                    if guard is not None:
+                        for a in self_attrs:
+                            cls.guarded.setdefault(a, guard)
+            mod.classes[node.name] = cls
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            value = node.value
+            if names and isinstance(value, ast.Call):
+                rc = _ranked_construction(value)
+                if rc and rc[0]:
+                    for n in names:
+                        mod.locks.setdefault(n, rc[0])
+                elif rc is None:
+                    fn = dotted_name(value.func)
+                    if fn:
+                        for n in names:
+                            mod.var_seeds.append((n, fn))
+    return mod
+
+
+# -- whole-repo analysis ------------------------------------------------------
+
+class Analysis:
+    """The whole-repo lock/call model one lint invocation builds."""
+
+    def __init__(self, sources: Sequence[Tuple[str, str]], config):
+        self.config = config
+        self.modules: Dict[str, _Module] = {}
+        self.parse_failures: List[str] = []
+        for path, source in sources:
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError:
+                self.parse_failures.append(path)
+                continue
+            mod = _collect_module(path, source, tree)
+            self.modules[mod.name] = mod
+
+        self.hierarchy = self._find_hierarchy()
+        self.classes: Dict[str, _Class] = {}
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                self.classes[cls.qname] = cls
+        self._resolve_types()
+        self.construction_findings: List[Finding] = []
+        self.constructed: Dict[str, List[str]] = {}
+        self._scan_constructions()
+        self.funcs: Dict[str, _Func] = {}
+        self._scan_functions()
+        self._ta = self._fix_acquires()
+        self._tb = self._fix_blocking()
+        self._tg = self._fix_guarded()
+
+    # -- hierarchy ------------------------------------------------------------
+
+    def _find_hierarchy(self) -> Dict[str, int]:
+        fallback = None
+        for mod in self.modules.values():
+            h = _parse_hierarchy(mod.tree)
+            if h is not None:
+                if mod.stem in self.config.lock_modules:
+                    return h
+                fallback = fallback or h
+        if fallback is not None:
+            return fallback
+        # partial walks (e.g. linting serve/ alone) still need the repo
+        # hierarchy: climb from any walked file to the wrapper module
+        for mod in self.modules.values():
+            d = os.path.dirname(os.path.abspath(mod.path))
+            for _ in range(8):
+                cand = os.path.join(d, "dsin_tpu", "utils", "locks.py")
+                if os.path.isfile(cand):
+                    try:
+                        with open(cand, encoding="utf-8") as f:
+                            h = _parse_hierarchy(ast.parse(f.read()))
+                        if h:
+                            return h
+                    except (OSError, SyntaxError):
+                        pass
+                parent = os.path.dirname(d)
+                if parent == d:
+                    break
+                d = parent
+            break
+        return {}
+
+    # -- type seeds -----------------------------------------------------------
+
+    def _resolve_symbol(self, mod: _Module, dotted: str) -> Optional[str]:
+        """Resolve a dotted name used in `mod` to a global qname."""
+        parts = dotted.split(".")
+        head = parts[0]
+        if head in mod.classes:
+            base = mod.classes[head].qname
+        elif head in mod.funcs:
+            base = f"{mod.name}.{head}"
+        elif head in mod.imports:
+            base = mod.imports[head]
+        else:
+            return None
+        return ".".join([base] + parts[1:])
+
+    def _class_for_call(self, mod: _Module, fn_dotted: str
+                        ) -> Optional[str]:
+        q = self._resolve_symbol(mod, fn_dotted)
+        return q if q in self.classes else None
+
+    def _resolve_types(self) -> None:
+        for mod in self.modules.values():
+            for var, fn in mod.var_seeds:
+                q = self._class_for_call(mod, fn)
+                if q:
+                    mod.var_types.setdefault(var, q)
+            for cls in mod.classes.values():
+                for attr, fn in cls.attr_seeds:
+                    q = self._class_for_call(mod, fn)
+                    if q:
+                        cls.attr_types.setdefault(attr, q)
+
+    def _mro(self, cls_qname: str) -> List[_Class]:
+        out, queue, seen = [], [cls_qname], set()
+        while queue:
+            q = queue.pop(0)
+            if q in seen or q not in self.classes:
+                continue
+            seen.add(q)
+            cls = self.classes[q]
+            out.append(cls)
+            mod = self.modules.get(cls.module)
+            for b in cls.bases:
+                bq = self._resolve_symbol(mod, b) if mod else None
+                if bq:
+                    queue.append(bq)
+        return out
+
+    def _class_lock_attr(self, cls_qname: str, attr: str
+                         ) -> Optional[str]:
+        for cls in self._mro(cls_qname):
+            if attr in cls.lock_attrs:
+                return cls.lock_attrs[attr]
+        return None
+
+    def _class_attr_type(self, cls_qname: str, attr: str
+                         ) -> Optional[str]:
+        for cls in self._mro(cls_qname):
+            if attr in cls.attr_types:
+                return cls.attr_types[attr]
+        return None
+
+    def _class_method(self, cls_qname: str, name: str) -> Optional[str]:
+        for cls in self._mro(cls_qname):
+            if name in cls.methods:
+                return f"{cls.qname}.{name}"
+        return None
+
+    # -- construction sites ---------------------------------------------------
+
+    def _scan_constructions(self) -> None:
+        rule = RULES["lockgraph-unresolved-lock"]
+        for mod in self.modules.values():
+            if mod.stem in self.config.lock_modules:
+                continue
+            is_test = _is_test_path(mod.path)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                rc = _ranked_construction(node)
+                if rc is None:
+                    continue
+                name, explicit_rank = rc
+                if name is not None:
+                    self.constructed.setdefault(name, []).append(
+                        f"{_display(mod.path)}:{node.lineno}")
+                if is_test:
+                    continue
+                if name is None:
+                    self.construction_findings.append(rule.finding_at(
+                        mod.path, node,
+                        "ranked lock constructed with a non-literal "
+                        "name — the static hierarchy cannot resolve "
+                        "its rank; use a string literal from "
+                        "locks.HIERARCHY"))
+                elif explicit_rank:
+                    self.construction_findings.append(rule.finding_at(
+                        mod.path, node,
+                        f"ad-hoc `rank=` construction of `{name}` "
+                        f"outside tests — production locks take their "
+                        f"rank from locks.HIERARCHY so the repo has "
+                        f"one ordering story"))
+                elif name not in self.hierarchy:
+                    self.construction_findings.append(rule.finding_at(
+                        mod.path, node,
+                        f"lock name `{name}` is not in "
+                        f"locks.HIERARCHY — add a row (rank strictly "
+                        f"between its outermost caller and everything "
+                        f"its critical section touches)"))
+
+    # -- per-function scan ----------------------------------------------------
+
+    def _scan_functions(self) -> None:
+        for mod in self.modules.values():
+            for name, fn in mod.funcs.items():
+                self._scan_one(mod, None, f"{mod.name}.{name}", fn)
+            for cls in mod.classes.values():
+                for mname, meth in cls.methods.items():
+                    self._scan_one(mod, cls,
+                                   f"{cls.qname}.{mname}", meth)
+
+    def _scan_one(self, mod: _Module, cls: Optional[_Class],
+                  qname: str, fn: ast.AST) -> None:
+        info = _Func(qname=qname, module=mod.name,
+                     cls=cls.qname if cls else None, name=fn.name,
+                     path=mod.path, line=fn.lineno, node=fn)
+        self.funcs[qname] = info
+        _FuncScanner(self, mod, cls, info).run()
+        # nested defs: their own scope, empty held (they may run on
+        # another thread after the enclosing `with` exited)
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub_q = f"{qname}.{sub.name}"
+                if sub_q not in self.funcs:
+                    sub_info = _Func(
+                        qname=sub_q, module=mod.name,
+                        cls=cls.qname if cls else None, name=sub.name,
+                        path=mod.path, line=sub.lineno, node=sub)
+                    self.funcs[sub_q] = sub_info
+                    _FuncScanner(self, mod, cls, sub_info).run()
+
+    # -- fixpoints ------------------------------------------------------------
+
+    def _fix(self, seed):
+        """Generic reachability fixpoint: table[f][key] = (line, via)."""
+        table = {q: dict(seed(f)) for q, f in self.funcs.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, f in self.funcs.items():
+                row = table[q]
+                for targets, line, _held in f.calls:
+                    for t in targets:
+                        for key in table.get(t, ()):
+                            if key not in row:
+                                row[key] = (line, t)
+                                changed = True
+        return table
+
+    def _fix_acquires(self):
+        return self._fix(lambda f: {lock: (line, None)
+                                    for lock, line, _ in f.acquires})
+
+    def _fix_blocking(self):
+        return self._fix(lambda f: {desc: (line, None)
+                                    for desc, line in f.blocking})
+
+    def _fix_guarded(self):
+        """Touches of guarded fields propagate only upward through
+        `*_locked` callers that do not already hold the guard; a
+        non-`_locked` caller without the guard is a finding (emitted in
+        guarded_findings), not a propagation."""
+        table = {q: {(fld, guard): (line, None)
+                     for fld, guard, line in f.touches}
+                 if f.name.endswith("_locked") else {}
+                 for q, f in self.funcs.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, f in self.funcs.items():
+                if not f.name.endswith("_locked"):
+                    continue
+                row = table[q]
+                for targets, line, held in f.calls:
+                    for t in targets:
+                        for key in table.get(t, ()):
+                            if key in row:
+                                continue
+                            if self._guard_held(key[1], held, f,
+                                                self.funcs.get(t)):
+                                continue
+                            row[key] = (line, t)
+                            changed = True
+        return table
+
+    def _guard_held(self, guard: Tuple, held: Tuple, caller: _Func,
+                    callee: Optional[_Func]) -> bool:
+        if guard[0] == "L":
+            return guard[1] in _held_names(held)
+        # raw guard (unranked lock expr): only a same-class caller can
+        # meaningfully hold the same instance's lock
+        if callee is not None and caller.cls != callee.cls:
+            return False
+        return any(h[0] == "R" and h[2] == guard[2] for h in held)
+
+    # -- findings -------------------------------------------------------------
+
+    def _trace(self, table, start: str, key) -> List[str]:
+        hops, q, seen = [], start, set()
+        while q is not None and len(hops) < MAX_PATH_HOPS:
+            f = self.funcs[q]
+            line, via = table[q][key]
+            hops.append(f"{f.qname} ({_display(f.path)}:{line})")
+            if via is None or via in seen:
+                break
+            seen.add(via)
+            q = via
+        return hops
+
+    def inversion_findings(self) -> Iterable[Finding]:
+        rule = RULES["lockgraph-rank-inversion"]
+        seen: Set[Tuple] = set()
+        for q, f in self.funcs.items():
+            for lock, line, held in f.acquires:
+                if lock not in self.hierarchy:
+                    continue
+                worst = self._worst_held(held, self.hierarchy.get(lock))
+                if worst is None:
+                    continue
+                key = (f.path, line, lock, worst)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield rule.finding_at(
+                    f.path, _Line(line),
+                    f"acquires `{lock}`(rank {self.hierarchy[lock]}) "
+                    f"while holding `{worst}`(rank "
+                    f"{self.hierarchy[worst]}) in {f.qname} — acquires "
+                    f"must be strictly rank-increasing "
+                    f"(dsin_tpu/utils/locks.py)")
+            for targets, line, held in f.calls:
+                held_ranked = [h for h in _held_names(held)
+                               if h in self.hierarchy]
+                if not held_ranked:
+                    continue
+                for t in targets:
+                    for lock in self._ta.get(t, ()):
+                        if lock not in self.hierarchy:
+                            continue
+                        worst = self._worst_held(held,
+                                                 self.hierarchy[lock])
+                        if worst is None:
+                            continue
+                        key = (f.path, line, lock, worst)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        path = " -> ".join(
+                            [f"{f.qname} ({_display(f.path)}:{line})"]
+                            + self._trace(self._ta, t, lock))
+                        yield rule.finding_at(
+                            f.path, _Line(line),
+                            f"call path may acquire `{lock}`(rank "
+                            f"{self.hierarchy[lock]}) while "
+                            f"`{worst}`(rank {self.hierarchy[worst]}) "
+                            f"is held: {path}")
+
+    def _worst_held(self, held: Tuple, rank: Optional[int]
+                    ) -> Optional[str]:
+        """The held lock with the highest rank >= `rank`, else None."""
+        if rank is None:
+            return None
+        worst, worst_rank = None, None
+        for h in _held_names(held):
+            r = self.hierarchy.get(h)
+            if r is not None and r >= rank and \
+                    (worst_rank is None or r > worst_rank):
+                worst, worst_rank = h, r
+        return worst
+
+    def blocking_findings(self) -> Iterable[Finding]:
+        rule = RULES["lockgraph-blocking-reachable-under-lock"]
+        seen: Set[Tuple] = set()
+        for q, f in self.funcs.items():
+            for desc, line, held in f.pipe_lexical:
+                held_ranked = [h for h in _held_names(held)
+                               if h in self.hierarchy]
+                if not held_ranked:
+                    continue
+                key = (f.path, line, desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield rule.finding_at(
+                    f.path, _Line(line),
+                    f"blocking pipe call {desc} inside `with "
+                    f"{held_ranked[-1]}:` in {f.qname} — if the peer "
+                    f"stops draining, every thread needing the lock "
+                    f"convoys behind the stuck write")
+            for targets, line, held in f.calls:
+                held_ranked = [h for h in _held_names(held)
+                               if h in self.hierarchy]
+                if not held_ranked:
+                    continue
+                outer = held_ranked[-1]
+                for t in targets:
+                    for desc in self._tb.get(t, ()):
+                        key = (f.path, line, desc)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        path = " -> ".join(
+                            [f"{f.qname} ({_display(f.path)}:{line})"]
+                            + self._trace(self._tb, t, desc))
+                        yield rule.finding_at(
+                            f.path, _Line(line),
+                            f"blocking call {desc} reachable while "
+                            f"`{outer}` is held: {path} — a blocked "
+                            f"waiter convoys every thread needing the "
+                            f"lock")
+
+    def guarded_findings(self) -> Iterable[Finding]:
+        rule = RULES["lockgraph-guarded-field-unlocked-path"]
+        seen: Set[Tuple] = set()
+        for q, f in self.funcs.items():
+            if f.name.endswith("_locked"):
+                continue
+            for targets, line, held in f.calls:
+                for t in targets:
+                    for (fld, guard) in self._tg.get(t, ()):
+                        if self._guard_held(guard, held, f,
+                                            self.funcs.get(t)):
+                            continue
+                        gname = guard[1] if guard[0] == "L" \
+                            else guard[-1]
+                        key = (f.path, line, fld, gname)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        path = " -> ".join(
+                            [f"{f.qname} ({_display(f.path)}:{line})"]
+                            + self._trace(self._tg, t, (fld, guard)))
+                        yield rule.finding_at(
+                            f.path, _Line(line),
+                            f"`{fld}` is guarded-by `{gname}` but this "
+                            f"call path reaches it without the guard "
+                            f"held: {path} — hold `{gname}` at the "
+                            f"call site (the `_locked` suffix is a "
+                            f"caller-holds-the-lock contract)")
+
+    def findings(self) -> List[Finding]:
+        out = list(self.construction_findings)
+        out.extend(self.inversion_findings())
+        out.extend(self.blocking_findings())
+        out.extend(self.guarded_findings())
+        return sorted(set(out))
+
+    # -- artifact -------------------------------------------------------------
+
+    def build_graph(self) -> dict:
+        """The lock-order graph the code actually implements: nodes =
+        lock names (+ranks, +construction sites), edges = observed
+        outer->inner nestings with one witness site each. Deterministic
+        (sorted, no timestamps) so the artifact can be committed."""
+        edges: Dict[Tuple[str, str], dict] = {}
+
+        def note(outer: str, inner: str, kind: str, site: str,
+                 via: str) -> None:
+            key = (outer, inner)
+            if key not in edges or (edges[key]["kind"] == "call"
+                                    and kind == "direct"):
+                edges[key] = {"outer": outer, "inner": inner,
+                              "kind": kind, "site": site, "via": via}
+
+        for q in sorted(self.funcs):
+            f = self.funcs[q]
+            for lock, line, held in f.acquires:
+                names = [h for h in _held_names(held)
+                         if h in self.hierarchy]
+                if names and lock in self.hierarchy:
+                    note(names[-1], lock, "direct",
+                         f"{_display(f.path)}:{line}", f.qname)
+            for targets, line, held in f.calls:
+                names = [h for h in _held_names(held)
+                         if h in self.hierarchy]
+                if not names:
+                    continue
+                for t in sorted(targets):
+                    for lock in sorted(self._ta.get(t, ())):
+                        if lock in self.hierarchy:
+                            note(names[-1], lock, "call",
+                                 f"{_display(f.path)}:{line}",
+                                 " -> ".join([f.qname] + [
+                                     h.split(" (")[0] for h in
+                                     self._trace(self._ta, t, lock)]))
+        return {
+            "hierarchy": dict(sorted(self.hierarchy.items(),
+                                     key=lambda kv: kv[1])),
+            "constructed": {k: sorted(v) for k, v in
+                            sorted(self.constructed.items())},
+            "edges": [edges[k] for k in sorted(edges)],
+            "functions_analyzed": len(self.funcs),
+            "modules_analyzed": len(self.modules),
+        }
+
+
+class _Line:
+    """Minimal node stand-in so Rule.finding anchors at a line."""
+
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset
+
+
+class _FuncScanner:
+    """One function's body walk: held-lock tracking, lock resolution,
+    call/blocking/guarded-touch recording."""
+
+    def __init__(self, analysis: Analysis, mod: _Module,
+                 cls: Optional[_Class], info: _Func):
+        self.a = analysis
+        self.mod = mod
+        self.cls = cls
+        self.info = info
+        self.local_types: Dict[str, str] = {}
+        self.local_defs: Set[str] = set()
+        fn = info.node
+        for stmt in ast.walk(fn):
+            if stmt is fn:
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_defs.add(stmt.name)
+        self._seed_local_types(fn)
+        self.guarded = {}
+        if cls is not None:
+            for c in analysis._mro(cls.qname):
+                for fld, guard in c.guarded.items():
+                    self.guarded.setdefault(fld, guard)
+
+    def _seed_local_types(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            value = node.value
+            q = None
+            if isinstance(value, ast.Call):
+                fnname = dotted_name(value.func)
+                if fnname:
+                    q = self.a._class_for_call(self.mod, fnname)
+            elif isinstance(value, ast.Attribute):
+                dn = dotted_name(value)
+                if dn:
+                    q = self._type_of(dn)
+            if q:
+                for n in names:
+                    self.local_types.setdefault(n, q)
+
+    # -- type / lock resolution ----------------------------------------------
+
+    def _type_of(self, dotted: str) -> Optional[str]:
+        """Class qname of the object a dotted expr evaluates to."""
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        if head == "self" and self.cls is not None:
+            cur = self.cls.qname
+        elif head in self.local_types:
+            cur = self.local_types[head]
+        elif head in self.mod.var_types:
+            cur = self.mod.var_types[head]
+        else:
+            return None
+        for attr in rest:
+            nxt = self.a._class_attr_type(cur, attr)
+            if nxt is None:
+                return None
+            cur = nxt
+        return cur
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[Tuple]:
+        """held-entry for a with-item context expr, or None."""
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        if len(parts) == 1:
+            if dn in self.mod.locks:
+                return ("L", self.mod.locks[dn])
+        else:
+            recv, attr = ".".join(parts[:-1]), parts[-1]
+            recv_type = self._type_of(recv)
+            if recv_type is not None:
+                name = self.a._class_lock_attr(recv_type, attr)
+                if name is not None:
+                    return ("L", name)
+            if recv in self.mod.imports:
+                target = self.mod.imports[recv]
+                tmod = self.a.modules.get(target)
+                if tmod and attr in tmod.locks:
+                    return ("L", tmod.locks[attr])
+            # unique ranked-attr fallback: exactly one class in the
+            # repo constructs a ranked lock under this attribute name
+            owners = {c.lock_attrs[attr] for c in
+                      self.a.classes.values() if attr in c.lock_attrs}
+            if len(owners) == 1:
+                return ("L", next(iter(owners)))
+        if re.search(r"(lock|cond|mutex)", parts[-1], re.IGNORECASE):
+            return ("R", self.cls.qname if self.cls else None,
+                    _norm_raw(dn))
+        return None
+
+    def _resolve_call(self, func: ast.AST) -> Tuple[str, ...]:
+        dn = dotted_name(func)
+        if dn is None:
+            return ()
+        parts = dn.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if name in self.local_defs:
+                return (f"{self.info.qname}.{name}",)
+            if name in self.mod.funcs:
+                return (f"{self.mod.name}.{name}",)
+            q = self.a._resolve_symbol(self.mod, name)
+            if q in self.a.classes:
+                init = self.a._class_method(q, "__init__")
+                return (init,) if init else ()
+            if q in self.a.funcs:
+                return (q,)
+            return ()
+        recv, meth = ".".join(parts[:-1]), parts[-1]
+        recv_type = self._type_of(recv)
+        if recv_type is not None:
+            m = self.a._class_method(recv_type, meth)
+            return (m,) if m else ()
+        q = self.a._resolve_symbol(self.mod, dn)
+        if q is not None:
+            if q in self.a.classes:
+                init = self.a._class_method(q, "__init__")
+                return (init,) if init else ()
+            if q in self.a.funcs:
+                return (q,)
+        return ()
+
+    # -- body walk ------------------------------------------------------------
+
+    def run(self) -> None:
+        for stmt in self.info.node.body:
+            self._visit(stmt, ())
+
+    def _visit(self, node: ast.AST, held: Tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return   # separate scope; scanned with an empty held set
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._visit(item.context_expr, held)
+            inner = list(held)
+            for item in node.items:
+                entry = self._resolve_lock(item.context_expr)
+                if entry is not None:
+                    if entry[0] == "L":
+                        self.info.acquires.append(
+                            (entry[1], node.lineno, tuple(inner)))
+                    inner.append(entry)
+            for stmt in node.body:
+                self._visit(stmt, tuple(inner))
+            return
+        if isinstance(node, ast.Call):
+            targets = self._resolve_call(node.func)
+            if targets and _ranked_construction(node) is None:
+                self.info.calls.append((targets, node.lineno, held))
+            desc = self._blocking_desc(node)
+            if desc is not None:
+                self.info.blocking.append((desc, node.lineno))
+                if held and isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in PIPE_METHODS:
+                    self.info.pipe_lexical.append(
+                        (desc, node.lineno, held))
+        self._note_guarded_touch(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _note_guarded_touch(self, node: ast.AST, held: Tuple) -> None:
+        if not self.guarded or not isinstance(node, ast.Attribute):
+            return
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        fld = node.attr
+        guard_expr = self.guarded.get(fld)
+        if guard_expr is None:
+            return
+        entry = self._resolve_lock_expr_str(guard_expr)
+        if entry[0] == "L":
+            if entry[1] in _held_names(held):
+                return
+        else:
+            if any(h[0] == "R" and h[2] == entry[2] for h in held):
+                return
+        self.info.touches.append((f"self.{fld}", entry, node.lineno))
+
+    def _resolve_lock_expr_str(self, expr: str) -> Tuple:
+        """Resolve a `# guarded-by:` annotation text to a held entry.
+        Bare names (`_lock`) resolve as instance attrs of the enclosing
+        class first, then module-level locks."""
+        expr = _norm_raw(expr)
+        if "." not in expr:
+            if self.cls is not None:
+                name = self.a._class_lock_attr(self.cls.qname, expr)
+                if name is not None:
+                    return ("L", name)
+            if expr in self.mod.locks:
+                return ("L", self.mod.locks[expr])
+            return ("R", self.cls.qname if self.cls else None, expr)
+        try:
+            parsed = ast.parse(expr, mode="eval").body
+        except SyntaxError:
+            return ("R", self.cls.qname if self.cls else None, expr)
+        entry = self._resolve_lock(parsed)
+        if entry is not None and entry[0] == "L":
+            return entry
+        return ("R", self.cls.qname if self.cls else None,
+                _norm_raw(expr))
+
+    @staticmethod
+    def _blocking_desc(node: ast.Call) -> Optional[str]:
+        dn = dotted_name(node.func)
+        if dn in BLOCKING_DOTTED:
+            return f"`{dn}`"
+        if isinstance(node.func, ast.Attribute) and \
+                not isinstance(node.func.value, ast.Constant):
+            attr = node.func.attr
+            recv = dotted_name(node.func.value)
+            last = recv.split(".")[-1] if recv else ""
+            if attr in BLOCKING_METHODS:
+                return f"`.{attr}()`"
+            if attr == "get" and last and QUEUEISH_RE.search(last):
+                return f"`{last}.get()`"
+            if attr in PIPE_METHODS and last and \
+                    PIPEISH_RE.search(last):
+                return f"`{last}.{attr}()`"
+        return None
+
+
+# -- rule registration --------------------------------------------------------
+
+class _RepoRule(Rule):
+    """Whole-repo rule: per-file check is a no-op (the real pass runs
+    once per lint invocation in lint_repo); registering keeps the rule
+    selectable/suppressible/documented like any other."""
+
+    def check(self, ctx) -> Iterable[Finding]:
+        return ()
+
+    def finding_at(self, path: str, node, message: str) -> Finding:
+        return Finding(path=path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       rule=self.name, message=message)
+
+
+class RankInversionPath(_RepoRule):
+    name = "lockgraph-rank-inversion"
+    description = ("a call path exists on which a lock of rank <= a "
+                   "held rank may be acquired — the static, "
+                   "whole-program twin of LockOrderViolation")
+
+
+class BlockingReachableUnderLock(_RepoRule):
+    name = "lockgraph-blocking-reachable-under-lock"
+    description = ("a blocking call (.result/.join/pipe send/device "
+                   "transfer/sleep) is reachable through the call "
+                   "graph while a ranked lock is held")
+
+
+class GuardedFieldUnlockedPath(_RepoRule):
+    name = "lockgraph-guarded-field-unlocked-path"
+    description = ("a `# guarded-by:` field is touched in a *_locked "
+                   "function reachable from a caller that does not "
+                   "hold the guard")
+
+
+class UnresolvedLock(_RepoRule):
+    name = "lockgraph-unresolved-lock"
+    description = ("a RankedLock/RankedCondition construction the "
+                   "static hierarchy cannot resolve: non-literal "
+                   "name, name missing from HIERARCHY, or ad-hoc "
+                   "rank= outside tests")
+
+
+LOCKGRAPH_RULES = [RankInversionPath(), BlockingReachableUnderLock(),
+                   GuardedFieldUnlockedPath(), UnresolvedLock()]
+LOCKGRAPH_RULE_NAMES = tuple(r.name for r in LOCKGRAPH_RULES)
+RULES = {r.name: r for r in LOCKGRAPH_RULES}
+
+
+# -- entry points -------------------------------------------------------------
+
+def analyze(sources: Sequence[Tuple[str, str]], config=None) -> Analysis:
+    from tools.jaxlint.config import LintConfig
+    return Analysis(sources, config or LintConfig())
+
+
+def analyze_paths(paths: Sequence[str], config=None) -> Analysis:
+    from tools.jaxlint.config import LintConfig
+    config = config or LintConfig()
+    sources = []
+    for path in config.iter_files(paths):
+        with open(path, encoding="utf-8") as f:
+            sources.append((path, f.read()))
+    return analyze(sources, config)
+
+
+def lint_repo(sources: Sequence[Tuple[str, str]], config=None
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """The whole-repo pass: (active, suppressed) lockgraph findings,
+    restricted to the rules enabled in `config` and filtered through
+    each anchor file's inline suppressions."""
+    from tools.jaxlint.config import LintConfig
+    config = config or LintConfig()
+    enabled = {n for n in config.enabled_rules()
+               if n in LOCKGRAPH_RULE_NAMES}
+    if not enabled:
+        return [], []
+    analysis = analyze(sources, config)
+    raw = [f for f in analysis.findings() if f.rule in enabled]
+    by_path: Dict[str, List[Finding]] = {}
+    for f in raw:
+        by_path.setdefault(f.path, []).append(f)
+    src_by_path = dict(sources)
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for path, findings in by_path.items():
+        source = src_by_path.get(path, "")
+        sup = Suppressions(source)
+        try:
+            stmt_start = _statement_start_lines(ast.parse(source))
+        except SyntaxError:
+            stmt_start = {}
+        for f in findings:
+            (suppressed if sup.covers(f, stmt_start)
+             else active).append(f)
+    return sorted(active), sorted(suppressed)
+
+
+def render_dot(graph: dict) -> str:
+    """GraphViz rendering of build_graph(): rank-sorted lock nodes,
+    solid edges for direct nestings, dashed for call-graph-derived."""
+    lines = ["digraph lockgraph {",
+             '  rankdir=TB;',
+             '  node [shape=box, fontname="monospace"];']
+    for name, rank in sorted(graph["hierarchy"].items(),
+                             key=lambda kv: kv[1]):
+        constructed = name in graph["constructed"]
+        style = "" if constructed else ', style=dashed, color=gray'
+        lines.append(f'  "{name}" [label="{name}\\nrank {rank}"'
+                     f'{style}];')
+    for e in graph["edges"]:
+        style = "solid" if e["kind"] == "direct" else "dashed"
+        lines.append(f'  "{e["outer"]}" -> "{e["inner"]}" '
+                     f'[style={style}, tooltip="{e["site"]}"];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def emit_artifacts(analysis: Analysis, prefix: str) -> Tuple[str, str]:
+    """Write `<prefix>.json` and `<prefix>.dot`; returns the paths."""
+    graph = analysis.build_graph()
+    json_path, dot_path = prefix + ".json", prefix + ".dot"
+    os.makedirs(os.path.dirname(os.path.abspath(json_path)),
+                exist_ok=True)
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(graph, f, indent=2, sort_keys=False)
+        f.write("\n")
+    with open(dot_path, "w", encoding="utf-8") as f:
+        f.write(render_dot(graph))
+    return json_path, dot_path

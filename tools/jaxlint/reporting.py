@@ -8,22 +8,30 @@ from typing import List
 from tools.jaxlint.framework import Finding
 
 
-def format_text(findings: List[Finding], suppressed_count: int,
+def format_text(findings: List[Finding], suppressed: List[Finding],
                 files_count: int) -> str:
     lines = [f.format() for f in sorted(findings)]
     lines.append(f"jaxlint: {len(findings)} finding(s) "
-                 f"({suppressed_count} suppressed) in {files_count} "
+                 f"({len(suppressed)} suppressed) in {files_count} "
                  f"file(s)")
     return "\n".join(lines)
 
 
-def format_json(findings: List[Finding], suppressed_count: int,
+def format_json(findings: List[Finding], suppressed: List[Finding],
                 files_count: int) -> str:
+    """The machine-readable contract CI consumes. Each finding is
+    exactly {rule, path, line, message, suppressed} — suppressed
+    findings are included (flagged true) so dashboards can audit what
+    inline disables are absorbing, but only active ones drive the exit
+    code."""
+    def row(f: Finding, is_suppressed: bool) -> dict:
+        return {"rule": f.rule, "path": f.path, "line": f.line,
+                "message": f.message, "suppressed": is_suppressed}
+    rows = ([row(f, False) for f in sorted(findings)]
+            + [row(f, True) for f in sorted(suppressed)])
     return json.dumps({
-        "findings": [{"path": f.path, "line": f.line, "col": f.col,
-                      "rule": f.rule, "message": f.message}
-                     for f in sorted(findings)],
-        "suppressed": suppressed_count,
+        "findings": rows,
+        "suppressed": len(suppressed),
         "files": files_count,
     }, indent=2)
 
@@ -46,3 +54,14 @@ def format_suppressions(rows, stale_count: int) -> str:
     lines.append(f"jaxlint: {len(rows)} suppression(s), "
                  f"{stale_count} stale")
     return "\n".join(lines)
+
+
+def format_suppressions_json(rows, stale_count: int) -> str:
+    """`--list-suppressions --format json`: same audit, stable schema
+    {path, line, rules, reason, stale} per suppression."""
+    return json.dumps({
+        "suppressions": [{"path": path, "line": line, "rules": rules,
+                          "reason": reason, "stale": stale}
+                         for path, line, rules, reason, stale in rows],
+        "stale": stale_count,
+    }, indent=2)

@@ -386,10 +386,12 @@ class PytreeArgMutation(Rule):
 
 from tools.jaxlint.concurrency import (CONCURRENCY_RULES,
                                        CONCURRENCY_RULE_NAMES)
+from tools.jaxlint.lockgraph import (LOCKGRAPH_RULES,
+                                     LOCKGRAPH_RULE_NAMES)
 
 ALL_RULES = [HostCallInJit(), TracedPythonBranch(), PrngKeyReuse(),
              HostSyncInLoop(), NonStaticJitCapture(),
              ShardMapMissingSpecs(), BareExperimentalImport(),
-             PytreeArgMutation()] + CONCURRENCY_RULES
+             PytreeArgMutation()] + CONCURRENCY_RULES + LOCKGRAPH_RULES
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -34,12 +34,18 @@ lint)
   fi
   ;;
 threadlint)
-  # fail fast: the concurrency family alone (lock discipline, guarded
-  # fields, blocking calls under locks, thread-local escapes) over the
-  # threaded trees — same exit-code contract as the lint stage. The
-  # runtime half (ranked-lock inversion checks) is exercised by
-  # chaos-smoke right below.
-  python -m tools.jaxlint --concurrency dsin_tpu/ tools/ \
+  # fail fast: both concurrency families in one stage — the per-file
+  # threadlint rules (lock discipline, guarded fields, blocking calls
+  # under locks, thread-local escapes) AND the whole-repo lockgraph
+  # pass (interprocedural rank inversions, blocking calls and guarded
+  # fields reachable through the call graph). Also regenerates the
+  # committed lock-order artifact so a hierarchy change in this run
+  # shows up as a lockgraph.json diff (tests/test_lockgraph_repo.py
+  # pins freshness). The runtime half (ranked-lock inversion checks)
+  # is exercised by chaos-smoke right below.
+  python -m tools.jaxlint --concurrency --lockgraph \
+    --emit-lockgraph artifacts/lockgraph \
+    dsin_tpu/ tools/ bench.py __graft_entry__.py \
     > artifacts/threadlint.log 2>&1 || rc=$?
   if [ "$rc" -ne 0 ]; then
     cat artifacts/threadlint.log
