@@ -197,18 +197,64 @@ def codec_from_spec(spec: CodecSpec):
 _worker_codec = None
 
 
+#: shm lane ring this worker attached at init (ISSUE 17); None = the
+#: pipe transport. Single-threaded per pool worker, like _worker_codec.
+_worker_rings = None
+
+
 def init_worker_codec(spec: CodecSpec,
-                      warm_shapes: Sequence[Tuple[int, int, int]] = ()
-                      ) -> None:
+                      warm_shapes: Sequence[Tuple[int, int, int]] = (),
+                      lane_manifest=None) -> None:
     """ProcessPoolExecutor initializer: rebuild the codec once for this
     worker's lifetime and warm its schedule cache for every (D, H, W)
     volume geometry the service's buckets map to — after this, tasks pay
-    coding work only."""
-    global _worker_codec
+    coding work only. `lane_manifest` (shm transport) attaches this
+    worker to the parent's lane ring: task payloads arrive as LaneRef
+    descriptors and results write into the parent-claimed reply lane."""
+    global _worker_codec, _worker_rings
+    if lane_manifest is not None:
+        # serve/shmlane.py imports only utils — this is the transport
+        # layer reaching down, not coding reaching into the serve stack
+        from dsin_tpu.serve import shmlane
+        _worker_rings = shmlane.LaneRing.attach(lane_manifest)
     _worker_codec = codec_from_spec(spec)
     eng = _worker_codec._incremental_engine()
     for shape in warm_shapes:
         eng.schedule(tuple(int(s) for s in shape))
+
+
+def _resolve_task(data):
+    """Inline payloads pass through; a LaneRef copies out of the
+    attached ring WITHOUT freeing — the parent is the sole allocator
+    and reclaims the task lane when the future settles."""
+    from dsin_tpu.serve import shmlane
+    if not isinstance(data, shmlane.LaneRef):
+        return data
+    if _worker_rings is None:
+        raise shmlane.ShmLaneError(
+            "task arrived as a shm lane descriptor but this worker was "
+            "initialized without a lane ring — parent and worker "
+            "disagree about the transport")
+    return _worker_rings.take_obj(data, free=False)
+
+
+def _lane_reply(result, reply):
+    """Ship a task result back through the parent-claimed reply lane
+    when it fits (returning the written descriptor), else inline over
+    the pipe — the same per-message fallback contract the request
+    direction has. The parent frees the reply lane either way."""
+    if reply is None or _worker_rings is None:
+        return result
+    import pickle as _pickle
+
+    from dsin_tpu.serve import shmlane
+    blob = _pickle.dumps(result, protocol=_pickle.HIGHEST_PROTOCOL)
+    if len(blob) < shmlane.SMALL_INLINE_MAX:
+        return result
+    try:
+        return _worker_rings.write_into(reply, blob)
+    except shmlane.ShmLaneError:
+        return result          # oversize for the lane: inline fallback
 
 
 def _resident_codec():
@@ -264,19 +310,24 @@ def _traced_task(fn, data, trace):
                  "coding_ms": (t1 - t0) * 1e3}
 
 
-def worker_encode_batch(volumes, trace=None):
+def worker_encode_batch(volumes, trace=None, reply=None):
     """Process-pool task: encode N (D, H, W) symbol volumes with the
     resident codec — one native rANS call for the whole micro-batch,
     per-lane isolation on refusal (encode_batch_isolated's
     [(payload, None) | (None, exception)] contract). With `trace`
     (sampled TraceContexts riding the task), returns (lanes, echo) —
     the echo carries the contexts back bit-identical plus the
-    child-side coding time."""
+    child-side coding time. shm transport: `volumes` may arrive as a
+    LaneRef and `reply` as a parent-claimed reply lane the result
+    writes into (descriptor back, bytes out of band)."""
+    volumes = _resolve_task(volumes)
     if trace is None:
-        return encode_batch_isolated(_resident_codec(), volumes)
-    return _traced_task(
-        lambda v: encode_batch_isolated(_resident_codec(), v),
-        volumes, trace)
+        out = encode_batch_isolated(_resident_codec(), volumes)
+    else:
+        out = _traced_task(
+            lambda v: encode_batch_isolated(_resident_codec(), v),
+            volumes, trace)
+    return _lane_reply(out, reply)
 
 
 def decode_batch_isolated(codec, payloads) -> list:
@@ -297,13 +348,17 @@ def decode_batch_isolated(codec, payloads) -> list:
         return out
 
 
-def worker_decode_batch(payloads, trace=None):
+def worker_decode_batch(payloads, trace=None, reply=None):
     """Process-pool task: decode N payloads with the resident codec.
     Payloads arrive CRC-verified (the parent-side bridge keeps the
     per-request verify + fault-site semantics). `trace` as in
-    `worker_encode_batch`: (lanes, echo) when contexts ride the task."""
+    `worker_encode_batch`: (lanes, echo) when contexts ride the task.
+    `payloads`/`reply` lane semantics as in `worker_encode_batch`."""
+    payloads = _resolve_task(payloads)
     if trace is None:
-        return decode_batch_isolated(_resident_codec(), payloads)
-    return _traced_task(
-        lambda p: decode_batch_isolated(_resident_codec(), p),
-        payloads, trace)
+        out = decode_batch_isolated(_resident_codec(), payloads)
+    else:
+        out = _traced_task(
+            lambda p: decode_batch_isolated(_resident_codec(), p),
+            payloads, trace)
+    return _lane_reply(out, reply)

@@ -142,6 +142,8 @@ from dataclasses import replace
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from dsin_tpu.serve import metrics as metrics_lib
+from dsin_tpu.serve import protocol
+from dsin_tpu.serve import shmlane
 from dsin_tpu.serve import trace as trace_lib
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, ServeError,
                                     ServiceOverloaded, ServiceUnavailable)
@@ -149,11 +151,9 @@ from dsin_tpu.serve.session import SessionExpired
 from dsin_tpu.serve.swap import SwapError
 from dsin_tpu.utils import locks as locks_lib
 
-#: pipe ops that drive the two-phase hot swap instead of carrying a
-#: request; they target a SPECIFIC replica and are never rerouted on
-#: death — a dead replica fails its swap phase, typed
-CONTROL_OPS = frozenset(
-    {"swap_prepare", "swap_commit", "swap_abort", "rollback"})
+#: re-exported from serve/protocol.py (the one shared definition the
+#: router parent and the replica child both parse by)
+CONTROL_OPS = protocol.CONTROL_OPS
 
 #: how long _dispatch will wait on the commit gate before proceeding
 #: anyway (fail-open: a wedged swap must degrade to pre-swap routing,
@@ -288,7 +288,7 @@ def _picklable_exc(exc: BaseException) -> BaseException:
         return RuntimeError(f"{type(exc).__name__}: {exc}")
 
 
-def _replica_main(conn, config, replica_id: int) -> None:
+def _replica_main(conn, config, replica_id: int, lanes=None) -> None:
     """Spawn target: one full shared-nothing service replica.
 
     Builds + warms its own CompressionService from the picklable
@@ -300,10 +300,20 @@ def _replica_main(conn, config, replica_id: int) -> None:
     digest. Then: one reader loop (submit requests, answer via future
     callbacks through a single sender thread so pipe writes never
     interleave and never run under a ranked lock) until "stop" or
-    router death (EOF), then a graceful drain."""
+    router death (EOF), then a graceful drain.
+
+    `lanes` (shm transport) carries the manifests of the two lane rings
+    the ROUTER created for this replica: requests arrive as LaneRef
+    descriptors resolved (and freed) here, and the sender thread — the
+    sole allocator of the result ring — lanes big "ok" payloads back.
+    The child only attaches; the router owns segment lifetime."""
     from dsin_tpu.serve.service import CompressionService
     from dsin_tpu.utils import recompile
+    req_ring = res_ring = None
     try:
+        if lanes is not None:
+            req_ring = shmlane.LaneRing.attach(lanes["req"])
+            res_ring = shmlane.LaneRing.attach(lanes["res"])
         cfg = replace(config, metrics_port=0)
         service = CompressionService(cfg).start()
         warm = service.warmup()
@@ -320,22 +330,36 @@ def _replica_main(conn, config, replica_id: int) -> None:
                 # coding/loader.py params_digest over (params,
                 # batch_stats) — one digest story everywhere
                 "params_digest": service.model_digest}
+        if res_ring is not None:
+            res_ring.set_metrics(service.metrics)
     except BaseException as e:  # noqa: BLE001 — the router needs the cause
         try:
             conn.send(("failed", replica_id, _picklable_exc(e)))
         finally:
             conn.close()
+            for ring in (req_ring, res_ring):
+                if ring is not None:
+                    ring.close()
         return
     outq: "queue.Queue" = queue.Queue()
 
     def _sender():
+        # the ONE result-ring allocator: laning happens here, on a
+        # single thread, so "ok" payloads never race for lanes and a
+        # pipe death can still free what it just claimed
         while True:
             item = outq.get()
             if item is None:
                 return
+            wire = None
+            if res_ring is not None and item[0] == "ok":
+                wire = protocol.wire_payload(res_ring, item[2])
+                item = (item[0], item[1], wire)
             try:
                 conn.send(item)
             except (OSError, ValueError, BrokenPipeError):
+                if isinstance(wire, shmlane.LaneRef):
+                    res_ring.free(wire)
                 return     # router gone; the reader will see EOF too
 
     sender = threading.Thread(target=_sender, daemon=True,
@@ -375,12 +399,22 @@ def _replica_main(conn, config, replica_id: int) -> None:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break              # router died: drain and exit
-            if msg[0] == "stop":
+            if msg[0] == protocol.STOP:
                 break
             # request messages carry a 6th element since ISSUE 11 (the
             # front-door TraceContext); control ops stay 5-tuples
-            op, rid, payload, priority, deadline_ms = msg[:5]
-            trace = msg[5] if len(msg) > 5 else None
+            op, rid, payload, priority, deadline_ms, trace = \
+                protocol.parse_request(msg)
+            try:
+                # identity for inline payloads; a LaneRef copies out of
+                # the request ring (CRC-verified) and frees the lane —
+                # the receiver-frees half of the lane contract
+                payload = protocol.resolve_payload(req_ring, payload)
+            except (ValueError, shmlane.ShmLaneError) as e:
+                # IntegrityError (corrupt lane / geometry liar) or a
+                # descriptor with no ring: answer typed, keep serving
+                outq.put(("err", rid, _picklable_exc(e)))
+                continue
             if op in CONTROL_OPS:
                 if op == "swap_prepare":
                     # prepare is the slow phase (load + census warm):
@@ -453,15 +487,23 @@ def _replica_main(conn, config, replica_id: int) -> None:
         sender.join(timeout=10)
         if not sender.is_alive():
             conn.close()
+            # close (never unlink — the router owns the segments) only
+            # once the sender cannot be mid-write into a lane
+            for ring in (req_ring, res_ring):
+                if ring is not None:
+                    ring.close()
         # a wedged sender keeps the fd — closing under its write would
         # be the same interleaving; process exit reclaims it
 
 
-def _spawn_launcher(config, idx: int, ctx):
+def _spawn_launcher(config, idx: int, ctx, lanes=None):
     """Default replica launcher: a real spawn process + duplex pipe.
-    Tests substitute a launcher whose far end is driven in-process."""
+    Tests substitute a launcher whose far end is driven in-process.
+    `lanes` (shm transport) is the picklable {req, res} ring-manifest
+    pair the child attaches to."""
     parent, child = ctx.Pipe(duplex=True)
-    proc = ctx.Process(target=_replica_main, args=(child, config, idx),
+    proc = ctx.Process(target=_replica_main,
+                       args=(child, config, idx, lanes),
                        name=f"serve-replica-{idx}", daemon=True)
     proc.start()
     child.close()
@@ -506,12 +548,16 @@ class _Replica:
     """Parent-side replica handle: process, pipe, and the in-flight map
     (rid -> _Pending) under the per-replica `serve.replica` lock, which
     also serializes pipe sends (interleaved Connection writes corrupt
-    the stream)."""
+    the stream). With the shm transport, `rings` holds the two lane
+    rings the ROUTER created for this replica ("req": router allocates,
+    child frees; "res": child's sender allocates, router's reader
+    frees) — created before spawn, unlinked exactly once when the
+    replica leaves for good."""
 
     __slots__ = ("idx", "proc", "conn", "info", "lock", "inflight",
-                 "reader")
+                 "reader", "rings")
 
-    def __init__(self, idx: int, proc, conn):
+    def __init__(self, idx: int, proc, conn, rings=None):
         self.idx = idx
         self.proc = proc
         self.conn = conn
@@ -519,6 +565,21 @@ class _Replica:
         self.lock = locks_lib.RankedLock("serve.replica")
         self.inflight: Dict[int, _Pending] = {}   # guarded-by: self.lock
         self.reader: Optional[threading.Thread] = None
+        self.rings: Optional[Dict[str, shmlane.LaneRing]] = rings
+
+    def ring(self, which: str) -> Optional[shmlane.LaneRing]:
+        rings = self.rings
+        return None if rings is None else rings.get(which)
+
+    def close_rings(self) -> None:
+        """Unlink both segments (idempotent; creator side only — the
+        router created them). Attached children keep valid mappings
+        until they close; the NAME disappears now, so a /dev/shm census
+        goes clean the moment the replica leaves the rotation."""
+        rings, self.rings = self.rings, None
+        if rings:
+            for ring in rings.values():
+                ring.unlink()
 
 
 class FrontDoorRouter:
@@ -538,11 +599,23 @@ class FrontDoorRouter:
                  metrics_port: Optional[int] = None,
                  trace_sample_rate: float = 0.0,
                  trace_capacity: int = 4096,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 transport: Optional[str] = None,
+                 prewarm_template: bool = False,
+                 shm_lanes_per_class: Optional[int] = None):
         if replicas < 1:
             raise ValueError(f"need at least one replica, got {replicas}")
         if evict_after < 1:
             raise ValueError(f"evict_after must be >= 1, got {evict_after}")
+        # router->replica payload transport: None inherits the config's
+        # (which governs the service->entropy-pool hop the same way)
+        self.transport = (transport if transport is not None
+                          else getattr(config, "transport", "pipe"))
+        if self.transport not in ("pipe", "shm"):
+            raise ValueError(
+                f"transport must be 'pipe' or 'shm', "
+                f"got {self.transport!r}")
+        self._shm_lanes_per_class = shm_lanes_per_class
         self.config = config
         self.num_replicas = int(replicas)
         self.poll_every_s = float(poll_every_s)
@@ -620,8 +693,68 @@ class FrontDoorRouter:
         #: the fleet-merged trace view: the router's own spans + a live
         #: /trace scrape of every replica, stitched onto one timeline
         self.traces = AggregatedTraces(self)
+        # pre-warmed replica template (cold-start attack): one paused,
+        # census-warmed spawn held in reserve OUTSIDE the rotation (no
+        # reader thread — nothing routes to it), so add_replica becomes
+        # digest-handshake + unpause. Stock/admit/discard run under the
+        # rank-3 serve.template lock (BELOW frontdoor: admit walks into
+        # the replica-table machinery while holding it).
+        self._template_enabled = bool(prewarm_template)
+        self._template_lock = locks_lib.RankedLock("serve.template")
+        self._template: Optional[_Replica] = None  # guarded-by: self._template_lock
+        self._template_thread: Optional[threading.Thread] = None  # guarded-by: self._template_lock
 
     # -- lifecycle ----------------------------------------------------------
+
+    def _lane_classes(self) -> List[shmlane.LaneClass]:
+        """Ring geometry for ONE replica direction: a lane class per
+        bucket (sized for the widest payload a bucket produces —
+        float32 HxWx3 plus pickle slack) and a small class for the
+        blobs between the inline threshold and the smallest bucket.
+        Oversize falls back inline by contract, so the bound only has
+        to be right for the common case, not a guarantee."""
+        per = self._shm_lanes_per_class
+        if per is None:
+            per = min(16, max(4, self.config.max_batch
+                              * max(1, self.config.workers)
+                              * max(1, self.config.pipeline_depth)))
+        bounds = [("small", shmlane.SMALL_INLINE_MAX * 4)]
+        for (bh, bw) in self.config.buckets:
+            bounds.append((f"b{bh}x{bw}", bh * bw * 3 * 4 + 65536))
+        return shmlane.derive_lane_classes(bounds, per)
+
+    def _launch(self, idx: int, ctx, tag: str = "") -> _Replica:
+        """Launch one replica through the injectable launcher. With the
+        shm transport the router creates the replica's two lane rings
+        FIRST (it owns segment lifetime end to end — one process to
+        blame for a /dev/shm leak) and ships their manifests to the
+        child, which only attaches."""
+        if self.transport != "shm":
+            proc, conn = self._launcher(self.config, idx, ctx)
+            return _Replica(idx, proc, conn)
+        classes = self._lane_classes()
+        rings = {
+            "req": shmlane.LaneRing.create(f"{tag}r{idx}q", classes,
+                                           metrics=self.metrics),
+            "res": shmlane.LaneRing.create(f"{tag}r{idx}s", classes,
+                                           metrics=self.metrics),
+        }
+        # the fallback contract is typed + counted + FLIGHT-RECORDED:
+        # the counter says how often, the timeline says when and why
+        rings["req"].on_fallback = (
+            lambda reason, size, _idx=idx: self.flight.record(
+                "shm_fallback", replica=_idx, reason=reason,
+                payload_bytes=size))
+        try:
+            proc, conn = self._launcher(
+                self.config, idx, ctx,
+                lanes={"req": rings["req"].manifest(),
+                       "res": rings["res"].manifest()})
+        except BaseException:
+            for ring in rings.values():
+                ring.unlink()
+            raise
+        return _Replica(idx, proc, conn, rings=rings)
 
     def start(self) -> "FrontDoorRouter":
         if self._started:
@@ -630,8 +763,7 @@ class FrontDoorRouter:
         ctx = multiprocessing.get_context("spawn")
         replicas = []
         for i in range(self.num_replicas):
-            proc, conn = self._launcher(self.config, i, ctx)
-            replicas.append(_Replica(i, proc, conn))
+            replicas.append(self._launch(i, ctx))
         with self._lock:
             self._replicas = replicas
         deadline = time.monotonic() + self.start_timeout_s
@@ -669,6 +801,7 @@ class FrontDoorRouter:
                 port=self.metrics_port,
                 trace=self.traces.http_snapshot).start()
         self._started = True
+        self._kick_restock()
         return self
 
     def _all_replicas(self) -> List[_Replica]:
@@ -698,8 +831,12 @@ class FrontDoorRouter:
             self.metrics.gauge("serve_router_replicas_total").set(
                 len(states))
 
-    def _wait_ready(self, rep: _Replica, deadline: float) -> dict:
+    def _wait_ready(self, rep: _Replica, deadline: float,
+                    abort_on_stop: bool = False) -> dict:
         while True:
+            if abort_on_stop and self._stop.is_set():
+                raise RuntimeError(
+                    "router is draining — abandoning replica startup")
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
@@ -731,6 +868,7 @@ class FrontDoorRouter:
                 rep.conn.close()
             except OSError:
                 pass
+            rep.close_rings()
 
     def __enter__(self) -> "FrontDoorRouter":
         return self.start()
@@ -823,15 +961,23 @@ class FrontDoorRouter:
         already gone; the caller owns the typed answer."""
         with self._lock:
             rid = self._next_rid_locked()
+        # lane the payload OUTSIDE rep.lock (pickling a side image under
+        # the send lock would serialize it against every other send);
+        # claiming a lane acquires serve.shmlane(7) — legal under 6 too
+        ring = rep.ring("req")
+        wire = protocol.wire_payload(ring, pending.payload)
         with rep.lock:
             rep.inflight[rid] = pending
             try:
                 # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- conn.send under serve.replica(6): the child recv-loop drains unconditionally and admission bounds in-flight frames, so the pipe buffer cannot back up; a dead child raises BrokenPipeError instead of blocking
-                rep.conn.send((op, rid, pending.payload, pending.priority,
-                               pending.remaining_ms(), pending.trace))
+                rep.conn.send(protocol.request_msg(
+                    op, rid, wire, pending.priority,
+                    pending.remaining_ms(), pending.trace))
                 return True
             except (OSError, ValueError, BrokenPipeError):
                 del rep.inflight[rid]
+        if isinstance(wire, shmlane.LaneRef):
+            ring.free(wire)   # nobody will ever take it
         return False
 
     def _publish_pins(self) -> None:
@@ -986,6 +1132,11 @@ class FrontDoorRouter:
                 break
             rep, rid = picked
             sent = False
+            # lane the payload per-TARGET (a reroute re-encodes on the
+            # new replica's ring — _Pending keeps the original object,
+            # never a descriptor), outside rep.lock
+            ring = rep.ring("req")
+            wire = protocol.wire_payload(ring, pending.payload)
             with rep.lock:
                 rep.inflight[rid] = pending
                 try:
@@ -994,13 +1145,14 @@ class FrontDoorRouter:
                     # (the trace context rides every (re)dispatch, so
                     # a rerouted request keeps one stitched timeline)
                     # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- conn.send under serve.replica(6): child recv-loop drains unconditionally, admission bounds in-flight; dead child -> BrokenPipeError, not a stuck write
-                    rep.conn.send((pending.op, rid, pending.payload,
-                                   pending.priority,
-                                   pending.remaining_ms(),
-                                   pending.trace))
+                    rep.conn.send(protocol.request_msg(
+                        pending.op, rid, wire, pending.priority,
+                        pending.remaining_ms(), pending.trace))
                     sent = True
                 except (OSError, ValueError, BrokenPipeError):
                     del rep.inflight[rid]
+            if not sent and isinstance(wire, shmlane.LaneRef):
+                ring.free(wire)   # nobody will ever take it
             if sent:
                 self.metrics.counter(
                     f"serve_router_routed_r{rep.idx}").inc()
@@ -1030,6 +1182,20 @@ class FrontDoorRouter:
                 continue   # already rerouted by a death race: drop, the
                 #            live dispatch owns the future now
             if tag == "ok":
+                try:
+                    # identity for inline results; a LaneRef copies out
+                    # of the result ring (CRC-verified) and frees the
+                    # lane. A corrupt lane answers TYPED — the caller
+                    # gets IntegrityError, never plausible wrong bytes.
+                    payload = protocol.resolve_payload(
+                        rep.ring("res"), payload)
+                except (ValueError, shmlane.ShmLaneError) as e:
+                    self.metrics.counter(
+                        "serve_shm_integrity_errors").inc()
+                    self.flight.record("shm_integrity", replica=rep.idx,
+                                       error=f"{type(e).__name__}: {e}")
+                    pending.future.set_exception(e)
+                    continue
                 pending.future.set_result(payload)
             else:
                 if isinstance(payload, DeadlineExceeded):
@@ -1139,7 +1305,152 @@ class FrontDoorRouter:
             pending.future.set_exception(ServiceUnavailable(
                 f"replica {rep.idx} went away with this request in "
                 f"flight" + ("" if draining else " (no retry left)")))
+        # terminal exit owns the shm segments too: unlink NOW (death
+        # never reaches _reap) so a /dev/shm census after any exit —
+        # crash or drain — is clean. Idempotent with _reap's unlink.
+        rep.close_rings()
         self._publish_replica_gauges()
+
+    # -- pre-warmed replica template (ISSUE 17) -------------------------------
+
+    def _kick_restock(self) -> None:
+        """Start a background stock of the template slot unless one is
+        already running, one is already stocked, or the router is
+        draining. Never blocks the caller on a spawn."""
+        if not self._template_enabled or self._stop.is_set():
+            return
+        with self._template_lock:
+            if self._template is not None:
+                return
+            t = self._template_thread
+            if t is not None and t.is_alive():
+                return
+            self._template_thread = threading.Thread(
+                target=self._stock_template, name="router-template",
+                daemon=True)
+            self._template_thread.start()
+        self.metrics.counter("serve_template_restocks").inc()
+
+    def _stock_template(self) -> None:
+        """Background thread body: spawn + census-warm ONE reserve
+        replica and park it OUTSIDE the rotation (no reader thread —
+        it is paused: its service sits recv-blocked with zero traffic,
+        executables warm, shm lanes pre-mapped). Runs WITHOUT the scale
+        claim: stocking for seconds must not block a drain; only the
+        O(1) admit runs under add_replica's claim."""
+        import multiprocessing
+        ctx = multiprocessing.get_context("spawn")
+        rep = None
+        try:
+            with self._lock:
+                idx = len(self._replicas)
+            rep = self._launch(idx, ctx, tag="t")
+            rep.info = self._wait_ready(
+                rep, time.monotonic() + self.start_timeout_s,
+                abort_on_stop=True)
+        except BaseException as e:  # noqa: BLE001 — background, log+count
+            if not self._stop.is_set():
+                # a drain abort is a clean shutdown, not a stock failure
+                self.metrics.counter("serve_template_failures").inc()
+                self.flight.record("template_stock_failed",
+                                   error=f"{type(e).__name__}: {e}")
+            if rep is not None:
+                self._reap(rep, timeout_s=5.0)
+            return
+        stale = None
+        with self._template_lock:
+            if self._stop.is_set() or self._template is not None:
+                stale = rep    # drained while stocking / lost a race
+            else:
+                self._template = rep
+        if stale is not None:
+            self._reap(stale, stop_first=True, timeout_s=5.0)
+            return
+        self.metrics.gauge("serve_template_ready").set(1)
+        self.flight.record("template_stocked",
+                           digest=(rep.info or {}).get("params_digest"))
+
+    def _take_template(self) -> Optional[_Replica]:
+        with self._template_lock:
+            rep, self._template = self._template, None
+        if rep is not None:
+            self.metrics.gauge("serve_template_ready").set(0)
+        return rep
+
+    def template_ready(self) -> bool:
+        """True while a warmed reserve replica is stocked (the
+        autoscale bench waits on this before timing the fast path)."""
+        with self._template_lock:
+            return self._template is not None
+
+    def _discard_template(self, *, restock: bool) -> None:
+        """Reap the stocked template (drain, or a fleet swap made its
+        digest stale) and optionally stock a fresh one."""
+        rep = self._take_template()
+        if rep is not None:
+            self._reap(rep, stop_first=True, timeout_s=5.0)
+        if restock:
+            self._kick_restock()
+
+    def _revalidate_template(self) -> None:
+        """After a fleet swap/rollback: a template warmed on the OLD
+        digest can never be admitted (the admit handshake would refuse
+        it) — discard it now and restock on the new model, instead of
+        paying the miss at the next scale-up."""
+        if not self._template_enabled:
+            return
+        with self._template_lock:
+            rep = self._template
+            digest = (rep.info or {}).get("params_digest") if rep else None
+        if rep is not None and self.params_digest is not None \
+                and digest != self.params_digest:
+            self.metrics.counter("serve_template_stale").inc()
+            self._discard_template(restock=True)
+
+    def _admit_template(self, rep: _Replica) -> Optional[dict]:
+        """The fast half of add_replica (caller holds the scale claim):
+        digest handshake + unpause. The template already paid spawn +
+        build + census warm when it was stocked; admit is appending it
+        to the rotation and starting its reader — O(ms). Returns None
+        (template unusable: died in reserve, or its digest went stale)
+        to fall through to the cold path."""
+        info = rep.info or {}
+        digest = info.get("params_digest")
+        alive = rep.proc is None or rep.proc.is_alive()
+        if not alive or (self.params_digest is not None
+                         and digest != self.params_digest):
+            self.metrics.counter("serve_template_misses").inc()
+            if not alive:
+                self.flight.record("template_miss", reason="dead")
+            else:
+                self.metrics.counter("serve_template_stale").inc()
+                self.flight.record("template_miss", reason="digest",
+                                   template_digest=digest,
+                                   fleet_digest=self.params_digest)
+            self._reap(rep, stop_first=alive, timeout_s=5.0)
+            return None
+        if self.params_digest is None:
+            self.params_digest = digest
+        with self._lock:
+            idx = len(self._replicas)
+            rep.idx = idx     # the child's provisional id is cosmetic:
+            #                   the reader matches answers on rid
+            rep.info = dict(info, replica=idx)
+            self._replicas.append(rep)
+            self.num_replicas = len(self._replicas)
+            self._state[idx] = "live"
+            self._fails[idx] = 0
+        rep.reader = threading.Thread(
+            target=self._reader, args=(rep,),
+            name=f"router-reader-{idx}", daemon=True)
+        rep.reader.start()
+        self.metrics.counter("serve_router_scale_ups").inc()
+        self.metrics.counter("serve_template_admits").inc()
+        self.flight.record("scale_up", replica=idx, digest=digest,
+                           template=True,
+                           warmup_compiles=info.get("warmup_compiles"))
+        self._publish_replica_gauges()
+        return dict(rep.info, replica=idx, template_admit=True)
 
     # -- elastic fleet: runtime replica mutation (ISSUE 14) -------------------
 
@@ -1157,17 +1468,31 @@ class FrontDoorRouter:
         assert self._started, "start() the router before scaling"
         self._claim_scale("add_replica")
         try:
+            # fast path (ISSUE 17): a stocked pre-warmed template turns
+            # admit into digest-handshake + unpause. A miss (stale
+            # digest, died in reserve) falls through to the cold spawn
+            # below; either way the slot restocks in the background.
+            if self._template_enabled:
+                tpl = self._take_template()
+                admitted = (None if tpl is None
+                            else self._admit_template(tpl))
+                self._kick_restock()
+                if admitted is not None:
+                    return admitted
+                if tpl is None:
+                    self.metrics.counter("serve_template_misses").inc()
+                    self.flight.record("template_miss",
+                                       reason="not_stocked")
             import multiprocessing
             ctx = multiprocessing.get_context("spawn")
             with self._lock:
                 idx = len(self._replicas)
             try:
-                proc, conn = self._launcher(self.config, idx, ctx)
+                rep = self._launch(idx, ctx)
             except Exception as e:  # noqa: BLE001 — typed contract
                 raise FleetScaleError(
                     f"replica {idx} could not be launched for "
                     f"scale-up ({type(e).__name__}: {e})") from e
-            rep = _Replica(idx, proc, conn)
             deadline = time.monotonic() + (self.start_timeout_s
                                            if timeout_s is None
                                            else float(timeout_s))
@@ -1285,7 +1610,7 @@ class FrontDoorRouter:
             with victim.lock:
                 try:
                     # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- tiny one-tuple stop frame under serve.replica(6); the drained child is idle and recv-blocked, and a dead one raises instead of blocking
-                    victim.conn.send(("stop", None, None, None, None))
+                    victim.conn.send(protocol.stop_msg())
                 except (OSError, ValueError, BrokenPipeError):
                     pass
             if victim.reader is not None:
@@ -1324,7 +1649,7 @@ class FrontDoorRouter:
         is a zombie until router shutdown."""
         if stop_first:
             try:
-                rep.conn.send(("stop", None, None, None, None))
+                rep.conn.send(protocol.stop_msg())
             except (OSError, ValueError, BrokenPipeError):
                 pass
         if rep.proc is not None:
@@ -1336,6 +1661,7 @@ class FrontDoorRouter:
             rep.conn.close()
         except OSError:
             pass
+        rep.close_rings()
 
     # -- fleet-coordinated hot swap (ISSUE 9) --------------------------------
 
@@ -1351,7 +1677,7 @@ class FrontDoorRouter:
             rep.inflight[rid] = pending
             try:
                 # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- control-op send under serve.replica(6): one small tuple, child recv-loop always draining; pipe death surfaces as BrokenPipeError below
-                rep.conn.send((op, rid, payload, None, None))
+                rep.conn.send(protocol.control_msg(op, rid, payload))
                 sent = True
             except (OSError, ValueError, BrokenPipeError):
                 del rep.inflight[rid]
@@ -1485,6 +1811,9 @@ class FrontDoorRouter:
         finally:
             with self._lock:
                 self._swapping = False
+            # a template warmed pre-swap is stale now — refresh it in
+            # the background rather than paying a miss at scale-up
+            self._revalidate_template()
 
     def rollback(self, timeout_s: float = 60.0,
                  expect_digest: Optional[str] = None) -> dict:
@@ -1580,6 +1909,9 @@ class FrontDoorRouter:
         finally:
             with self._lock:
                 self._swapping = False
+            # a template warmed pre-swap is stale now — refresh it in
+            # the background rather than paying a miss at scale-up
+            self._revalidate_template()
 
     # -- health -------------------------------------------------------------
 
@@ -1679,6 +2011,18 @@ class FrontDoorRouter:
         join, then fail anything still unresolved — no hung futures."""
         self._stop.set()
         self._swap_gate.set()     # never strand a dispatcher on drain
+        if self._template_enabled:
+            # the reserve replica never took traffic; stop it like any
+            # other child. A stock still in flight sees _stop set,
+            # aborts its wait, and reaps its own spawn (rings included)
+            # — JOIN it so the /dev/shm census is clean when drain
+            # returns, then sweep anything stocked in between.
+            self._discard_template(restock=False)
+            with self._template_lock:
+                stocker = self._template_thread
+            if stocker is not None and stocker.is_alive():
+                stocker.join(timeout=timeout_s)
+            self._discard_template(restock=False)
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -1689,7 +2033,7 @@ class FrontDoorRouter:
             with rep.lock:
                 try:
                     # jaxlint: disable=lockgraph-blocking-reachable-under-lock -- shutdown stop frame under serve.replica(6): tiny tuple, and the subsequent reader.join(timeout) bounds how long a wedged child can be waited on
-                    rep.conn.send(("stop", None, None, None, None))
+                    rep.conn.send(protocol.stop_msg())
                 except (OSError, ValueError, BrokenPipeError):
                     pass
         for rep in replicas:
@@ -1704,6 +2048,7 @@ class FrontDoorRouter:
                 rep.conn.close()
             except OSError:
                 pass
+            rep.close_rings()
             with rep.lock:
                 leftovers = list(rep.inflight.values())
                 rep.inflight.clear()

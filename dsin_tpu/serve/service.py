@@ -218,6 +218,7 @@ from dsin_tpu.serve import metrics as metrics_lib
 from dsin_tpu.serve import placement as placement_lib
 from dsin_tpu.serve import quality as quality_lib
 from dsin_tpu.serve import router as router_lib
+from dsin_tpu.serve import shmlane as shmlane_lib
 from dsin_tpu.serve import swap as swap_lib
 from dsin_tpu.serve import session as session_lib
 from dsin_tpu.serve import trace as trace_lib
@@ -287,6 +288,17 @@ class ServiceConfig:
     #: future — after a rebuild that includes the fresh pool's spawn +
     #: codec re-warm — so keep it generous.
     entropy_proc_timeout_s: float = 120.0
+    #: heavy-payload transport for the process boundaries (ISSUE 17):
+    #: "pipe" — payloads pickle through the multiprocessing pipe (the
+    #:          pre-shm behavior, and the per-message fallback path);
+    #: "shm"  — payloads ride fixed-size CRC-framed lanes in a
+    #:          multiprocessing.shared_memory ring (serve/shmlane.py);
+    #:          only a (lane, offset, length) descriptor crosses the
+    #:          pipe. Governs the service->entropy-pool hop here and is
+    #:          the default for FrontDoorRouter(transport=None)'s
+    #:          router->replica hop. Bit-identical to "pipe" by
+    #:          contract (gated in serve_bench).
+    transport: str = "pipe"
     #: max batches a worker may hold in flight (device dispatched,
     #: entropy pending) before finishing the oldest; >= 2 overlaps
     #: batch N's entropy with batch N+1's device stage
@@ -590,6 +602,31 @@ class _Inflight:
         self.si_entry = None
 
 
+class _EntropyPool:
+    """One entropy-pool GENERATION: the ProcessPoolExecutor plus (shm
+    transport) the lane ring its workers attached at init. Duck-types
+    the two pool calls the service makes (`submit`, `shutdown`) so
+    ModelBundle.retire() and _swap_entropy_proc keep working untouched;
+    shutdown unlinks the ring WITH the pool, which is the whole
+    lifetime story — a wedged child's late reply write lands in a
+    detached mapping and hurts nobody. All lanes (task AND reply) are
+    parent-allocated and parent-freed: the bridge thread blocks on the
+    reply, so no cross-process free handshake exists to get wrong."""
+
+    def __init__(self, pool, rings, reply_bytes: int):
+        self.pool = pool
+        self.rings = rings          # None = pipe transport
+        self.reply_bytes = int(reply_bytes)
+
+    def submit(self, fn, *args, **kwargs):
+        return self.pool.submit(fn, *args, **kwargs)
+
+    def shutdown(self, wait: bool = False) -> None:
+        self.pool.shutdown(wait=wait)
+        if self.rings is not None:
+            self.rings.unlink()
+
+
 class CompressionService:
     """Thread-per-worker micro-batching codec service.
 
@@ -737,6 +774,9 @@ class CompressionService:
         if self.config.entropy_proc_timeout_s <= 0:
             raise ValueError(f"entropy_proc_timeout_s must be > 0, got "
                              f"{self.config.entropy_proc_timeout_s}")
+        if self.config.transport not in ("pipe", "shm"):
+            raise ValueError(f"transport must be 'pipe' or 'shm', got "
+                             f"{self.config.transport!r}")
         # canary knobs (ISSUE 13), validated with the rest up front
         if self.config.canary_every_s is not None \
                 and self.config.canary_every_s <= 0:
@@ -2295,6 +2335,15 @@ class CompressionService:
         if not isinstance(e, Exception):
             rec.crash = e
 
+    def _entropy_lane_bytes(self) -> int:
+        """Payload bound for ONE entropy task/reply lane: a whole
+        micro-batch of the largest bucket's symbol volumes at int64
+        width, plus pickle slack. Oversize falls back inline by the
+        lane contract, so this is a sizing hint, not a guarantee."""
+        vol = max((d * h * w for (d, h, w) in self._warm_shapes),
+                  default=128 * 1024)
+        return self.config.max_batch * vol * 8 + 65536
+
     def _make_entropy_proc(self, initargs):
         """A fresh "process"-backend pool for ONE bundle's CodecSpec.
         spawn (not fork): forking a process whose jax backend has live
@@ -2302,15 +2351,33 @@ class CompressionService:
         the picklable spec ONCE (initializer) and warm every bucket's
         schedule there — worker-resident state, nothing re-pickled per
         task (coding/loader.py). Called from start(), prepare_swap(),
-        and _proc_call's child-death rebuild."""
+        and _proc_call's child-death rebuild.
+
+        transport="shm" (ISSUE 17): each pool GENERATION gets its own
+        lane ring (task + reply lanes, ALL allocated parent-side — the
+        bridge blocks on the reply, so no cross-process free protocol
+        is needed) whose manifest rides the worker initializer; the
+        ring unlinks with the pool, so a wedged child's late writes
+        land in a detached mapping, harmlessly."""
         import multiprocessing
         from concurrent.futures import ProcessPoolExecutor
         from dsin_tpu.coding import loader as loader_lib
-        return ProcessPoolExecutor(
+        rings = None
+        lane_manifest = None
+        if self.config.transport == "shm":
+            classes = shmlane_lib.derive_lane_classes(
+                [("ent", self._entropy_lane_bytes())],
+                2 * max(2, self._entropy_workers
+                        * max(1, self.config.pipeline_depth)) + 2)
+            rings = shmlane_lib.LaneRing.create("ent", classes,
+                                                metrics=self.metrics)
+            lane_manifest = rings.manifest()
+        pool = ProcessPoolExecutor(
             max_workers=self._entropy_workers,
             mp_context=multiprocessing.get_context("spawn"),
             initializer=loader_lib.init_worker_codec,
-            initargs=initargs)
+            initargs=tuple(initargs) + (lane_manifest,))
+        return _EntropyPool(pool, rings, self._entropy_lane_bytes())
 
     def _proc_call(self, bundle, fn, *args):
         """One coding task on the process backend, surviving child
@@ -2347,7 +2414,10 @@ class CompressionService:
                     f"entropy pool of model bundle epoch {bundle.epoch} "
                     f"was retired while this batch was in flight")
             try:
-                fut = proc.submit(fn, *args)
+                # lane the task per-ATTEMPT on the CURRENT generation's
+                # ring (a retry after a pool swap must not reference
+                # the dead generation's unlinked segment)
+                fut, refs = self._submit_entropy(proc, fn, args)
             except RuntimeError as e:
                 # either the pool is broken (BrokenProcessPool IS a
                 # RuntimeError) or our `proc` read raced a concurrent
@@ -2361,7 +2431,9 @@ class CompressionService:
                 last_exc = e
                 continue
             try:
-                return fut.result(timeout)
+                out = fut.result(timeout)
+                # resolve BEFORE the finally frees the reply lane
+                return self._resolve_entropy(proc, out)
             except BrokenProcessPool as e:
                 self._swap_entropy_proc(bundle, proc)
                 last_exc = e
@@ -2371,7 +2443,51 @@ class CompressionService:
                 raise TimeoutError(
                     f"entropy process backend task exceeded {timeout}s "
                     f"(child alive but stuck); pool replaced") from None
+            finally:
+                # sole-allocator bookkeeping: the parent reclaims task
+                # + reply lanes once the future settled, whatever
+                # happened (no-op after a swap unlinked the ring)
+                self._release_entropy(proc, refs)
         raise last_exc
+
+    def _submit_entropy(self, proc, fn, args):
+        """Submit one coding task -> (future, (task_ref, reply_ref)).
+        Pipe transport submits as-is. shm transport lanes the payload
+        (args[0]) when it is big enough and a lane is free — inline
+        fallback otherwise, counted by the ring — and pre-claims a
+        reply lane for the worker to write the result into."""
+        rings = getattr(proc, "rings", None)
+        if rings is None:
+            return proc.submit(fn, *args), (None, None)
+        payload, rest = args[0], args[1:]
+        task_ref = rings.put_obj(payload)
+        reply_ref = rings.claim(proc.reply_bytes)
+        try:
+            fut = proc.submit(
+                fn, payload if task_ref is None else task_ref,
+                *rest, reply=reply_ref)
+        except BaseException:
+            self._release_entropy(proc, (task_ref, reply_ref))
+            raise
+        return fut, (task_ref, reply_ref)
+
+    def _resolve_entropy(self, proc, out):
+        """A LaneRef result copies out of the reply lane (CRC-verified;
+        corruption raises typed IntegrityError and fails the batch —
+        never plausible wrong symbols). free=False: _proc_call's
+        finally owns the reclaim."""
+        if not isinstance(out, shmlane_lib.LaneRef):
+            return out
+        return proc.rings.take_obj(out, free=False)
+
+    @staticmethod
+    def _release_entropy(proc, refs) -> None:
+        rings = getattr(proc, "rings", None)
+        if rings is None:
+            return
+        for ref in refs:
+            if ref is not None:
+                rings.free(ref)
 
     def _swap_entropy_proc(self, bundle, seen) -> None:
         """Replace a bundle's broken/wedged pool with a fresh one built

@@ -42,7 +42,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 from dsin_tpu.utils import locks as locks_lib
 
 SITES = ("serve.worker.batch", "serve.rans", "serve.swap", "serve.session",
-         "ckpt.write", "ckpt.swap", "ckpt.manifest", "io.read")
+         "serve.shm.lane", "ckpt.write", "ckpt.swap", "ckpt.manifest",
+         "io.read")
 
 ACTIONS = ("raise", "crash", "delay", "corrupt")
 

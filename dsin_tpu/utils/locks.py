@@ -32,8 +32,24 @@ a thread holding rank r may only acquire ranks > r):
                               it across router.add_replica/drain_replica/
                               rollback calls, which acquire
                               serve.frontdoor (4) and serve.replica (6)
+       3  serve.template      pre-warmed replica template slot
+                              (serve/router.py): stock/admit/discard of
+                              the paused spawn held in reserve. Below
+                              serve.frontdoor because admitting the
+                              template calls into the replica-table
+                              machinery (4) and the template's own
+                              replica lock (6); above serve.autoscale
+                              because a scaler tick may drive
+                              add_replica while holding 2
        4  serve.frontdoor     router replica table / per-class rr state (serve/router.py)
        6  serve.replica       per-replica pipe send + in-flight map (serve/router.py)
+       7  serve.shmlane       shared-memory lane allocator free-scan
+                              (serve/shmlane.py): claims/frees lanes in
+                              one ring. Above serve.replica because
+                              payload puts happen under the per-replica
+                              send lock (6); below the batcher/future
+                              rungs so a lane free in a done-callback
+                              stays legal
       10  serve.batcher       MicroBatcher's condition (serve/batcher.py)
       12  serve.future        Future done-callback slot (serve/batcher.py)
       14  serve.admission     per-class outstanding counts (serve/router.py)
@@ -97,8 +113,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 #: for the rationale per rung.
 HIERARCHY: Dict[str, int] = {
     "serve.autoscale": 2,
+    "serve.template": 3,
     "serve.frontdoor": 4,
     "serve.replica": 6,
+    "serve.shmlane": 7,
     "serve.batcher": 10,
     "serve.future": 12,
     "serve.rebalance": 13,

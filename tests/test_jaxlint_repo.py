@@ -65,6 +65,8 @@ THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
     ("dsin_tpu", "serve", "trace.py"),    # tracer + flight recorder (ISSUE 11)
     ("dsin_tpu", "serve", "quality.py"),  # model-health telemetry (ISSUE 13)
     ("dsin_tpu", "serve", "autoscale.py"),  # elastic-fleet loop (ISSUE 14)
+    ("dsin_tpu", "serve", "shmlane.py"),  # shm lane transport (ISSUE 17)
+    ("dsin_tpu", "serve", "protocol.py"),  # wire-tuple helpers (ISSUE 17)
     ("dsin_tpu", "coding", "codec.py"),
     ("dsin_tpu", "coding", "incremental.py"),
     ("dsin_tpu", "coding", "rans.py"),

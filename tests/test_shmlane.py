@@ -1,0 +1,481 @@
+"""Shared-memory lane transport (ISSUE 17): framing, geometry liars,
+fallback, lifecycle — and the bit-identity + template contracts over the
+real router/service paths.
+
+The lane frame carries the same CRC discipline as the DSIM/DSRV stream
+formats (utils/integrity.py), so the exhaustive every-bit sweep from
+test_stream_integrity.py is repeated here against bytes INSIDE a mapped
+/dev/shm segment: no single-bit flip anywhere in a frame may survive
+`take()`, and no descriptor that disagrees with the ring layout may be
+read through.
+"""
+
+import glob
+import struct
+import threading
+import time
+
+import pytest
+
+from dsin_tpu.serve import metrics as metrics_lib
+from dsin_tpu.serve import protocol, shmlane
+from dsin_tpu.utils import faults
+from dsin_tpu.utils.integrity import IntegrityError
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _ring(metrics=None, lane_bytes=4096 - shmlane.FRAME_OVERHEAD,
+          n_lanes=2, name="t"):
+    classes = [shmlane.LaneClass("a", lane_bytes, n_lanes)]
+    return shmlane.LaneRing.create(name, classes, metrics=metrics)
+
+
+def _flip_bit(ring, byte_off, bit):
+    ring._shm.buf[byte_off + bit // 8] ^= 1 << (bit % 8)
+
+
+# -- framing: the exhaustive sweep -------------------------------------------
+
+def test_every_single_bit_flip_in_the_frame_raises_typed():
+    """Mirror of test_dsrv_every_single_bit_flip_raises_typed, in shared
+    memory: flip every bit of [length][crc][payload] in place; every
+    take() must raise ValueError (IntegrityError is one); the lane is
+    NOT freed on refusal (free=True never reached the free)."""
+    ring = _ring()
+    try:
+        payload = bytes(range(48))
+        ref = ring.put(payload)
+        assert ref is not None
+        frame_bits = (shmlane.FRAME_OVERHEAD + len(payload)) * 8
+        for bit in range(frame_bits):
+            _flip_bit(ring, ref.offset, bit)
+            with pytest.raises(ValueError):
+                ring.take(ref)
+            _flip_bit(ring, ref.offset, bit)   # restore
+        assert ring.take(ref) == payload       # pristine frame still reads
+    finally:
+        ring.unlink()
+
+
+def test_payload_flip_is_specifically_a_crc_mismatch():
+    ring = _ring()
+    try:
+        ref = ring.put(bytes(range(48)))
+        _flip_bit(ring, ref.offset, shmlane.FRAME_OVERHEAD * 8 + 5)
+        with pytest.raises(IntegrityError, match="CRC mismatch"):
+            ring.take(ref)
+    finally:
+        ring.unlink()
+
+
+def test_fault_site_corrupts_lane_reads():
+    """The serve.shm.lane injection site models bytes rotting in the
+    mapped segment between write and read — the CRC must catch it."""
+    ring = _ring()
+    try:
+        ref = ring.put(b"x" * 64)
+        plan = faults.FaultPlan(
+            [faults.FaultSpec(site="serve.shm.lane", action="corrupt")],
+            seed=3)
+        with faults.installed(plan):
+            with pytest.raises(IntegrityError, match="CRC mismatch"):
+                ring.take(ref)
+        assert plan.activations["serve.shm.lane"] == 1
+    finally:
+        ring.unlink()
+
+
+# -- geometry liars: refused before the CRC ----------------------------------
+
+def test_descriptor_length_disagreeing_with_header_is_refused():
+    ring = _ring()
+    try:
+        ref = ring.put(b"y" * 100)
+        liar = shmlane.LaneRef(ref.ring, ref.cls, ref.lane, ref.offset, 64)
+        with pytest.raises(IntegrityError, match="geometry liar"):
+            ring.take(liar)
+    finally:
+        ring.unlink()
+
+
+def test_descriptor_offset_disagreeing_with_layout_is_refused():
+    ring = _ring()
+    try:
+        ref = ring.put(b"z" * 32)
+        liar = shmlane.LaneRef(ref.ring, ref.cls, ref.lane,
+                               ref.offset + 8, ref.length)
+        with pytest.raises(IntegrityError, match="lying descriptor"):
+            ring.take(liar)
+    finally:
+        ring.unlink()
+
+
+def test_header_length_overflowing_the_lane_is_refused():
+    """A forged in-lane header claiming more bytes than the lane holds
+    must not drive a read past the lane end — even when the descriptor
+    agrees with the forgery."""
+    ring = _ring()
+    try:
+        ref = ring.put(b"w" * 16)
+        huge = ring._classes[0].lane_bytes  # > capacity with overhead
+        struct.pack_into("<I", ring._shm.buf, ref.offset, huge)
+        liar = shmlane.LaneRef(ref.ring, ref.cls, ref.lane, ref.offset,
+                               huge)
+        with pytest.raises(IntegrityError, match="overflows"):
+            ring.take(liar)
+    finally:
+        ring.unlink()
+
+
+def test_bogus_descriptor_targets_raise_shmlane_error():
+    ring = _ring()
+    try:
+        ref = ring.put(b"q" * 16)
+        with pytest.raises(shmlane.ShmLaneError, match="only"):
+            ring.take(shmlane.LaneRef(ref.ring, ref.cls, 99,
+                                      ref.offset, ref.length))
+        with pytest.raises(shmlane.ShmLaneError, match="unknown lane"):
+            ring.take(shmlane.LaneRef(ref.ring, "nope", 0,
+                                      ref.offset, ref.length))
+        with pytest.raises(shmlane.ShmLaneError, match="ring"):
+            ring.take(shmlane.LaneRef("other-ring", ref.cls, ref.lane,
+                                      ref.offset, ref.length))
+    finally:
+        ring.unlink()
+
+
+# -- fallback: oversize / exhausted -> None, typed + counted ------------------
+
+def test_oversize_and_exhaustion_fall_back_counted():
+    reg = metrics_lib.MetricsRegistry()
+    ring = _ring(metrics=reg, n_lanes=2)
+    try:
+        cap = ring._classes[0].lane_bytes - shmlane.FRAME_OVERHEAD
+        assert ring.put(b"a" * cap) is not None
+        assert ring.put(b"b" * cap) is not None
+        # all lanes claimed: exhausted, not oversize
+        assert ring.put(b"c" * cap) is None
+        # no lane class could ever fit this: oversize
+        assert ring.put(b"d" * (cap + 1)) is None
+        snap = reg.snapshot()["counters"]
+        assert snap["serve_shm_fallbacks"] == 2
+        assert snap["serve_shm_fallback_exhausted"] == 1
+        assert snap["serve_shm_fallback_oversize"] == 1
+    finally:
+        ring.unlink()
+
+
+def test_small_pickles_stay_inline_without_counting_fallback():
+    reg = metrics_lib.MetricsRegistry()
+    ring = _ring(metrics=reg)
+    try:
+        assert ring.put_obj({"tiny": 1}) is None
+        assert reg.snapshot()["counters"].get("serve_shm_fallbacks", 0) == 0
+    finally:
+        ring.unlink()
+
+
+def test_freed_lane_is_reusable_and_free_unblocks_exhaustion():
+    ring = _ring(n_lanes=1)
+    try:
+        ref = ring.put(b"one")
+        assert ring.put(b"two") is None          # exhausted
+        assert ring.take(ref) == b"one"          # receiver frees
+        ref2 = ring.put(b"two")
+        assert ref2 is not None and ring.take(ref2) == b"two"
+        # free() without reading (send failed) also releases
+        ref3 = ring.claim(8)
+        ring.free(ref3)
+        assert ring.claim(8) is not None
+    finally:
+        ring.unlink()
+
+
+# -- reply-lane pattern + attach ---------------------------------------------
+
+def test_claim_then_write_into_reply_pattern_roundtrips():
+    """The entropy-pool shape: the parent claims the reply lane, the
+    worker writes a SHORTER payload into it, the returned descriptor
+    carries the actual length, and the parent copies out with
+    free=False (the parent owns the reclaim)."""
+    ring = _ring()
+    try:
+        reply = ring.claim(2048)
+        worker_view = shmlane.LaneRing.attach(ring.manifest())
+        try:
+            written = worker_view.write_into(reply, b"result" * 10)
+            assert written.length == 60 and written.lane == reply.lane
+        finally:
+            worker_view.close()
+        assert ring.take(written, free=False) == b"result" * 10
+        ring.free(written)
+        with pytest.raises(shmlane.ShmLaneError, match="does not fit"):
+            ring.write_into(ring.claim(8), b"x" * 8192)
+    finally:
+        ring.unlink()
+
+
+def test_unlink_census_and_idempotence():
+    ring = _ring(name="census")
+    seg = f"/dev/shm/{ring.name}"
+    assert glob.glob(seg), "segment not visible in /dev/shm"
+    ring.unlink()
+    ring.unlink()                                 # safe to call twice
+    assert not glob.glob(seg)
+    assert ring.put(b"late") is None              # closed -> inline
+    ring.free(shmlane.LaneRef(ring.name, "a", 0, 0, 0))   # no-op
+
+
+def test_derive_lane_classes_rounds_to_alignment():
+    classes = shmlane.derive_lane_classes([("b16x24", 100)], 3)
+    assert classes[0].lane_bytes == 4096 and classes[0].n_lanes == 3
+    big = shmlane.derive_lane_classes([("b", 4096)], 1)[0]
+    assert big.lane_bytes == 8192                 # 4096 + overhead rounds up
+    with pytest.raises(ValueError, match="positive geometry"):
+        shmlane.LaneClass("bad", 0, 4)
+
+
+# -- the pipe protocol helpers -----------------------------------------------
+
+def test_wire_and_resolve_payload_contract():
+    reg = metrics_lib.MetricsRegistry()
+    ring = _ring(metrics=reg, lane_bytes=128 * 1024)
+    try:
+        # None ring = pipe transport: payloads pass through untouched
+        assert protocol.wire_payload(None, b"x" * 65536) == b"x" * 65536
+        small = {"k": 1}
+        assert protocol.resolve_payload(ring, small) is small
+        wired = protocol.wire_payload(ring, b"y" * 65536)
+        assert wired is not None and isinstance(wired, shmlane.LaneRef)
+        assert protocol.resolve_payload(ring, wired) == b"y" * 65536
+        # a descriptor on a pipe connection is protocol drift, typed
+        with pytest.raises(shmlane.ShmLaneError, match="disagree"):
+            protocol.resolve_payload(None, wired)
+    finally:
+        ring.unlink()
+
+
+def test_protocol_tuples_have_the_wire_shapes():
+    assert protocol.stop_msg() == ("stop", None, None, None, None)
+    assert protocol.control_msg("rollback", 7, "d0") == \
+        ("rollback", 7, "d0", None, None)
+    msg = protocol.request_msg("encode", 3, b"p", "bulk", 50.0, None)
+    assert protocol.parse_request(msg) == \
+        ("encode", 3, b"p", "bulk", 50.0, None)
+    # control frames parse through the same shape
+    assert protocol.parse_request(protocol.control_msg("swap_abort", 1,
+                                                       None)) == \
+        ("swap_abort", 1, None, None, None, None)
+
+
+def test_concurrent_claims_never_hand_out_the_same_lane():
+    ring = _ring(n_lanes=8)
+    try:
+        got, errs = [], []
+
+        def worker():
+            try:
+                for _ in range(50):
+                    ref = ring.claim(64)
+                    if ref is not None:
+                        got.append(ref.lane)
+                        ring.free(ref)
+            except Exception as e:  # noqa: BLE001 — fail the test below
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        assert got and all(0 <= lane < 8 for lane in got)
+    finally:
+        ring.unlink()
+
+
+# -- the real thing: spawned replica, shm vs pipe bit-identity ---------------
+
+@pytest.fixture(scope="module")
+def tiny_cfg_files(tmp_path_factory):
+    from test_train_step import tiny_ae_cfg, tiny_pc_cfg
+    d = tmp_path_factory.mktemp("shmlane_cfg")
+    ae = tiny_ae_cfg(crop_size=(16, 24), batch_size=1)
+    ae_p, pc_p = str(d / "ae"), str(d / "pc")
+    with open(ae_p, "w") as f:
+        f.write(str(ae))
+    with open(pc_p, "w") as f:
+        f.write(str(tiny_pc_cfg()))
+    return ae_p, pc_p
+
+
+def test_spawned_replica_shm_bit_identical_to_pipe(tiny_cfg_files,
+                                                   monkeypatch):
+    """One REAL replica per transport answers the same mixed-class
+    stream with identical bytes, the shm run actually used lanes
+    (inline threshold dropped parent-side so tiny test images ride
+    descriptors), and /dev/shm is clean after both drains."""
+    import numpy as np
+
+    from dsin_tpu.serve import ServiceConfig
+    from dsin_tpu.serve.router import FrontDoorRouter
+    ae_p, pc_p = tiny_cfg_files
+    cfg = ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, buckets=((16, 24),),
+        max_batch=2, max_wait_ms=2.0, max_queue=16, workers=1)
+    rng = np.random.default_rng(17)
+    imgs = [rng.integers(0, 255, (16, 24, 3), dtype=np.uint8),
+            rng.integers(0, 255, (10, 17, 3), dtype=np.uint8)]
+    results = {}
+    for transport in ("pipe", "shm"):
+        if transport == "shm":
+            # the parent-side allocator lanes EVERY payload: the
+            # cross-process descriptor path is exercised with tiny
+            # images instead of multi-MB ones (the child resolves by
+            # descriptor TYPE, so its own threshold is irrelevant)
+            monkeypatch.setattr(shmlane, "SMALL_INLINE_MAX", 1)
+        router = FrontDoorRouter(cfg, replicas=1, poll_every_s=0.5,
+                                 start_timeout_s=600.0,
+                                 transport=transport).start()
+        try:
+            frames = [router.encode(im, timeout=180.0).stream
+                      for im in imgs]
+            outs = [router.decode(fr, timeout=120.0) for fr in frames]
+            if transport == "shm":
+                snap = router.metrics.snapshot()["counters"]
+                assert snap.get("serve_shm_sends", 0) >= len(imgs) * 2, \
+                    "shm run never used its lanes"
+        finally:
+            router.drain(timeout_s=60)
+        results[transport] = (frames, outs)
+    assert results["pipe"][0] == results["shm"][0], \
+        "encode streams differ between transports"
+    for a, b in zip(results["pipe"][1], results["shm"][1]):
+        assert np.array_equal(a, b), "decoded images differ"
+    assert not glob.glob("/dev/shm/dsin-*"), "leaked lane segments"
+
+
+# -- pre-warmed template: admit is a handshake, misses fall back cold --------
+
+def _fake_router(replicas=1, **kw):
+    from test_serve_autoscale import _ElasticFakes, _router
+    fakes = _ElasticFakes()
+    return fakes, _router(fakes, replicas=replicas, **kw)
+
+
+def test_template_stocks_admits_and_restocks():
+    fakes, router = _fake_router(prewarm_template=True)
+    router.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not router.template_ready() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.template_ready(), "template never stocked"
+        info = router.add_replica(timeout_s=10)
+        assert info["template_admit"] and info["replica"] == 1
+        snap = router.metrics.snapshot()["counters"]
+        assert snap["serve_template_admits"] == 1
+        assert snap.get("serve_template_misses", 0) == 0
+        # the admitted replica takes traffic immediately
+        fut = router.submit_encode(b"img")
+        assert fut.result(timeout=10)
+        # and the slot restocks in the background
+        deadline = time.monotonic() + 10
+        while not router.template_ready() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.template_ready(), "slot never restocked"
+        assert router.metrics.snapshot()["counters"][
+            "serve_template_restocks"] >= 2
+    finally:
+        router.drain(timeout_s=10)
+
+
+def test_template_digest_mismatch_misses_to_cold_path():
+    """A template whose handshake digest no longer matches the fleet
+    must never be admitted: the miss is counted, the impostor is
+    reaped, and add_replica falls through to the cold warm-before-admit
+    path (which then refuses or admits on ITS handshake)."""
+    fakes, router = _fake_router(prewarm_template=True)
+    router.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not router.template_ready() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.template_ready()
+        # fleet digest moves out from under the stocked template
+        router.params_digest = "d-new"
+        with pytest.raises(Exception):
+            # cold-path newcomer also builds d0 -> typed refusal;
+            # the point here is the MISS accounting, not the admit
+            router.add_replica(timeout_s=10)
+        snap = router.metrics.snapshot()["counters"]
+        assert snap["serve_template_misses"] == 1
+        assert snap["serve_template_stale"] >= 1
+        assert snap.get("serve_template_admits", 0) == 0
+    finally:
+        router.drain(timeout_s=10)
+
+
+class _FirstLaunchBlocks:
+    """delay_ready gate that stalls only the FIRST spawn that reaches
+    it (the background template stock), letting the cold-path spawn —
+    which reuses the same idx — come up immediately."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self._first = True
+
+    def wait(self, timeout):
+        if self._first:
+            self._first = False
+            self.entered.set()
+            self.release.wait(timeout)
+
+
+def test_template_not_stocked_miss_is_counted_and_cold_path_serves():
+    fakes, router = _fake_router(prewarm_template=True)
+    # stall the template stock so add_replica finds an empty slot
+    gate = _FirstLaunchBlocks()
+    fakes.delay_ready[1] = gate
+    router.start()
+    try:
+        assert gate.entered.wait(5), "template stock never launched"
+        info = router.add_replica(timeout_s=10)
+        assert "template_admit" not in info and info["replica"] == 1
+        snap = router.metrics.snapshot()["counters"]
+        assert snap["serve_template_misses"] == 1
+    finally:
+        gate.release.set()
+        router.drain(timeout_s=10)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_drain_reaps_an_inflight_template_stock():
+    """Drain while the stock thread is still waiting on readiness: the
+    stock must abort, reap its spawn, and leave no segment behind —
+    the bug class this guards is a /dev/shm leak at shutdown. (The
+    stalled fake replica sending `ready` into the pipe the reap closed
+    raises BrokenPipeError in ITS thread — that is the expected
+    outcome, hence the filterwarnings.)"""
+    fakes, router = _fake_router(prewarm_template=True)
+    gate = threading.Event()
+    fakes.delay_ready[1] = gate
+    router.start()
+    try:
+        assert not router.template_ready()
+    finally:
+        router.drain(timeout_s=10)
+        gate.set()
+    assert not router.template_ready()
+    snap = router.metrics.snapshot()["counters"]
+    assert snap.get("serve_template_admits", 0) == 0

@@ -427,6 +427,24 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert au["lock_order_inversions"] == 0
     assert au["flight_recorder"]["dumps"] >= 1
     assert au["flight_recorder"]["last_dump_events"] >= 1
+    # ISSUE 17: the shared-memory lane battery rides every chaos run —
+    # pin its scenario shape so a silent removal cannot pass
+    tr = report["transport"]
+    assert tr["violations"] == []
+    tsc = tr["scenarios"]
+    lc = tsc["lane_corruption"]
+    assert lc["flips_caught"] == lc["frame_bits"] > 0
+    assert lc["pristine_readback"] is True
+    assert lc["geometry_refusals"] == lc["expected_geometry_refusals"]
+    le = tsc["lane_exhaustion"]
+    assert le["fallback_exhausted"] >= 1 and le["lane_sends"] >= 1
+    assert le["hung_futures"] == 0 and le["untyped_errors"] == 0
+    assert le["completed_ok"] > 0 and le["integrity_errors"] == 0
+    rd = tsc["replica_death_mid_descriptor"]
+    assert rd["replica_deaths"] >= 1
+    assert rd["hung_futures"] == 0 and rd["untyped_errors"] == 0
+    assert tr["shm_census"]["after"] == tr["shm_census"]["before"]
+    assert tr["lock_order_inversions"] == 0
     # ISSUE 11: every injected-fault battery must leave a non-empty
     # flight-recorder dump behind (the replayable incident timeline)
     fr = report["flight_recorder"]

@@ -173,6 +173,7 @@ def run_chaos(args) -> dict:
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         workers=args.workers, entropy_workers=args.entropy_workers,
         entropy_backend=args.entropy_backend,
+        transport=args.transport,
         pipeline_depth=args.pipeline_depth, restart_backoff_s=0.02,
         restart_backoff_max_s=0.25, trace_sample_rate=1.0,
         flight_dir=flight_dir, flight_dump_min_interval_s=0.0)
@@ -1749,6 +1750,219 @@ def run_autoscale(args) -> dict:
     }
 
 
+def _shm_census() -> list:
+    """Names of dsin-owned shared-memory segments currently mapped on
+    the host — the lane battery's leak evidence."""
+    try:
+        return sorted(f for f in os.listdir("/dev/shm")
+                      if f.startswith("dsin-"))
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+
+
+def run_transport(args) -> dict:
+    """The shared-memory lane battery (ISSUE 17), three scenarios:
+
+    * lane_corruption — every single bit of a lane frame flipped IN the
+      mapped /dev/shm segment must surface as a typed error from
+      take(), never a wrong payload, and descriptors that lie about the
+      ring geometry are refused before any CRC work.
+    * lane_exhaustion — a burst through a real spawn replica configured
+      with ONE lane per class: claims that find no free lane must fall
+      back to the pipe path typed and counted, with zero hung futures
+      and every request still served.
+    * replica_death_mid_descriptor — a real replica killed with lane
+      descriptors in flight: futures resolve (rerouted or typed), and
+      after the drain the /dev/shm census is byte-for-byte what it was
+      before the battery touched anything.
+
+    Smoke payloads pickle under SMALL_INLINE_MAX, so the battery drops
+    the parent-side inline threshold to 1 for its duration — every
+    dispatch rides a lane (the child resolves by descriptor TYPE, so
+    its own threshold is irrelevant)."""
+    from dsin_tpu.serve import IntegrityError, ServeError, ServiceConfig
+    from dsin_tpu.serve import shmlane as shmlane_lib
+    from dsin_tpu.serve.router import FrontDoorRouter
+    from dsin_tpu.utils import locks
+
+    from tools.serve_bench import _parse_shapes
+
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled — the lane battery needs them"
+
+    buckets = _parse_shapes(args.buckets)
+    violations = []
+    scenarios = {}
+    inversions_before = locks.inversion_count()
+    census_before = _shm_census()
+    t0 = time.monotonic()
+
+    # -- (1) lane corruption: the exhaustive in-segment sweep ---------
+    ring = shmlane_lib.LaneRing.create(
+        "chaos", [shmlane_lib.LaneClass("c", 512, 2)])
+    try:
+        payload = bytes(range(96))
+        ref = ring.put(payload)
+        frame_bits = (shmlane_lib.FRAME_OVERHEAD + len(payload)) * 8
+        caught = 0
+        for bit in range(frame_bits):
+            ring._shm.buf[ref.offset + bit // 8] ^= 1 << (bit % 8)
+            try:
+                ring.take(ref, free=False)
+            except ValueError:       # IntegrityError is one
+                caught += 1
+            ring._shm.buf[ref.offset + bit // 8] ^= 1 << (bit % 8)
+        pristine_ok = ring.take(ref) == payload
+        ref2 = ring.put(b"g" * 100)
+        liars = (
+            (shmlane_lib.LaneRef(ref2.ring, ref2.cls, ref2.lane,
+                                 ref2.offset, 64), IntegrityError),
+            (shmlane_lib.LaneRef(ref2.ring, ref2.cls, ref2.lane,
+                                 ref2.offset + 8, ref2.length),
+             IntegrityError),
+            (shmlane_lib.LaneRef("not-this-ring", ref2.cls, ref2.lane,
+                                 ref2.offset, ref2.length),
+             shmlane_lib.ShmLaneError),
+        )
+        geometry_refusals = 0
+        for liar, exc_type in liars:
+            try:
+                ring.take(liar, free=False)
+            except exc_type:
+                geometry_refusals += 1
+    finally:
+        ring.unlink()
+    if caught != frame_bits:
+        violations.append(
+            f"lane_corruption: {frame_bits - caught} of {frame_bits} "
+            f"single-bit flips read through undetected")
+    if not pristine_ok:
+        violations.append("lane_corruption: the restored frame no "
+                          "longer reads back byte-identical")
+    if geometry_refusals != len(liars):
+        violations.append(
+            f"lane_corruption: {len(liars) - geometry_refusals} lying "
+            f"descriptors were read through instead of refused")
+    scenarios["lane_corruption"] = {
+        "frame_bits": frame_bits, "flips_caught": caught,
+        "pristine_readback": pristine_ok,
+        "geometry_refusals": geometry_refusals,
+        "expected_geometry_refusals": len(liars),
+    }
+
+    cfg = ServiceConfig(
+        ae_config=args.ae_config, pc_config=args.pc_config,
+        ckpt=args.ckpt, seed=args.seed, buckets=buckets,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue, workers=args.workers,
+        entropy_workers=args.entropy_workers,
+        entropy_backend=args.entropy_backend,
+        pipeline_depth=args.pipeline_depth)
+    rng = np.random.default_rng(args.seed + 23)
+    img = rng.integers(0, 255, (buckets[0][0], buckets[0][1], 3),
+                       dtype=np.uint8)
+    inline_max = shmlane_lib.SMALL_INLINE_MAX
+    shmlane_lib.SMALL_INLINE_MAX = 1
+    try:
+        # -- (2) lane exhaustion under burst: typed fallback ----------
+        router = FrontDoorRouter(cfg, replicas=1, transport="shm",
+                                 shm_lanes_per_class=1).start()
+        try:
+            futures = []
+            for _ in range(args.requests):
+                try:
+                    futures.append(router.submit_encode(img))
+                except ServeError:
+                    pass             # admission sheds are typed load
+            counts, hung = _await_all(futures, args.timeout_s)
+            exhausted = router.metrics.counter(
+                "serve_shm_fallback_exhausted").value
+            sends = router.metrics.counter("serve_shm_sends").value
+            integ = router.metrics.counter(
+                "serve_shm_integrity_errors").value
+        finally:
+            router.drain(timeout_s=60)
+        if exhausted < 1:
+            violations.append(
+                "lane_exhaustion: a one-lane burst never exhausted the "
+                "ring — the scenario proved nothing")
+        if sends < 1:
+            violations.append("lane_exhaustion: the lane transport "
+                              "never ran (all sends fell back?)")
+        if hung:
+            violations.append(f"lane_exhaustion: {hung} hung futures")
+        if counts["untyped"]:
+            violations.append(f"lane_exhaustion: {counts['untyped']} "
+                              f"untyped errors")
+        if counts["ok"] == 0:
+            violations.append("lane_exhaustion: no request completed — "
+                              "the fallback path did not serve")
+        if integ:
+            violations.append(f"lane_exhaustion: {integ} lane "
+                              f"integrity errors on an uncorrupted run")
+        scenarios["lane_exhaustion"] = {
+            "submitted": len(futures), "completed_ok": counts["ok"],
+            "typed_errors": counts["typed"],
+            "untyped_errors": counts["untyped"], "hung_futures": hung,
+            "lane_sends": sends, "fallback_exhausted": exhausted,
+            "integrity_errors": integ,
+        }
+
+        # -- (3) replica death with descriptors in flight -------------
+        router = FrontDoorRouter(cfg, replicas=2, transport="shm",
+                                 poll_every_s=0.1).start()
+        try:
+            futures = [router.submit_encode(img)
+                       for _ in range(min(args.requests, 16))]
+            router._replicas[0].proc.kill()
+            counts, hung = _await_all(futures, args.timeout_s)
+            deaths = router.metrics.counter(
+                "serve_router_replica_deaths").value
+            reroutes = router.metrics.counter(
+                "serve_router_reroutes").value
+            survivor = router.encode(img, timeout=args.timeout_s)
+        finally:
+            router.drain(timeout_s=60)
+        if deaths < 1:
+            violations.append("replica_death_mid_descriptor: the kill "
+                              "was never observed as a death")
+        if hung:
+            violations.append(f"replica_death_mid_descriptor: {hung} "
+                              f"hung futures")
+        if counts["untyped"]:
+            violations.append(
+                f"replica_death_mid_descriptor: {counts['untyped']} "
+                f"untyped errors")
+        if survivor is None:
+            violations.append("replica_death_mid_descriptor: the "
+                              "survivor did not serve after the death")
+        scenarios["replica_death_mid_descriptor"] = {
+            "submitted": len(futures), "completed_ok": counts["ok"],
+            "typed_errors": counts["typed"],
+            "untyped_errors": counts["untyped"], "hung_futures": hung,
+            "replica_deaths": deaths, "reroutes": reroutes,
+        }
+    finally:
+        shmlane_lib.SMALL_INLINE_MAX = inline_max
+
+    census_after = _shm_census()
+    if census_after != census_before:
+        violations.append(
+            f"lane battery leaked shared memory: /dev/shm census went "
+            f"{census_before} -> {census_after}")
+    transport_inversions = locks.inversion_count() - inversions_before
+    if transport_inversions:
+        violations.append(f"{transport_inversions} lock-order "
+                          f"inversions during the lane battery")
+    return {
+        "scenarios": scenarios,
+        "shm_census": {"before": census_before, "after": census_after},
+        "lock_order_inversions": transport_inversions,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="seeded chaos soak for dsin_tpu/serve")
@@ -1819,6 +2033,20 @@ def main(argv=None) -> int:
                         "sick-model fleet rollback via the canary "
                         "roll-up) — rides the fail-fast "
                         "autoscale-bench tpu_session.sh stage")
+    p.add_argument("--transport", default="pipe",
+                   choices=("pipe", "shm"),
+                   help="heavy-payload transport for the main soak's "
+                        "service (ISSUE 17): 'shm' runs the crash/"
+                        "corruption battery over shared-memory lanes "
+                        "(meaningful with --entropy_backend process)")
+    p.add_argument("--transport_only", action="store_true",
+                   help="run ONLY the shared-memory lane battery "
+                        "(exhaustive in-segment bit flips, lying "
+                        "descriptors, one-lane exhaustion burst with "
+                        "typed fallback, replica death with "
+                        "descriptors in flight + /dev/shm census) — "
+                        "rides the fail-fast transport-bench "
+                        "tpu_session.sh stage")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -1851,14 +2079,20 @@ def main(argv=None) -> int:
         report = {"config": {"smoke": args.smoke, "seed": args.seed},
                   "autoscale": run_autoscale(args),
                   "violations": []}
+    elif args.transport_only:
+        report = {"config": {"smoke": args.smoke, "seed": args.seed},
+                  "transport": run_transport(args),
+                  "violations": []}
     else:
         report = run_chaos(args)
         report["hotswap"] = run_hotswap(args)
         report["sessions"] = run_sessions(args)
         report["degraded_model"] = run_degraded(args)
         report["autoscale"] = run_autoscale(args)
+        report["transport"] = run_transport(args)
     # every battery's violations gate the exit code like the soak's own
-    for extra in ("hotswap", "sessions", "degraded_model", "autoscale"):
+    for extra in ("hotswap", "sessions", "degraded_model", "autoscale",
+                  "transport"):
         if extra in report:
             report["violations"] = (report["violations"]
                                     + report[extra]["violations"])
@@ -1887,6 +2121,10 @@ def main(argv=None) -> int:
             k: report["autoscale"][k]
             for k in ("scenarios", "autoscale_counters",
                       "steady_compiles", "violations")}
+    if "transport" in report:
+        summary["transport"] = {
+            k: report["transport"][k]
+            for k in ("scenarios", "shm_census", "violations")}
     summary["violations"] = report["violations"]
     print(json.dumps(summary, indent=1))
     if report["violations"]:

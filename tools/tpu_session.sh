@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -230,6 +230,35 @@ autoscale-bench)
   if [ "$rc" -ne 0 ]; then
     cat artifacts/autoscale_chaos.log
     echo "TPU_SESSION_FAILED: autoscale-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
+transport-bench)
+  # fail fast (ISSUE 17): the shared-memory lane leg — serve_bench runs
+  # the SAME traffic over both transports on both heavy-payload hops
+  # (router dispatch through a real spawn replica; the process entropy
+  # pool) and must show cross-transport bit-identity, real lane
+  # traffic with zero integrity errors, and zero steady-state
+  # compiles (2-core host-weather convention applies: effective and
+  # host cores are recorded in every run entry); chaos_bench's lane
+  # battery then flips every bit of a mapped frame (all typed), bursts
+  # a one-lane ring into typed fallback with zero hung futures, and
+  # kills a replica with descriptors in flight — /dev/shm census must
+  # come back byte-identical. Both exit 1 on violation; seconds on CPU.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --transport_only \
+    --devices "" --out artifacts/transport_bench.json \
+    > artifacts/transport_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/transport_bench.log
+    echo "TPU_SESSION_FAILED: transport-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke --transport_only \
+    --out artifacts/transport_chaos.json \
+    > artifacts/transport_chaos.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/transport_chaos.log
+    echo "TPU_SESSION_FAILED: transport-bench (queue aborted before chip stages)"
     exit 1
   fi
   ;;
