@@ -12,6 +12,12 @@ Layering (each module stands alone below the next):
     autoscale.py — elastic-fleet control plane (ISSUE 14): windowed
                    autoscale + fleet-health policies (pure) and the
                    Autoscaler loop that calls add/drain/rollback
+                   (+ the ISSUE 18 federation-tier health driver)
+    federation.py— federated fleet tier (ISSUE 18): router-of-routers
+                   over whole member fleets — staged rollout waves
+                   gated by the wave canary + a health soak window,
+                   partition-tolerant conditional auto-rollback,
+                   host-sticky session pins, hierarchical admission
     placement.py — bucket ladder -> device mesh assignment (replica
                    policy + per-device shardings via parallel/mesh.py)
     session.py   — side-information session cache: LRU/TTL/byte-bounded
@@ -34,8 +40,10 @@ SERVE_BENCH.json).
 
 from dsin_tpu.serve.autoscale import (Autoscaler, AutoscaleConfig,
                                       AutoscaleError, AutoscalePolicy,
+                                      FederationHealthDriver,
                                       FleetHealthPolicy,
                                       FleetHealthSignals, ScaleSignals,
+                                      federation_health_from_snapshot,
                                       health_from_snapshot,
                                       signals_from_snapshot)
 from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, DeadlineExceeded,
@@ -45,6 +53,10 @@ from dsin_tpu.serve.batcher import (BULK, INTERACTIVE, DeadlineExceeded,
                                     default_priority_classes)
 from dsin_tpu.serve.buckets import (BucketPolicy, NoBucketFits,
                                     crop_from_bucket, pad_to_bucket)
+from dsin_tpu.serve.federation import (FederatedMetrics, FederatedRouter,
+                                       FederatedTraces, FederationError,
+                                       Member, MemberUnreachable,
+                                       RolloutAborted, RolloutPlan)
 from dsin_tpu.serve.metrics import MetricsRegistry, MetricsServer
 from dsin_tpu.serve.quality import CanaryFailed, QualityMonitor
 from dsin_tpu.serve.placement import (DevicePlacement, PlacementError,
@@ -58,8 +70,9 @@ from dsin_tpu.serve.service import (CompressionService, EncodeResult,
 from dsin_tpu.serve.session import (SessionEntry, SessionError,
                                     SessionExpired, SessionOverCapacity,
                                     SessionStore)
-from dsin_tpu.serve.swap import (ModelBundle, RollbackWatchdog,
-                                 SwapCoordinator, SwapError)
+from dsin_tpu.serve.swap import (ConditionalRollbackRefused, ModelBundle,
+                                 RollbackWatchdog, SwapCoordinator,
+                                 SwapError)
 from dsin_tpu.serve.trace import FlightRecorder, TraceContext, Tracer
 from dsin_tpu.train.checkpoint import ManifestMismatch
 from dsin_tpu.utils.integrity import IntegrityError
@@ -70,10 +83,14 @@ __all__ = [
     "Autoscaler", "AutoscaleConfig", "AutoscaleError",
     "AutoscalePolicy",
     "BucketPolicy", "CanaryFailed", "CompressionService",
-    "DeadlineExceeded",
-    "DevicePlacement", "EncodeResult", "FleetHealthPolicy",
+    "ConditionalRollbackRefused", "DeadlineExceeded",
+    "DevicePlacement", "EncodeResult",
+    "FederatedMetrics", "FederatedRouter", "FederatedTraces",
+    "FederationError", "FederationHealthDriver",
+    "FleetHealthPolicy",
     "FleetHealthSignals", "FleetScaleError", "FleetSwapError",
-    "ScaleSignals",
+    "Member", "MemberUnreachable",
+    "RolloutAborted", "RolloutPlan", "ScaleSignals",
     "FlightRecorder", "FrontDoorRouter", "Future",
     "IntegrityError", "ManifestMismatch", "MetricsRegistry",
     "MetricsServer", "MicroBatcher", "ModelBundle", "NoBucketFits",
@@ -85,6 +102,7 @@ __all__ = [
     "SessionExpired", "SessionOverCapacity", "SessionStore",
     "SwapCoordinator", "SwapError", "TraceContext", "Tracer",
     "crop_from_bucket", "default_priority_classes",
+    "federation_health_from_snapshot",
     "health_from_snapshot", "pad_to_bucket",
     "plan_placement", "signals_from_snapshot",
 ]

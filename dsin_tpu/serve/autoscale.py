@@ -526,3 +526,147 @@ class Autoscaler:
                 "digest": res.get("digest"),
                 "replicas": res.get("replicas"),
                 "skipped": res.get("skipped")}
+
+
+# -- federation tier (ISSUE 18) -----------------------------------------------
+
+def federation_health_from_snapshot(
+        snap: Mapping[str, Any]) -> FleetHealthSignals:
+    """Derive health-policy inputs from one FederatedMetrics snapshot
+    (serve/federation.py) — the same signal shape one tier up: members
+    stand where replicas stood. Restricted to LIVE members (an evicted
+    or partitioned member's sickness is not federation evidence), and
+    each member's canary verdict is its own fleet-level roll-up
+    (`fleet_canary_ok`), so 'unanimous' here means EVERY live member's
+    ENTIRE fleet agrees the model is sick."""
+    info = snap.get("info", {})
+    states = info.get("member_states", {})
+    live = {n for n, s in states.items() if s == "live"}
+    quality = info.get("quality", {})
+    canary = {n: v for n, v in quality.get("canary", {}).items()
+              if n in live and isinstance(v, dict)
+              and v.get("fleet_canary_ok") is not None}
+    failing = [n for n in quality.get("members_canary_failing", [])
+               if n in live]
+    errors = {n: v for n, v in quality.get("member_errors", {}).items()
+              if n in live}
+    return FleetHealthSignals(
+        live_replicas=len(live),
+        canary_failing=len(failing),
+        canary_reporting=len(canary),
+        replica_errors=errors)
+
+
+class FederationHealthDriver:
+    """The PR 14 fleet-health rollback loop lifted to the federation
+    tier: sample the FEDERATED roll-up, run the same unanimous-evidence
+    `FleetHealthPolicy` over member-level signals, and on a fire drive
+    the federation's CONDITIONAL rollback (`expect_digest=<sick>`) —
+    every member already converged by its own driver/watchdog refuses
+    typed and is counted, never fought. This is the backstop BEHIND the
+    rollout machinery: waves catch a sick model during promotion; this
+    loop catches one that soaked clean and went sick later, fleet-wide.
+
+    Mirrors `Autoscaler`'s shape (injectable snapshot_fn + clock,
+    synchronous `tick()`, daemon loop that counts its own errors and
+    never dies). Holds no lock while acting: the `serve.autoscale` rung
+    only serializes the in-flight-tick flag, and a federation rollback
+    acquires `serve.federation` (rank 1, OUTERMOST) which must never
+    sit under it."""
+
+    def __init__(self, federation,
+                 policy: Optional[FleetHealthPolicy] = None,
+                 check_every_s: float = 1.0,
+                 snapshot_fn: Optional[Callable[[], dict]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.federation = federation
+        self.policy = policy or FleetHealthPolicy()
+        self.check_every_s = float(check_every_s)
+        self._snapshot_fn = (snapshot_fn if snapshot_fn is not None
+                             else federation.aggregate.snapshot)
+        self._clock = clock
+        self.metrics = federation.metrics
+        self.flight = federation.flight
+        self._lock = locks_lib.RankedLock("serve.autoscale")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ticking = False             # guarded-by: self._lock
+
+    def start(self) -> "FederationHealthDriver":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="federation-health",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+    def __enter__(self) -> "FederationHealthDriver":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_every_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                self.metrics.counter(
+                    "federation_health_driver_errors").inc()
+                self.flight.record("federation_health_error",
+                                   error=f"{type(e).__name__}: {e}")
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One sample -> decide -> act iteration (see Autoscaler.tick:
+        serialized against itself, a rollback IS slow)."""
+        with self._lock:
+            if self._ticking:
+                return {"skipped": "tick in flight"}
+            self._ticking = True
+        try:
+            return self._tick_locked_out(self._clock()
+                                         if now is None else now)
+        finally:
+            with self._lock:
+                self._ticking = False
+
+    def _tick_locked_out(self, now: float) -> Dict[str, Any]:
+        snap = self._snapshot_fn()
+        reason = self.policy.observe(
+            now, federation_health_from_snapshot(snap))
+        if reason is None:
+            return {"rollback": None}
+        sick = self.federation.params_digest
+        if sick is None:
+            # same refusal as the fleet tier: an unconditional rollback
+            # on an UNKNOWN digest would ping-pong converged members
+            self.metrics.counter(
+                "federation_health_driver_errors").inc()
+            self.flight.record(
+                "federation_health_error", action="rollback",
+                error="federation digest unknown — refusing an "
+                      "unconditional federation rollback")
+            return {"rollback": {"reason": reason,
+                                 "error": "federation digest unknown"}}
+        self.flight.record("federation_rollback", reason=reason,
+                           digest=sick)
+        try:
+            res = self.federation.rollback(expect_digest=sick)
+        except Exception as e:  # noqa: BLE001 — counted, loop lives
+            self.metrics.counter(
+                "federation_health_driver_errors").inc()
+            self.flight.record("federation_health_error",
+                               action="rollback",
+                               error=f"{type(e).__name__}: {e}")
+            return {"rollback": {"reason": reason, "error": str(e)}}
+        self.metrics.counter("federation_health_rollbacks").inc()
+        return {"rollback": {"reason": reason,
+                             "rolled_back_from": sick, **res}}

@@ -181,6 +181,39 @@ def compare_goldens(expected: Dict[str, Any],
     return problems
 
 
+def wave_canary_verdict(quality: Optional[Dict[str, Any]],
+                        expect_digest: str) -> Optional[bool]:
+    """One member's aggregated quality roll-up -> wave-gate verdict for
+    a just-committed digest (ISSUE 18: the rollout wave's canary gate,
+    pure so the federation can poll it and tests can table-drive it).
+
+    Returns False the moment ANY replica reports a failed/errored
+    canary verdict AGAINST `expect_digest` — the probe ran through the
+    new model's real serve path and mismatched, the one signal that
+    must stop a promotion. Returns True only when every live canary
+    verdict in the roll-up covers `expect_digest` and reports "ok"
+    (verdicts still naming the OLD digest mean the prober simply has
+    not rerun since the commit). Anything else — no verdicts yet,
+    partial coverage, "busy"/"raced"/"skipped" statuses — is None:
+    evidence still incomplete, keep polling until the gate's deadline
+    (an expired deadline is the caller's typed failure, never a
+    silent pass)."""
+    canary = (quality or {}).get("canary") or {}
+    if not canary:
+        return None
+    covering = {i: c for i, c in canary.items()
+                if isinstance(c, dict)
+                and c.get("digest") == expect_digest}
+    if any(c.get("status") in ("failed", "error")
+           for c in covering.values()):
+        return False
+    if (len(covering) == len(canary)
+            and all(c.get("status") == "ok"
+                    for c in covering.values())):
+        return True
+    return None
+
+
 #: per-session score history bound: once a session has accumulated 2x
 #: this many scores, its counters HALVE (an exponential decay in O(1)
 #: state) — the running fraction then tracks roughly the last

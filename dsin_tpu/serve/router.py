@@ -884,13 +884,17 @@ class FrontDoorRouter:
     # against the other.
 
     def submit_encode(self, img, deadline_ms: Optional[float] = None,
-                      priority: Optional[str] = None) -> Future:
-        return self._submit("encode", img, priority, deadline_ms)
+                      priority: Optional[str] = None,
+                      trace=None) -> Future:
+        return self._submit("encode", img, priority, deadline_ms,
+                            trace=trace)
 
     def submit_decode(self, blob: bytes,
                       deadline_ms: Optional[float] = None,
-                      priority: Optional[str] = None) -> Future:
-        return self._submit("decode", blob, priority, deadline_ms)
+                      priority: Optional[str] = None,
+                      trace=None) -> Future:
+        return self._submit("decode", blob, priority, deadline_ms,
+                            trace=trace)
 
     def encode(self, img, deadline_ms: Optional[float] = None,
                timeout: Optional[float] = 120.0,
@@ -905,7 +909,7 @@ class FrontDoorRouter:
                                   priority=priority).result(timeout)
 
     def _submit(self, op: str, payload, priority: Optional[str],
-                deadline_ms: Optional[float]) -> Future:
+                deadline_ms: Optional[float], trace=None) -> Future:
         assert self._started, "start() the router before submitting"
         cls = priority or self._class_names[0]
         try:
@@ -915,9 +919,13 @@ class FrontDoorRouter:
             raise
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms.get(cls)
+        # an externally-minted context (the federation tier, ISSUE 18)
+        # rides through unchanged — its head sampling decision already
+        # happened, so one trace id stitches across both router tiers
         pending = _Pending(op, payload, cls, deadline_ms,
                            self.death_retries,
-                           trace=self.tracer.mint(origin="router"))
+                           trace=(trace if trace is not None else
+                                  self.tracer.mint(origin="router")))
         self.admission.attach(cls, pending.future)
         self._attach_trace(pending, op, cls)
         try:
@@ -1054,7 +1062,8 @@ class FrontDoorRouter:
 
     def submit_decode_si(self, blob: bytes, session_id: str,
                          deadline_ms: Optional[float] = None,
-                         priority: Optional[str] = None) -> Future:
+                         priority: Optional[str] = None,
+                         trace=None) -> Future:
         """SI decode against a pinned session. An unknown pin, an
         evicted/dead pinned replica, or the replica dying mid-flight
         all answer typed `SessionExpired` — the prep existed in exactly
@@ -1079,7 +1088,8 @@ class FrontDoorRouter:
             deadline_ms = self._default_deadline_ms.get(cls)
         pending = _Pending("decode_si", (blob, session_id), cls,
                            deadline_ms, 0,
-                           trace=self.tracer.mint(origin="router"))
+                           trace=(trace if trace is not None else
+                                  self.tracer.mint(origin="router")))
         self.admission.attach(cls, pending.future)
         self._attach_trace(pending, "decode_si", cls)
         self._swap_gate.wait(_SWAP_GATE_TIMEOUT_S)
@@ -2140,16 +2150,11 @@ class AggregatedMetrics:
         counters = dict(own["counters"])
         gauges = dict(own["gauges"])
         accumulators = dict(own["accumulators"])
-        # histogram partials:
-        # name -> [count_total, weighted_sum, p50s, p99s, mins, maxs]
-        # (min/max fold across the fleet — the ISSUE 13 alarm tails,
-        # e.g. the worst coding gap any replica ever saw, must survive
-        # the merge; guarded with `in` for replicas predating them)
-        hist: Dict[str, list] = {
-            k: [s["count"], s["mean"] * s["count"], [s["p50"]], [s["p99"]],
-                [s["min"]] if "min" in s else [],
-                [s["max"]] if "max" in s else []]
-            for k, s in own["histograms"].items()}
+        # histogram partials ride the shared two-tier merge helpers
+        # (serve/metrics.py, ISSUE 18) — the federation applies the
+        # identical rules to MEMBER roll-ups, one implementation
+        hist: Dict[str, list] = metrics_lib.hist_partials(
+            own["histograms"])
         per_replica_info: Dict[str, dict] = {}
         digests: Dict[str, Optional[str]] = {}
         unreachable = []
@@ -2218,22 +2223,8 @@ class AggregatedMetrics:
                 digests[str(rep.idx)] = (rep.info or {}).get(
                     "params_digest")
                 continue
-            for k, v in snap.get("counters", {}).items():
-                counters[k] = counters.get(k, 0) + v
-            for k, v in snap.get("gauges", {}).items():
-                gauges[k] = gauges.get(k, 0.0) + v
-            for k, v in snap.get("accumulators", {}).items():
-                accumulators[k] = accumulators.get(k, 0.0) + v
-            for k, s in snap.get("histograms", {}).items():
-                part = hist.setdefault(k, [0, 0.0, [], [], [], []])
-                part[0] += s["count"]
-                part[1] += s["mean"] * s["count"]
-                part[2].append(s["p50"])
-                part[3].append(s["p99"])
-                if "min" in s:
-                    part[4].append(s["min"])
-                if "max" in s:
-                    part[5].append(s["max"])
+            metrics_lib.merge_numeric_sections(
+                counters, gauges, accumulators, hist, snap)
             info = snap.get("info", {})
             per_replica_info[str(rep.idx)] = info
             model = info.get("serve_model_digest") or {}
@@ -2256,15 +2247,7 @@ class AggregatedMetrics:
                 "resolved": snap.get("counters", {}).get(
                     "serve_resolved", 0),
             }
-        histograms = {
-            k: {"count": c,
-                "mean": (wsum / c) if c else 0.0,
-                "p50": max(p50s) if p50s else 0.0,
-                "p99": max(p99s) if p99s else 0.0,
-                **({"min": min(mins)} if mins else {}),
-                **({"max": max(maxs)} if maxs else {})}
-            for k, (c, wsum, p50s, p99s, mins, maxs)
-            in sorted(hist.items())}
+        histograms = metrics_lib.fold_hist_partials(hist)
         # fleet model-health roll-up (ISSUE 13): the per-bucket gap/bpp
         # histograms merge through the generic rules above; the canary
         # verdicts are per-replica structural facts, so the aggregate
@@ -2306,6 +2289,14 @@ class AggregatedMetrics:
             # its port)
             "locks": own["locks"],
             "lock_order_inversions": own["lock_order_inversions"],
+            # freshness evidence one tier up (ISSUE 18): the federation
+            # applies the exact seq-equality + capture-age protocol to
+            # MEMBER scrapes that this view applies to replica scrapes,
+            # so the aggregate must carry its own router registry's seq
+            # and capture timestamp (a frozen/cached member response
+            # replays the identical pair)
+            "seq": own.get("seq"),
+            "captured_at": own.get("captured_at"),
         }
 
     def render_text(self) -> str:

@@ -62,6 +62,16 @@ class SwapError(RuntimeError):
     bundle — a refused swap is an operator error, never an outage."""
 
 
+class ConditionalRollbackRefused(SwapError):
+    """A CONDITIONAL rollback (`expect_current=`) found the service
+    already serving a different digest — this replica never committed
+    the model being rolled away, so refusing is CONVERGENCE, not
+    failure. Typed as its own class (ISSUE 18) so fleet- and
+    federation-tier drivers can classify the refusal structurally; the
+    message keeps the historical "conditional rollback refused" stem
+    callers already string-match across the replica pipe."""
+
+
 class ModelBundle:
     """One model version, whole: everything any dataplane stage reads.
 
@@ -320,7 +330,7 @@ class SwapCoordinator:
                                 "model bundle is retained)")
             if expect_current is not None \
                     and self._current.digest != expect_current:
-                raise SwapError(
+                raise ConditionalRollbackRefused(
                     f"conditional rollback refused: serving digest "
                     f"{self._current.digest!r} is not the expected "
                     f"{expect_current!r} (this replica never committed "

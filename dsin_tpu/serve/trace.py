@@ -78,6 +78,7 @@ SPAN_ENTROPY_PROC = "batch.entropy.proc"  # child-side coding (process backend)
 SPAN_SI_SEARCH = "batch.si_search"  # fused decode->siFinder->siNet executable
 SPAN_SESSION = "session.lookup"     # SI session store lookup at batch start
 SPAN_ROUTER = "router.dispatch"     # front-door send -> future resolution
+SPAN_FEDERATION = "federation.dispatch"  # federation hop -> member resolution
 SPAN_ERROR = "error"                # typed-error resolution (always recorded)
 
 

@@ -27,6 +27,14 @@ The repo lock hierarchy (rank ascending = acquire order outer->inner;
 a thread holding rank r may only acquire ranks > r):
 
     rank  name                where
+       1  serve.federation    federation member table / session pin map /
+                              rollout-wave state (serve/federation.py)
+                              — OUTERMOST rank of all: a federation
+                              control op (wave promote, member evict,
+                              reconcile) may call into a member
+                              router's swap/rollback/health machinery,
+                              which acquires serve.autoscale (2),
+                              serve.frontdoor (4) and serve.replica (6)
        2  serve.autoscale     autoscaler control-loop state (serve/autoscale.py)
                               — OUTERMOST serve rank: one tick may hold
                               it across router.add_replica/drain_replica/
@@ -112,6 +120,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 #: the repo-wide lock hierarchy: name -> rank. See the module docstring
 #: for the rationale per rung.
 HIERARCHY: Dict[str, int] = {
+    "serve.federation": 1,
     "serve.autoscale": 2,
     "serve.template": 3,
     "serve.frontdoor": 4,

@@ -170,6 +170,122 @@ def test_referenced_digests_handles_router_and_service_shapes():
     assert referenced_digests({}) == set()
 
 
+def _federation_snap(member_digests, per_member=None,
+                     members_unreachable=(), members_stale=()):
+    return {"info": {
+        "member_digests": dict(member_digests),
+        "per_member": dict(per_member or {}),
+        "members_unreachable": list(members_unreachable),
+        "members_stale": list(members_stale),
+    }}
+
+
+def test_referenced_digests_walks_the_federation_shape():
+    """ISSUE 18: a federation snapshot nests whole MEMBER roll-ups
+    under `per_member` — every member's replica handshake digests AND
+    every replica's current/prev/staged slots must land in the
+    reference set, or a federation-scoped GC deletes a checkpoint a
+    member two tiers down is serving."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from ckpt_gc import referenced_digests
+    snap = _federation_snap(
+        {"m0": "hm0", "m1": None},
+        per_member={
+            "m0": {
+                "replica_digests": {"0": "h00"},
+                "per_replica": {"0": {"serve_model_digest": {
+                    "digest": "cur0", "prev_digest": "prv0",
+                    "staged_digest": "stg0"}}},
+            },
+            "m1": {"replica_digests": {"0": "h10"}},
+        })
+    assert referenced_digests(snap) == {"hm0", "h00", "cur0", "prv0",
+                                        "stg0", "h10"}
+
+
+def test_blind_spots_counts_both_federation_tiers():
+    """An unreachable/stale MEMBER hides its whole fleet; a reachable
+    member's own roll-up can still be partially blind to replicas —
+    both must trip the refusal gate."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from ckpt_gc import blind_spots
+    snap = _federation_snap(
+        {"m0": "h"}, members_unreachable=["m2"], members_stale=["m3"],
+        per_member={"m0": {"replicas_unreachable": ["1"],
+                           "replicas_stale": []}})
+    assert blind_spots(snap) == 3
+
+
+def test_gc_kill_window_repolls_every_federation_member(tmp_path):
+    """The satellite-3 regression: `gc_checkpoints` must consult a
+    FRESH federation-wide reference set before EACH deletion. Member
+    m1 stages the second candidate between the initial scan and its
+    rm; the refresh (which re-polls every member, exactly like the
+    tool's --metrics_url closure over a federation endpoint) must save
+    it — and a refresh that can no longer see every member (a member
+    partitions away mid-GC) must keep everything."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from ckpt_gc import blind_spots, referenced_digests
+    _fake_ckpt(tmp_path, "ckpt_live", "dlive", step=3)
+    _fake_ckpt(tmp_path, "ckpt_c1", "dc1", step=2)
+    _fake_ckpt(tmp_path, "ckpt_c2", "dc2", step=1)
+    members = {
+        "m0": {"per_replica": {"0": {"serve_model_digest": {
+            "digest": "dlive"}}}},
+        "m1": {"per_replica": {"0": {"serve_model_digest": {
+            "digest": "dlive"}}}},
+    }
+    polls = []
+
+    def fed_snapshot():
+        # every member re-polled per refresh (the federation aggregate
+        # fans out to all members on each snapshot call)
+        polls.append(sorted(members))
+        return _federation_snap({}, per_member=members)
+
+    def refresh():
+        snap = fed_snapshot()
+        if blind_spots(snap):
+            return None
+        refs = referenced_digests(snap)
+        # first deletion edge: m1 stages dc2 mid-GC (AFTER this poll
+        # answered — the NEXT edge's re-poll must see it)
+        members["m1"]["per_replica"]["0"]["serve_model_digest"][
+            "staged_digest"] = "dc2"
+        return refs
+
+    report = gc_checkpoints(
+        str(tmp_path), referenced_digests(fed_snapshot()),
+        keep_latest=0, refresh=refresh)
+    assert len(polls) >= 2, "members were not re-polled per deletion"
+    assert all(p == ["m0", "m1"] for p in polls)
+    retired = {r["dir"] for r in report["retired"]}
+    kept = {k["dir"]: k["why"] for k in report["kept"]}
+    # dc1 was unreferenced at its (fresh) deletion edge: retired.
+    # dc2 became referenced by m1 between the scan and its rm: KEPT.
+    assert retired == {"ckpt_c1"}
+    assert kept["ckpt_c2"] == "referenced_at_delete"
+    assert (tmp_path / "ckpt_c2").exists()
+
+    # now a member partitions away mid-GC: the refresh sees the blind
+    # spot and fails toward keeping everything still unreferenced
+    _fake_ckpt(tmp_path, "ckpt_c3", "dc3", step=0)
+
+    def blind_refresh():
+        snap = _federation_snap({}, per_member={"m0": members["m0"]},
+                                members_unreachable=["m1"])
+        if blind_spots(snap):
+            return None
+        return referenced_digests(snap)
+
+    report = gc_checkpoints(str(tmp_path), {"dlive"}, keep_latest=0,
+                            refresh=blind_refresh)
+    assert report["retired"] == []
+    assert (tmp_path / "ckpt_c3").exists()
+    kept = {k["dir"]: k["why"] for k in report["kept"]}
+    assert kept["ckpt_c3"] == "reference_source_unreachable"
+
+
 def _run_tool(*args):
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "ckpt_gc.py"),

@@ -67,6 +67,7 @@ THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
     ("dsin_tpu", "serve", "autoscale.py"),  # elastic-fleet loop (ISSUE 14)
     ("dsin_tpu", "serve", "shmlane.py"),  # shm lane transport (ISSUE 17)
     ("dsin_tpu", "serve", "protocol.py"),  # wire-tuple helpers (ISSUE 17)
+    ("dsin_tpu", "serve", "federation.py"),  # federated tier (ISSUE 18)
     ("dsin_tpu", "coding", "codec.py"),
     ("dsin_tpu", "coding", "incremental.py"),
     ("dsin_tpu", "coding", "rans.py"),

@@ -445,6 +445,40 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert rd["hung_futures"] == 0 and rd["untyped_errors"] == 0
     assert tr["shm_census"]["after"] == tr["shm_census"]["before"]
     assert tr["lock_order_inversions"] == 0
+    # ISSUE 18: the federated fleet battery rides every chaos run —
+    # pin its scenario shape so a silent removal cannot pass
+    fe = report["federation"]
+    assert fe["violations"] == []
+    fsc = fe["scenarios"]
+    st = fsc["federation_trace_stitch"]
+    assert st["stitched"] is True
+    assert "federation.dispatch" in st["span_names"]
+    assert "router.dispatch" in st["span_names"]
+    ro = fsc["staged_rollout"]
+    assert ro["digest_b"] != ro["digest_a"]
+    assert ro["torn_versions"] == []
+    assert ro["bit_identical_members"] is True
+    assert all(ro["distributed_roots_staged"].values())
+    wc = fsc["wave_canary_failure"]
+    assert wc["aborted_typed"] is True and wc["abort_wave"] == 0
+    assert wc["torn_versions"] == []
+    assert wc["bit_identical_after"] is True
+    pm = fsc["partition_mid_rollout"]
+    assert pm["aborted_typed"] is True and pm["abort_wave"] == 1
+    assert pm["hung_futures"] == 0 and pm["untyped_errors"] == 0
+    assert pm["survivors_bit_identical"] is True
+    assert pm["reconciled"] is True and pm["reconciles"] >= 1
+    assert pm["torn_versions"] == []
+    md = fsc["member_death_pinned_sessions"]
+    assert md["evicted"] is True
+    assert md["victim_session_expired_typed"] is True
+    assert md["survivor_session_ok"] is True
+    assert sum(md["admission_limits_after"].values()) < \
+        sum(md["admission_limits_before"].values())
+    assert md["hung_futures"] == 0 and md["untyped_errors"] == 0
+    assert fe["steady_compiles"] == 0
+    assert fe["lock_order_inversions"] == 0
+    assert fe["flight_recorder"]["dumps"] >= 1
     # ISSUE 11: every injected-fault battery must leave a non-empty
     # flight-recorder dump behind (the replayable incident timeline)
     fr = report["flight_recorder"]

@@ -85,6 +85,24 @@ typed, non-empty flight dumps, zero steady-state compiles.
 `--degraded_only` runs just this battery (the `quality-smoke`
 tpu_session.sh stage pairs it with serve_bench --quality).
 
+Federation battery (ISSUE 18): every run also soaks the FEDERATED
+FLEET tier (serve/federation.py) — a router-of-routers over three real
+member fleets: (1) one trace id stitched across BOTH router tiers;
+(2) a staged rollout promoting wave by wave behind the wave canary
+gate + soak window, with `replicate_checkpoint` distribution into
+member checkpoint roots; (3) a bit-flipped model force-committed onto
+wave 0, caught by the wave canary through the member's real serve
+path and rolled back with zero torn versions; (4) a member
+PARTITIONED away mid-rollout — typed abort, prior-wave rollback,
+ack-eaten member-side commit, and the heal-time aborted-digest
+reconcile converging it without fighting; (5) a member's whole fleet
+dying with sessions pinned to it — scrape-evidence eviction, typed
+SessionExpired pins, shrinking hierarchical admission budget.
+Invariants: zero hung futures, all failures typed AND counted per
+member, zero torn versions, survivors bit-identical, budget-0
+compiles, non-empty flight dumps. `--federation_only` runs just this
+battery (the fail-fast `federation-bench` tpu_session.sh stage).
+
 Emits a CHAOS_BENCH.json artifact. `--smoke` is the tier-1 CI entry
 (tests/test_tools_smoke.py) and the `chaos-smoke` stage of
 tools/tpu_session.sh.
@@ -1963,6 +1981,488 @@ def run_transport(args) -> dict:
     }
 
 
+def run_federation(args) -> dict:
+    """The federated fleet battery (ISSUE 18): a router-of-routers over
+    THREE real single-replica member fleets (thread replicas, the same
+    tier-1 stand-in every other battery uses), five scenarios:
+
+    * federation_trace_stitch — one encode through the federation door
+      resolves, by ONE trace id, to the federation hop PLUS the member
+      router hop PLUS the replica-internal spans (both tiers stitched).
+    * staged_rollout — a good model promotes wave by wave (m0, then
+      m1+m2), each wave gated by the real wave canary and a soak
+      window, the manifest distributed into member checkpoint roots via
+      the CRC-verified replicate path; the whole federation converges
+      bit-identical on the new digest.
+    * wave_canary_failure — a bit-flipped model PROMISING the good
+      twin's goldens force-commits onto wave 0; the wave canary gate
+      catches it through the member's real serve path, the wave rolls
+      back conditionally, and the typed abort leaves zero torn
+      versions (m1/m2 never left the old digest).
+    * partition_mid_rollout — a member partitions away after wave 0
+      commits; the rollout aborts typed, prior waves roll back, the
+      partitioned member's ack-eaten commit lands member-side, and on
+      heal the aborted-digest reconcile converges it WITHOUT fighting:
+      zero torn versions, zero hung futures, survivors bit-identical
+      throughout.
+    * member_death_pinned_sessions — a member's whole fleet dies with
+      sessions pinned to it: the federation evicts it on scrape
+      evidence, its pins answer typed SessionExpired, a survivor's pin
+      keeps serving, and the hierarchical admission budget shrinks.
+
+    Budget-0 compiles hold across every rollout/rollback/heal; the
+    federation flight recorder leaves a non-empty incident dump."""
+    import tempfile
+    import threading
+
+    from dsin_tpu.coding.loader import load_model_state
+    from dsin_tpu.serve import ServeError, ServiceConfig, SessionExpired
+    from dsin_tpu.serve.federation import (FederatedRouter, Member,
+                                           RolloutAborted, RolloutPlan)
+    from dsin_tpu.serve.router import FrontDoorRouter
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.utils import locks
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    assert locks.enforcement_enabled(), \
+        "lock-discipline checks are disabled — the federation soak needs them"
+
+    buckets = [(16, 24), (32, 48)]
+    flight_dir = tempfile.mkdtemp(prefix="chaos_federation_flight_")
+    tmpd = tempfile.mkdtemp(prefix="chaos_federation_")
+
+    def make_config():
+        return ServiceConfig(
+            ae_config=args.ae_config, pc_config=args.pc_config,
+            ckpt=args.ckpt, seed=args.seed, buckets=buckets,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue=args.max_queue, workers=args.workers,
+            entropy_workers=args.entropy_workers,
+            entropy_backend=args.entropy_backend,
+            pipeline_depth=args.pipeline_depth, enable_si=True,
+            session_max=8, canary_every_s=0.15,
+            quality_gap_sample_rate=1.0,
+            trace_sample_rate=1.0)
+
+    # three member fleets, one real thread replica each; m1/m2 get
+    # checkpoint roots (the replicate_checkpoint distribution path),
+    # m0 swaps straight from the shared dir (both shapes in one run)
+    names = ("m0", "m1", "m2")
+    fleets, routers, member_of = {}, {}, {}
+    members = []
+    for name in names:
+        fleet = _ThreadReplicas(make_config)
+        router = FrontDoorRouter(
+            make_config(), replicas=1, launcher=fleet.launcher,
+            poll_every_s=0.2).start()
+        fleets[name], routers[name] = fleet, router
+        root = (os.path.join(tmpd, f"root_{name}")
+                if name != "m0" else None)
+        m = Member(name, router, ckpt_root=root,
+                   control_timeout_s=args.timeout_s)
+        member_of[name] = m
+        members.append(m)
+    fed = FederatedRouter(members, poll_every_s=0.1, evict_after=2,
+                          trace_sample_rate=1.0,
+                          flight_dir=flight_dir).start()
+
+    rng = np.random.default_rng(args.seed + 23)
+    img = rng.integers(0, 255, (buckets[0][0], buckets[0][1], 3),
+                       dtype=np.uint8)
+    violations = []
+    scenarios = {}
+    inversions_before = locks.inversion_count()
+    t0 = time.monotonic()
+    digest_a = fed.params_digest
+    a_stream = fed.encode(img, timeout=args.timeout_s).stream
+    if any(routers[n].encode(img, timeout=args.timeout_s).stream
+           != a_stream for n in names):
+        violations.append("setup: members are not bit-identical on "
+                          "the seed model")
+
+    def _sweep():
+        """Every version slot across both tiers — the torn-version
+        evidence (a committed federation must show ONE digest in every
+        live router AND every live replica service)."""
+        return {n: {"router": routers[n].params_digest,
+                    "replica": fleets[n].services[0].model_digest}
+                for n in names}
+
+    def _torn(expected, sweep, skip=()):
+        return sorted(
+            f"{n}.{slot}={d!r}" for n, slots in sweep.items()
+            if n not in skip for slot, d in slots.items()
+            if d != expected)
+
+    # checkpoint publishing happens BEFORE the sentinel opens (model
+    # builds compile; everything the federation DOES afterwards must
+    # not) — the publish flow mirrors run_degraded/run_autoscale
+    model_b, state_b = load_model_state(
+        args.ae_config, args.pc_config, None, tuple(buckets[-1]),
+        need_sinet=True, seed=args.seed + 1)
+    extra = {"pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+             "seed": args.seed + 1,
+             "buckets": [list(b) for b in buckets]}
+    ckpt_b = os.path.join(tmpd, "ckpt_b")
+    ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra=extra)
+    publisher = fleets["m0"].services[0]
+    publisher.prepare_swap(ckpt_b, canary=False)
+    goldens = publisher.canary_goldens(staged=True)
+    publisher.abort_swap()
+    ckpt_bad = os.path.join(tmpd, "ckpt_bad")
+    ckpt_lib.save_checkpoint(
+        ckpt_bad, _bitflip_params(state_b),
+        manifest_extra={**extra, "canary": goldens})
+    model_c, state_c = load_model_state(
+        args.ae_config, args.pc_config, None, tuple(buckets[-1]),
+        need_sinet=True, seed=args.seed + 2)
+    ckpt_c = os.path.join(tmpd, "ckpt_c")
+    ckpt_lib.save_checkpoint(
+        ckpt_c, state_c, manifest_extra={
+            "pc_config_sha256": ckpt_lib.config_sha256(model_c.pc_config),
+            "seed": args.seed + 2,
+            "buckets": [list(b) for b in buckets]})
+
+    with CompilationSentinel(budget=0, label="federation steady state",
+                             raise_on_exceed=False) as sentinel:
+        # -- (1) one trace id stitched across BOTH router tiers -------
+        fut = fed.submit_encode(img)
+        fut.result(args.timeout_s)
+        tid = fut.trace.trace_id if fut.trace else None
+        need = {"federation.dispatch", "router.dispatch", "queue.wait",
+                "batch.device", "batch.entropy"}
+        span_names = set()
+        merged = {"members_scraped": 0}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            merged = fed.traces.snapshot(trace_id=tid)
+            span_names = {s["name"] for s in merged["spans"]}
+            if need <= span_names:
+                break
+            time.sleep(0.05)
+        missing = sorted(need - span_names)
+        if tid is None or missing:
+            violations.append(
+                f"federation_trace_stitch: trace {tid} is missing "
+                f"spans {missing} (got {sorted(span_names)})")
+        scenarios["federation_trace_stitch"] = {
+            "trace_id": tid, "span_names": sorted(span_names),
+            "stitched": not missing,
+            "members_scraped": merged["members_scraped"],
+        }
+
+        # -- (2) staged rollout: good model promotes wave by wave -----
+        plan_b = RolloutPlan(
+            ckpt_dir=ckpt_b, waves=(("m0",), ("m1", "m2")),
+            canary_timeout_s=args.timeout_s, poll_s=0.05, soak_s=0.3,
+            swap_timeout_s=args.timeout_s,
+            rollback_timeout_s=args.timeout_s)
+        res_b = fed.rollout(plan_b)
+        digest_b = res_b["digest"]
+        sweep = _sweep()
+        torn = _torn(digest_b, sweep)
+        b_stream = fed.encode(img, timeout=args.timeout_s).stream
+        member_streams = {
+            n: routers[n].encode(img, timeout=args.timeout_s).stream
+            for n in names}
+        staged_roots = {
+            n: bool(member_of[n].ckpt_root and ckpt_lib.latest_checkpoint(
+                member_of[n].ckpt_root)) for n in ("m1", "m2")}
+        if digest_b == digest_a:
+            violations.append("staged_rollout: promotion did not "
+                              "change the federation digest")
+        if torn:
+            violations.append(f"staged_rollout: torn versions after "
+                              f"full promotion: {torn}")
+        if any(s != b_stream for s in member_streams.values()):
+            violations.append("staged_rollout: members are not "
+                              "bit-identical on the promoted model")
+        if not all(staged_roots.values()):
+            violations.append(f"staged_rollout: replicate_checkpoint "
+                              f"left no staged manifest in member "
+                              f"roots ({staged_roots})")
+        scenarios["staged_rollout"] = {
+            "digest_a": digest_a, "digest_b": digest_b,
+            "waves": res_b["waves"], "version_sweep": sweep,
+            "torn_versions": torn,
+            "distributed_roots_staged": staged_roots,
+            "bit_identical_members": all(
+                s == b_stream for s in member_streams.values()),
+            "rollout_waves": fed.metrics.counter(
+                "federation_rollout_waves").value,
+        }
+
+        # -- (3) wave canary failure: bit-flipped model force-commits
+        # onto wave 0, the wave gate catches it through the REAL serve
+        # path, the wave rolls back, m1/m2 never tear ----------------
+        plan_bad = RolloutPlan(
+            ckpt_dir=ckpt_bad, waves=(("m0",), ("m1", "m2")),
+            canary_timeout_s=args.timeout_s, poll_s=0.05, soak_s=0.0,
+            swap_timeout_s=args.timeout_s,
+            rollback_timeout_s=args.timeout_s)
+        fleets["m0"].prepare_canary = False
+        aborted_bad = None
+        try:
+            fed.rollout(plan_bad)
+        except RolloutAborted as e:
+            aborted_bad = e
+        finally:
+            fleets["m0"].prepare_canary = True
+        sweep = _sweep()
+        torn = _torn(digest_b, sweep)
+        post = fed.encode(img, timeout=args.timeout_s).stream
+        if aborted_bad is None:
+            violations.append("wave_canary_failure: the bad model "
+                              "promoted — the wave canary gate never "
+                              "fired")
+        elif aborted_bad.wave != 0 or "canary" not in aborted_bad.reason:
+            violations.append(
+                f"wave_canary_failure: aborted for the wrong reason "
+                f"(wave {aborted_bad.wave}: {aborted_bad.reason})")
+        if torn:
+            violations.append(f"wave_canary_failure: torn versions "
+                              f"after the abort: {torn}")
+        if post != b_stream:
+            violations.append("wave_canary_failure: good-model "
+                              "bit-identity lost after the wave "
+                              "rollback")
+        scenarios["wave_canary_failure"] = {
+            "aborted_typed": aborted_bad is not None,
+            "abort_wave": getattr(aborted_bad, "wave", None),
+            "abort_reason": getattr(aborted_bad, "reason", None),
+            "wave0_outcome": (aborted_bad.per_wave.get(0, {}).get("m0")
+                              if aborted_bad else None),
+            "version_sweep": sweep, "torn_versions": torn,
+            "bit_identical_after": post == b_stream,
+            "rollout_aborts": fed.metrics.counter(
+                "federation_rollout_aborts").value,
+            "wave_rollbacks": fed.metrics.counter(
+                "federation_rollout_wave_rollbacks").value,
+        }
+
+        # -- (4) partition mid-rollout + heal-time reconcile ----------
+        plan_c = RolloutPlan(
+            ckpt_dir=ckpt_c, waves=(("m0",), ("m1", "m2")),
+            canary_timeout_s=args.timeout_s, poll_s=0.05, soak_s=1.0,
+            swap_timeout_s=args.timeout_s,
+            rollback_timeout_s=args.timeout_s,
+            rollback_prior_waves=True)
+
+        def _ambush():
+            # partition m1 the moment wave 0 commits (m0's digest
+            # moves): the rollout is mid-flight, wave 1 not yet started
+            amb_deadline = time.monotonic() + args.timeout_s
+            while time.monotonic() < amb_deadline:
+                if routers["m0"].params_digest not in (None, digest_b):
+                    member_of["m1"].partition()
+                    return
+                time.sleep(0.005)
+
+        amb = threading.Thread(target=_ambush, name="chaos-fed-ambush")
+        amb.start()
+        aborted_c = None
+        try:
+            fed.rollout(plan_c)
+        except RolloutAborted as e:
+            aborted_c = e
+        amb.join(args.timeout_s)
+        if aborted_c is None:
+            violations.append("partition_mid_rollout: the rollout "
+                              "PROMOTED across a partitioned member")
+        elif aborted_c.wave != 1:
+            violations.append(
+                f"partition_mid_rollout: aborted at wave "
+                f"{aborted_c.wave}, not at the partitioned wave "
+                f"({aborted_c.reason})")
+        # survivors serve bit-identical while m1 is still gone; the
+        # in-flight burst must resolve with zero hung, zero untyped
+        futures = []
+        for _ in range(8):
+            try:
+                futures.append(fed.submit_encode(img))
+            except ServeError:
+                pass
+        counts, hung = _await_all(futures, args.timeout_s)
+        survivor_stream = fed.encode(img, timeout=args.timeout_s).stream
+        if hung:
+            violations.append(f"partition_mid_rollout: {hung} hung "
+                              f"futures during the partition")
+        if counts["untyped"]:
+            violations.append(f"partition_mid_rollout: "
+                              f"{counts['untyped']} untyped errors")
+        if survivor_stream != b_stream:
+            violations.append("partition_mid_rollout: survivors lost "
+                              "good-model bit-identity after the "
+                              "abort")
+        # the ack-eaten commit: the partition swallowed the swap's
+        # answer, but the MEMBER-side commit landed — m1 now serves
+        # the digest the federation rolled away from
+        routers["m1"].swap_model(ckpt_c,
+                                 prepare_timeout_s=args.timeout_s)
+        stranded = routers["m1"].params_digest
+        member_of["m1"].heal()
+        reconciled = False
+        deadline = time.monotonic() + args.timeout_s
+        while time.monotonic() < deadline:
+            if (fed.health()["members"].get("m1") == "live"
+                    and routers["m1"].params_digest == digest_b):
+                reconciled = True
+                break
+            time.sleep(0.05)
+        reconciles = fed.metrics.counter("federation_reconciles").value
+        sweep = _sweep()
+        torn = _torn(digest_b, sweep)
+        if not reconciled or reconciles < 1:
+            violations.append(
+                f"partition_mid_rollout: the healed member never "
+                f"reconciled off the aborted digest "
+                f"({reconciles} reconciles, m1 state "
+                f"{fed.health()['members'].get('m1')!r}, digest "
+                f"{routers['m1'].params_digest!r})")
+        if torn:
+            violations.append(f"partition_mid_rollout: torn versions "
+                              f"after the heal: {torn}")
+        scenarios["partition_mid_rollout"] = {
+            "aborted_typed": aborted_c is not None,
+            "abort_wave": getattr(aborted_c, "wave", None),
+            "abort_reason": getattr(aborted_c, "reason", None),
+            "prior_wave_outcome": (
+                aborted_c.per_wave.get(0, {}).get("m0")
+                if aborted_c else None),
+            "stranded_digest": stranded,
+            "completed_ok": counts["ok"],
+            "typed_errors": counts["typed"],
+            "untyped_errors": counts["untyped"], "hung_futures": hung,
+            "survivors_bit_identical": survivor_stream == b_stream,
+            "reconciled": reconciled, "reconciles": reconciles,
+            "readmissions": fed.metrics.counter(
+                "federation_member_readmissions").value,
+            "version_sweep": sweep, "torn_versions": torn,
+        }
+
+        # -- (5) member death with pinned sessions --------------------
+        pins = {}
+        for _ in range(6):
+            sid = fed.open_session(img, timeout=args.timeout_s)
+            with fed._lock:
+                pins[sid] = fed._sessions[sid]
+            if "m2" in pins.values() and len(set(pins.values())) >= 2:
+                break
+        victim_sid = next(s for s, n in pins.items() if n == "m2")
+        survivor_sid = next(s for s, n in pins.items() if n != "m2")
+        limits_before = dict(fed.admission.limits)
+        stream = fed.encode(img, timeout=args.timeout_s).stream
+        futures = []
+        for _ in range(4):
+            try:
+                futures.append(fed.submit_encode(img))
+            except ServeError:
+                pass
+        fleets["m2"].kill(0)
+        evicted = False
+        deadline = time.monotonic() + args.timeout_s
+        while time.monotonic() < deadline:
+            if fed.health()["members"].get("m2") == "evicted":
+                evicted = True
+                break
+            time.sleep(0.05)
+        counts, hung = _await_all(futures, args.timeout_s)
+        victim_typed = None
+        try:
+            fed.decode_si(stream, victim_sid, timeout=args.timeout_s)
+            victim_typed = False
+        except SessionExpired:
+            victim_typed = True
+        except Exception:  # noqa: BLE001 — wrong type = violation
+            victim_typed = False
+        try:
+            fed.decode_si(stream, survivor_sid, timeout=args.timeout_s)
+            survivor_ok = True
+        except Exception:  # noqa: BLE001 — survivor must serve
+            survivor_ok = False
+        limits_after = dict(fed.admission.limits)
+        if not evicted:
+            violations.append("member_death_pinned_sessions: the dead "
+                              "member was never evicted on scrape "
+                              "evidence")
+        if victim_typed is not True:
+            violations.append("member_death_pinned_sessions: the dead "
+                              "member's pinned session did not expire "
+                              "TYPED")
+        if not survivor_ok:
+            violations.append("member_death_pinned_sessions: a "
+                              "survivor's pinned session stopped "
+                              "serving")
+        if sum(limits_after.values()) >= sum(limits_before.values()):
+            violations.append(
+                f"member_death_pinned_sessions: the hierarchical "
+                f"admission budget did not shrink with the member "
+                f"({limits_before} -> {limits_after})")
+        if hung:
+            violations.append(f"member_death_pinned_sessions: {hung} "
+                              f"hung futures")
+        if counts["untyped"]:
+            violations.append(f"member_death_pinned_sessions: "
+                              f"{counts['untyped']} untyped errors")
+        scenarios["member_death_pinned_sessions"] = {
+            "pins": {s: n for s, n in pins.items()},
+            "evicted": evicted,
+            "victim_session_expired_typed": victim_typed,
+            "survivor_session_ok": survivor_ok,
+            "admission_limits_before": limits_before,
+            "admission_limits_after": limits_after,
+            "completed_ok": counts["ok"],
+            "typed_errors": counts["typed"],
+            "untyped_errors": counts["untyped"], "hung_futures": hung,
+            "member_evictions": fed.metrics.counter(
+                "federation_member_evictions").value,
+        }
+    if sentinel.compilations:
+        violations.append(f"federation battery: {sentinel.compilations} "
+                          f"steady-state compiles across rollout/"
+                          f"rollback/heal")
+
+    fed.flight.flush(timeout=10.0)
+    flight_meta = fed.flight.meta()
+    last_events = 0
+    if flight_meta["last_dump_path"]:
+        with open(flight_meta["last_dump_path"]) as f:
+            last_events = sum(1 for _ in f) - 1
+    if flight_meta["dumps"] < 1 or last_events < 1:
+        violations.append(
+            f"federation battery left no non-empty flight dump "
+            f"({flight_meta['dumps']} dumps, last had {last_events} "
+            f"events)")
+    counters = fed.metrics.snapshot()["counters"]
+    # the satellite-2 audit surface: every cross-process call failure
+    # on the federation path is typed AND counted per member
+    call_failures = {
+        n: counters.get(f"federation_member_call_failures_{n}", 0)
+        for n in names}
+    fed.drain()
+    for name in names:
+        routers[name].drain(timeout_s=60)
+    federation_inversions = locks.inversion_count() - inversions_before
+    if federation_inversions:
+        violations.append(f"{federation_inversions} lock-order "
+                          f"inversions during the federation battery")
+    return {
+        "scenarios": scenarios,
+        "federation_counters": {
+            k: v for k, v in counters.items()
+            if k.startswith("federation")},
+        "member_call_failures": call_failures,
+        "flight_recorder": {"dumps": flight_meta["dumps"],
+                            "last_dump_events": last_events,
+                            "last_dump_path":
+                                flight_meta["last_dump_path"]},
+        "steady_compiles": sentinel.compilations,
+        "lock_order_inversions": federation_inversions,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "violations": violations,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="seeded chaos soak for dsin_tpu/serve")
@@ -2039,6 +2539,13 @@ def main(argv=None) -> int:
                         "service (ISSUE 17): 'shm' runs the crash/"
                         "corruption battery over shared-memory lanes "
                         "(meaningful with --entropy_backend process)")
+    p.add_argument("--federation_only", action="store_true",
+                   help="run ONLY the federated fleet battery "
+                        "(staged rollout waves with the wave canary "
+                        "gate, partition-mid-rollout with heal-time "
+                        "reconcile, member death with pinned sessions, "
+                        "torn-version sweeps) — rides the fail-fast "
+                        "federation-bench tpu_session.sh stage")
     p.add_argument("--transport_only", action="store_true",
                    help="run ONLY the shared-memory lane battery "
                         "(exhaustive in-segment bit flips, lying "
@@ -2083,6 +2590,10 @@ def main(argv=None) -> int:
         report = {"config": {"smoke": args.smoke, "seed": args.seed},
                   "transport": run_transport(args),
                   "violations": []}
+    elif args.federation_only:
+        report = {"config": {"smoke": args.smoke, "seed": args.seed},
+                  "federation": run_federation(args),
+                  "violations": []}
     else:
         report = run_chaos(args)
         report["hotswap"] = run_hotswap(args)
@@ -2090,9 +2601,10 @@ def main(argv=None) -> int:
         report["degraded_model"] = run_degraded(args)
         report["autoscale"] = run_autoscale(args)
         report["transport"] = run_transport(args)
+        report["federation"] = run_federation(args)
     # every battery's violations gate the exit code like the soak's own
     for extra in ("hotswap", "sessions", "degraded_model", "autoscale",
-                  "transport"):
+                  "transport", "federation"):
         if extra in report:
             report["violations"] = (report["violations"]
                                     + report[extra]["violations"])
@@ -2125,6 +2637,11 @@ def main(argv=None) -> int:
         summary["transport"] = {
             k: report["transport"][k]
             for k in ("scenarios", "shm_census", "violations")}
+    if "federation" in report:
+        summary["federation"] = {
+            k: report["federation"][k]
+            for k in ("scenarios", "member_call_failures",
+                      "steady_compiles", "violations")}
     summary["violations"] = report["violations"]
     print(json.dumps(summary, indent=1))
     if report["violations"]:

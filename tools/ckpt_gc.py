@@ -39,19 +39,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def referenced_digests(snapshot: dict) -> Set[str]:
-    """Every checkpoint digest ANY fleet member references, from one
-    /metrics?format=json snapshot — fleet-aggregated (router) and
-    single-service shapes both supported:
-
-    * router aggregate: `info.replica_digests` (the handshake view,
-      present even for unreachable replicas) plus each
-      `info.per_replica[i].serve_model_digest`'s current/prev/staged;
-    * single service: `info.serve_model_digest` current/prev/staged.
-    """
-    out: Set[str] = set()
-    info = snapshot.get("info", {})
-
+def _walk_info(info: dict, out: Set[str]) -> None:
+    """Collect every digest slot one info section (service, router
+    roll-up, or federation roll-up) exposes, recursing through the
+    nested tiers: `per_replica` values are per-service info sections,
+    `per_member` (ISSUE 18) values are whole MEMBER roll-up info
+    sections that themselves carry replica_digests/per_replica."""
     def _from_model(model: dict) -> None:
         for key in ("digest", "prev_digest", "staged_digest"):
             d = model.get(key)
@@ -62,19 +55,53 @@ def referenced_digests(snapshot: dict) -> Set[str]:
     for d in (info.get("replica_digests") or {}).values():
         if d:
             out.add(d)
+    for d in (info.get("member_digests") or {}).values():
+        if d:
+            out.add(d)
     for rep_info in (info.get("per_replica") or {}).values():
-        _from_model(rep_info.get("serve_model_digest") or {})
+        _walk_info(rep_info or {}, out)
+    for member_info in (info.get("per_member") or {}).values():
+        _walk_info(member_info or {}, out)
+
+
+def referenced_digests(snapshot: dict) -> Set[str]:
+    """Every checkpoint digest ANY fleet member references, from one
+    /metrics?format=json snapshot — federation-aggregated, fleet-
+    aggregated (router) and single-service shapes all supported:
+
+    * federation aggregate (ISSUE 18): `info.member_digests` plus each
+      `info.per_member[name]` MEMBER roll-up, walked recursively (a
+      member roll-up nests the router shape below);
+    * router aggregate: `info.replica_digests` (the handshake view,
+      present even for unreachable replicas) plus each
+      `info.per_replica[i].serve_model_digest`'s current/prev/staged;
+    * single service: `info.serve_model_digest` current/prev/staged.
+    """
+    out: Set[str] = set()
+    _walk_info(snapshot.get("info", {}), out)
     return out
 
 
+def _blind_info(info: dict) -> int:
+    n = (len(info.get("replicas_unreachable") or [])
+         + len(info.get("replicas_stale") or [])
+         + len(info.get("members_unreachable") or [])
+         + len(info.get("members_stale") or []))
+    for member_info in (info.get("per_member") or {}).values():
+        n += _blind_info(member_info or {})
+    return n
+
+
 def blind_spots(snapshot: dict) -> int:
-    """Replicas whose digests this snapshot could NOT see: unreachable
-    or stale scrapes contribute only their startup handshake digest —
-    their current/prev/staged slots are missing, so GC over such a
-    snapshot could delete a checkpoint a live replica is serving."""
-    info = snapshot.get("info", {})
-    return (len(info.get("replicas_unreachable") or [])
-            + len(info.get("replicas_stale") or []))
+    """Fleet members whose digests this snapshot could NOT see:
+    unreachable or stale scrapes contribute only their startup
+    handshake digest — their current/prev/staged slots are missing, so
+    GC over such a snapshot could delete a checkpoint a live replica
+    is serving. Counts BOTH tiers for a federation snapshot (ISSUE
+    18): an unreachable/stale MEMBER hides its whole fleet, and a
+    reachable member's own roll-up can still be partially blind to
+    some of its replicas."""
+    return _blind_info(snapshot.get("info", {}))
 
 
 def _scrape(url: str, timeout_s: float) -> dict:
@@ -91,9 +118,10 @@ def main(argv=None) -> int:
                    help="directory whose checkpoint subdirs are "
                         "GC candidates")
     p.add_argument("--metrics_url", default=None,
-                   help="a router's aggregated /metrics (or a single "
-                        "service's) — scraped for the referenced digest "
-                        "set, and RE-scraped before each deletion")
+                   help="a federation's or router's aggregated "
+                        "/metrics (or a single service's) — scraped "
+                        "for the referenced digest set, and RE-scraped "
+                        "before each deletion")
     p.add_argument("--keep", action="append", default=[],
                    help="digest to keep regardless (repeatable); with "
                         "no --metrics_url this is the whole reference "

@@ -73,6 +73,25 @@ steady-state compile with quality on, or overhead past the 2% budget
 (noise-escaped per the repo convention). `--quality` runs just this leg
 — the fail-fast `quality-smoke` tpu_session.sh stage.
 
+Federated fleet axis (ISSUE 18): the full (artifact) run and the
+dedicated `--federation_only` stage stand up THREE real spawn-replica
+member fleets behind one `FederatedRouter` (serve/federation.py) and
+measure the router-of-routers tier itself: the same open-loop stream
+through one member's door directly vs through the federation door
+(the extra hop's wall/latency cost — round-robin over three fleets
+makes <1 ratios legitimate), one full staged wave-gated rollout's
+decision->fleet-converged promote time (manifest distributed into
+member checkpoint roots via the CRC-verified replicate path, each
+wave behind the golden-canary gate + a soak window), and the
+concurrent member-scrape fan-out vs serial scraping. In --smoke mode
+(`--federation_only` only; the leg is spawn-heavy like autoscale/
+transport so it skips the plain --smoke run) the bench FAILS on any
+untyped/hung request through either door, a fleet that did not
+converge onto ONE digest (torn versions), members not bit-identical
+before AND after the promotion, a scrape that missed a member, or any
+bench-process compile — the fail-fast `federation-bench`
+tpu_session.sh stage.
+
 Emits a SERVE_BENCH.json trajectory artifact: totals (throughput,
 rejections by cause), latency quantiles, batch occupancy, compile
 counts, per-stage times, the device-scaling section, and a sampled time
@@ -1785,6 +1804,249 @@ def _gate_autoscale(section) -> list:
     return violations
 
 
+def _run_federation_section(args) -> dict:
+    """Federated fleet axis (ISSUE 18): three real single-replica
+    member fleets (spawn processes) behind one `FederatedRouter` —
+    the router-of-routers tier. Measures:
+
+    * routing — the same request stream through one member's door
+      directly vs through the federation door (the extra hop's cost,
+      plus sequential latency probes for p50/p99 both ways);
+    * rollout — one full staged promotion (wave m0, then wave m1+m2,
+      each behind the wave canary gate + a soak window, the manifest
+      distributed into member checkpoint roots via the CRC-verified
+      replicate path): decision -> fleet-converged wall time, with a
+      torn-version sweep after;
+    * scrape_fanout — one federated metrics snapshot (bounded
+      CONCURRENT member scrapes) vs scraping each member serially;
+
+    gating fleet-wide bit-identity before AND after the promotion and
+    zero compiles in the bench/router process. Replica-side budget-0
+    across swaps is chaos_bench --federation_only territory."""
+    import concurrent.futures as cf
+    import tempfile
+
+    from dsin_tpu.coding.loader import load_model_state
+    from dsin_tpu.serve import ServeError
+    from dsin_tpu.serve.federation import (FederatedRouter, Member,
+                                           RolloutPlan)
+    from dsin_tpu.serve.router import FrontDoorRouter
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from dsin_tpu.utils.recompile import CompilationSentinel
+
+    shapes = _parse_shapes(args.shapes)
+    buckets = _parse_shapes(args.buckets)
+    rng = np.random.default_rng(args.seed + 29)
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for h, w in shapes]
+    # background canary ON: the rollout's wave gate reads it
+    cfg = _service_config(args, args.entropy_workers,
+                          canary_every_s=0.2,
+                          quality_gap_sample_rate=1.0)
+    tmpd = tempfile.mkdtemp(prefix="serve_fed_")
+
+    # publish the promotion candidate BEFORE the sentinel opens (model
+    # builds compile; nothing the federation does afterwards may)
+    model_b, state_b = load_model_state(
+        args.ae_config, args.pc_config, None, tuple(buckets[-1]),
+        need_sinet=False, seed=args.seed + 1)
+    ckpt_b = os.path.join(tmpd, "ckpt_b")
+    ckpt_lib.save_checkpoint(ckpt_b, state_b, manifest_extra={
+        "pc_config_sha256": ckpt_lib.config_sha256(model_b.pc_config),
+        "seed": args.seed + 1,
+        "buckets": [list(b) for b in buckets]})
+
+    names = ("m0", "m1", "m2")
+    period = 1.0 / args.rate
+    out = {"members": list(names), "replicas_per_member": 1}
+
+    def _lat_probe(door, n=12):
+        lat = []
+        for i in range(n):
+            t = time.monotonic()
+            door.encode(images[i % len(images)], timeout=180.0)
+            lat.append((time.monotonic() - t) * 1e3)
+        lat.sort()
+        return (round(lat[len(lat) // 2], 2),
+                round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2))
+
+    def _pass(door):
+        futures = []
+        shed = 0   # door refusals AND typed in-service sheds
+        t0 = time.monotonic()
+        for i in range(args.requests):
+            _pace(i, t0, period)
+            try:
+                futures.append(door.submit_encode(
+                    images[i % len(images)]))
+            except ServeError:
+                shed += 1
+        completed = failed = 0
+        for f in futures:
+            try:
+                exc = f.exception(timeout=180.0)
+            except (cf.TimeoutError, TimeoutError):
+                failed += 1
+                continue
+            if exc is None:
+                completed += 1
+            elif isinstance(exc, ServeError):
+                shed += 1
+            else:
+                failed += 1
+        return {"submitted": args.requests,
+                "completed": completed, "shed": shed,
+                "failed": failed,
+                "wall_s": round(time.monotonic() - t0, 3)}
+
+    with CompilationSentinel(budget=0, label="federation bench process",
+                             raise_on_exceed=False) as sentinel:
+        routers = {n: FrontDoorRouter(cfg, replicas=1).start()
+                   for n in names}
+        member_of = {n: Member(n, routers[n],
+                               ckpt_root=(os.path.join(tmpd, f"root_{n}")
+                                          if n != "m0" else None))
+                     for n in names}
+        fed = FederatedRouter(list(member_of.values()),
+                              poll_every_s=0.25).start()
+        try:
+            digest_a = fed.params_digest
+            ref = routers["m0"].encode(images[0], timeout=180.0).stream
+            ident_before = all(
+                routers[n].encode(images[0], timeout=180.0).stream
+                == ref for n in names) and fed.encode(
+                    images[0], timeout=180.0).stream == ref
+
+            direct = _pass(routers["m0"])
+            federated = _pass(fed)
+            d_p50, d_p99 = _lat_probe(routers["m0"])
+            f_p50, f_p99 = _lat_probe(fed)
+            out["routing"] = {
+                "direct": direct, "federated": federated,
+                "direct_p50_ms": d_p50, "direct_p99_ms": d_p99,
+                "federated_p50_ms": f_p50, "federated_p99_ms": f_p99,
+                # >1 = the extra hop costs wall time; the federation
+                # round-robins over THREE fleets, so <1 is just as
+                # legitimate (more capacity than one member's door)
+                "federation_hop_overhead": (
+                    round(federated["wall_s"] / direct["wall_s"], 3)
+                    if direct["wall_s"] else None),
+            }
+
+            plan = RolloutPlan(
+                ckpt_dir=ckpt_b, waves=(("m0",), ("m1", "m2")),
+                canary_timeout_s=180.0, poll_s=0.05, soak_s=0.5,
+                swap_timeout_s=600.0, rollback_timeout_s=60.0)
+            t0 = time.monotonic()
+            res = fed.rollout(plan)
+            promote_s = round(time.monotonic() - t0, 3)
+            digest_b = res["digest"]
+            per_member = {n: routers[n].params_digest for n in names}
+            torn = sorted(f"{n}={d!r}" for n, d in per_member.items()
+                          if d != digest_b)
+            ref_b = routers["m0"].encode(images[0],
+                                         timeout=180.0).stream
+            ident_after = all(
+                routers[n].encode(images[0], timeout=180.0).stream
+                == ref_b for n in names) and fed.encode(
+                    images[0], timeout=180.0).stream == ref_b
+            out["rollout"] = {
+                "digest_a": digest_a, "digest_b": digest_b,
+                "waves": res["waves"], "soak_s": plan.soak_s,
+                "promote_s": promote_s,
+                "per_member_digests": per_member,
+                "torn_versions": torn,
+                "distributed_roots_staged": {
+                    n: bool(member_of[n].ckpt_root
+                            and ckpt_lib.latest_checkpoint(
+                                member_of[n].ckpt_root))
+                    for n in ("m1", "m2")},
+            }
+
+            serial_ms = []
+            for n in names:
+                t = time.monotonic()
+                routers[n].aggregate.snapshot()
+                serial_ms.append((time.monotonic() - t) * 1e3)
+            t = time.monotonic()
+            fed_snap = fed.aggregate.snapshot()
+            federated_ms = (time.monotonic() - t) * 1e3
+            out["scrape_fanout"] = {
+                "member_scrape_ms": [round(v, 2) for v in serial_ms],
+                "serial_sum_ms": round(sum(serial_ms), 2),
+                "federated_ms": round(federated_ms, 2),
+                "concurrency_ratio": (
+                    round(sum(serial_ms) / federated_ms, 2)
+                    if federated_ms else None),
+                "members_scraped":
+                    fed_snap["info"]["members_scraped"],
+                "members_unreachable":
+                    fed_snap["info"]["members_unreachable"],
+            }
+            out["bit_identical"] = {"before_rollout": ident_before,
+                                    "after_rollout": ident_after}
+            out["federation_counters"] = {
+                k: v for k, v in
+                fed.metrics.snapshot()["counters"].items()
+                if k.startswith("federation")}
+        finally:
+            fed.drain()
+            for n in names:
+                routers[n].drain(timeout_s=60)
+    out["bench_process_compiles"] = sentinel.compilations
+    return out
+
+
+def _gate_federation(section) -> list:
+    """--smoke violations for the federated fleet leg: traffic through
+    the federation door must complete with nothing hung or untyped,
+    the staged rollout must promote every wave onto ONE digest (zero
+    torn versions) with the members bit-identical before and after,
+    the federated scrape must see every member, and the bench process
+    must not compile."""
+    violations = []
+    for tag in ("direct", "federated"):
+        leg = section["routing"][tag]
+        if leg["failed"]:
+            violations.append(f"federation: {leg['failed']} untyped/"
+                              f"hung requests through the {tag} door")
+        if leg["completed"] == 0:
+            violations.append(f"federation: no request completed "
+                              f"through the {tag} door")
+    ro = section["rollout"]
+    if ro["digest_b"] in (None, ro["digest_a"]):
+        violations.append(
+            f"federation: the staged rollout did not move the fleet "
+            f"({ro['digest_a']} -> {ro['digest_b']})")
+    if ro["torn_versions"]:
+        violations.append(f"federation: torn versions after full "
+                          f"promotion: {ro['torn_versions']}")
+    if not all(ro["distributed_roots_staged"].values()):
+        violations.append(
+            f"federation: replicate_checkpoint left no staged "
+            f"manifest in member roots "
+            f"({ro['distributed_roots_staged']})")
+    bi = section["bit_identical"]
+    if bi["before_rollout"] is not True:
+        violations.append("federation: members were not bit-identical "
+                          "before the rollout")
+    if bi["after_rollout"] is not True:
+        violations.append("federation: members were not bit-identical "
+                          "after the rollout")
+    sf = section["scrape_fanout"]
+    if sf["members_scraped"] != len(section["members"]) \
+            or sf["members_unreachable"]:
+        violations.append(
+            f"federation: the federated scrape missed members "
+            f"({sf['members_scraped']} scraped, "
+            f"{sf['members_unreachable']} unreachable)")
+    if section["bench_process_compiles"]:
+        violations.append(
+            f"federation: the bench/router process compiled "
+            f"{section['bench_process_compiles']} time(s)")
+    return violations
+
+
 def _run_transport_section(args) -> dict:
     """Transport axis (ISSUE 17): the same traffic through BOTH payload
     transports — "pipe" (payloads pickled through the control pipe, the
@@ -2182,6 +2444,17 @@ def main(argv=None) -> int:
                         "integrity errors, and zero steady-state "
                         "compiles — the fail-fast transport-bench "
                         "tpu_session.sh stage")
+    p.add_argument("--federation_only", action="store_true",
+                   help="run ONLY the federated fleet leg (ISSUE 18): "
+                        "three real spawn-replica member fleets behind "
+                        "the FederatedRouter — federation-door routing "
+                        "cost vs a direct member door, one full staged "
+                        "wave-gated rollout's promote wall time, and "
+                        "the concurrent member-scrape fan-out — gating "
+                        "fleet bit-identity before/after promotion, "
+                        "zero torn versions, and bench-process "
+                        "budget-0; the fail-fast federation-bench "
+                        "tpu_session.sh stage")
     p.add_argument("--autoscale", dest="autoscale_only",
                    action="store_true",
                    help="run ONLY the elastic-fleet leg (ISSUE 14): "
@@ -2238,7 +2511,7 @@ def main(argv=None) -> int:
     only_flags = [f for f in ("devices_only", "backends_only",
                               "frontdoor_only", "si_only", "trace_only",
                               "quality_only", "autoscale_only",
-                              "transport_only")
+                              "transport_only", "federation_only")
                   if getattr(args, f)]
     if len(only_flags) > 1:
         print(f"SERVE_BENCH_FAILED: {only_flags} are mutually "
@@ -2253,7 +2526,8 @@ def main(argv=None) -> int:
                                or args.si_only or args.trace_only
                                or args.quality_only
                                or args.autoscale_only
-                               or args.transport_only)
+                               or args.transport_only
+                               or args.federation_only)
                         else "1 2" if args.smoke else "1 2 4 8")
     axis = [int(v) for v in args.devices.split()]
     if any(n < 1 for n in axis):
@@ -2402,6 +2676,20 @@ def main(argv=None) -> int:
             },
             "transport": _run_transport_section(args),
         }
+    elif args.federation_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "rate_rps": args.rate, "requests": args.requests,
+                "smoke": args.smoke,
+            },
+            "federation": _run_federation_section(args),
+        }
     else:
         report = run_bench(args)
         report["config"]["entropy_backend"] = args.entropy_backend
@@ -2428,6 +2716,10 @@ def main(argv=None) -> int:
             # it rides only the full run and --transport_only
             report["config"]["transport"] = args.transport
             report["transport"] = _run_transport_section(args)
+            # federated fleet (ISSUE 18): three member fleets = three
+            # spawned replica processes, so it likewise rides only the
+            # full run and the dedicated --federation_only stage
+            report["federation"] = _run_federation_section(args)
         # session-cached SI serving (ISSUE 10): rides every run — the
         # smoke gate holds the warm-vs-per-request-prep speedup floor
         # (host-weather escape) and zero compiles under session churn
@@ -2450,7 +2742,7 @@ def main(argv=None) -> int:
     summary_keys = ("load", "latency_ms", "batch_occupancy",
                     "steady_compiles", "pipeline", "entropy_backends",
                     "devices", "frontdoor", "si", "trace", "quality",
-                    "autoscale", "transport")
+                    "autoscale", "transport", "federation")
     print(json.dumps({k: report[k] for k in summary_keys if k in report},
                      indent=1))
     if args.smoke and args.devices_only:
@@ -2497,6 +2789,12 @@ def main(argv=None) -> int:
         return 0
     if args.smoke and args.transport_only:
         violations = _gate_transport(report["transport"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
+    if args.smoke and args.federation_only:
+        violations = _gate_federation(report["federation"])
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -262,6 +262,38 @@ transport-bench)
     exit 1
   fi
   ;;
+federation-bench)
+  # fail fast (ISSUE 18): the federated fleet leg — serve_bench stands
+  # up three REAL spawn-replica member fleets behind the
+  # FederatedRouter and must show zero untyped/hung requests through
+  # either door, one staged wave-gated rollout converging the whole
+  # fleet onto ONE digest (zero torn versions, members bit-identical
+  # before AND after, manifests distributed into member roots via the
+  # CRC-verified replicate path), a federated scrape that reaches
+  # every member, and bench-process budget-0; chaos_bench's federation
+  # battery then partitions a member away MID-ROLLOUT (typed abort,
+  # prior-wave rollback, heal-time reconcile through the aborted-
+  # digest set), fails a wave canary against a bit-flipped twin, and
+  # kills a member with pinned sessions (victim typed SessionExpired,
+  # survivors serve, hierarchical admission budget shrinks). Both exit
+  # 1 on violation; seconds on CPU.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --federation_only \
+    --devices "" --out artifacts/federation_bench.json \
+    > artifacts/federation_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/federation_bench.log
+    echo "TPU_SESSION_FAILED: federation-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu python tools/chaos_bench.py --smoke --federation_only \
+    --out artifacts/federation_chaos.json \
+    > artifacts/federation_chaos.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/federation_chaos.log
+    echo "TPU_SESSION_FAILED: federation-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -333,7 +365,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
