@@ -22,6 +22,20 @@ ran >9 min with no output):
   * on total failure a JSON line with "value": null and the error is
     printed before the nonzero exit.
 
+RD-delta gate (ISSUE 19): `BENCH_RD_DELTA=1` switches this driver into
+the precision-ladder rate-distortion gate instead of the train bench —
+CPU-runnable (tpu_session.sh's `precision-bench` stage runs it under
+JAX_PLATFORMS=cpu). It builds the AE at every ladder rung
+(coding/precision.py), reconstructs one deterministic image batch
+through quantize->decode at each, and emits ONE JSON line with per-rung
+PSNR / MS-SSIM deltas vs the fp32 reference. Two verdicts ride in it:
+the distortion-side deltas must stay inside the PINNED budgets
+(BENCH_RD_PSNR_BUDGET_{BF16,INT8} dB, BENCH_RD_MSSSIM_BUDGET_{BF16,
+INT8}), and ONE fixed symbol volume encoded through every rung's codec
+must produce byte-identical rANS streams — any probclass stream
+divergence is a HARD failure (rc 1), never a budgeted delta: the
+entropy-critical path is frozen-point-exact fp32 at every rung.
+
 vs_baseline: the reference publishes no throughput numbers (BASELINE.md),
 so the denominator is a FLOP-derived *upper bound* on the reference's V100
 throughput: the compiled step's own cost analysis gives FLOPs/image for the
@@ -440,7 +454,136 @@ def _cpu_fallback(tpu_err):
     return payload
 
 
+def run_rd_delta():
+    """Precision-ladder RD gate (module docstring): per-rung PSNR /
+    MS-SSIM deltas vs fp32 within pinned budgets + cross-rung stream
+    bit-identity. Pure-host metrics (eval/reporting.py psnr_np,
+    eval/msssim_np) so the verdict is backend-independent."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from dsin_tpu.coding import loader as loader_lib
+    from dsin_tpu.coding import precision as precision_lib
+    from dsin_tpu.eval.msssim_np import multiscale_ssim_np
+    from dsin_tpu.eval.reporting import psnr_np
+    from dsin_tpu.serve.service import _make_batched_fns
+
+    h = int(os.environ.get("BENCH_RD_H", "48"))
+    w = int(os.environ.get("BENCH_RD_W", "96"))
+    # pinned per-rung budgets: bf16 is the production rung (tight);
+    # int8 is the experimental fake-quant rung (loose, but still a
+    # gate — a sign flip or scale bug blows far past 3 dB)
+    budgets = {
+        "bf16": (float(os.environ.get("BENCH_RD_PSNR_BUDGET_BF16", "1.0")),
+                 float(os.environ.get("BENCH_RD_MSSSIM_BUDGET_BF16",
+                                      "0.01"))),
+        "int8": (float(os.environ.get("BENCH_RD_PSNR_BUDGET_INT8", "3.0")),
+                 float(os.environ.get("BENCH_RD_MSSSIM_BUDGET_INT8",
+                                      "0.05"))),
+    }
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dsin_tpu", "configs")
+    ae_cfg_path = os.environ.get(
+        "BENCH_RD_AE_CONFIG", os.path.join(base, "ae_synthetic_micro"))
+    pc_cfg_path = os.environ.get(
+        "BENCH_RD_PC_CONFIG", os.path.join(base, "pc_default"))
+
+    # structured deterministic images (gradient + texture), not white
+    # noise: the AE is random-init either way, but a structured target
+    # keeps PSNR in a regime where a distortion regression moves it
+    rng = np.random.default_rng(0)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    grad = (yy / h * 160.0 + xx / w * 80.0)[..., None] * np.ones(3)
+    x_host = np.clip(
+        grad[None] + rng.normal(0.0, 24.0, size=(2, h, w, 3)), 0, 255
+    ).astype(np.float32)
+
+    stage("rd-delta: building rungs " + "/".join(precision_lib.RUNGS))
+    per_rung, fixed_sym, streams = {}, None, {}
+    for rung in precision_lib.RUNGS:
+        model, state = loader_lib.load_model_state(
+            ae_cfg_path, pc_cfg_path, None, (h, w), need_sinet=False,
+            seed=0, precision=rung)
+        params, bstats = state.params, state.batch_stats
+        encode_fn, decode_fn = _make_batched_fns(model)
+        sym = np.asarray(encode_fn(params, bstats, jnp.asarray(x_host)))
+        x_dec = np.asarray(decode_fn(params, bstats, jnp.asarray(sym)))
+        codec = loader_lib.make_codec(model, state)
+        if fixed_sym is None:
+            # ONE volume for every rung's codec: the identity question
+            # is about codec numerics, not encoder-side symbol drift
+            fixed_sym = np.ascontiguousarray(
+                np.transpose(sym[0], (2, 0, 1)).astype(np.int32))
+        rung_streams = {}
+        for mode in ("wavefront_np", "wavefront_pl"):
+            stream = codec.encode(fixed_sym, mode=mode)
+            rung_streams[mode] = hashlib.sha256(stream).hexdigest()
+            if not np.array_equal(codec.decode(stream), fixed_sym):
+                raise RuntimeError(
+                    f"rd-delta: {rung}/{mode} stream failed round-trip")
+        streams[rung] = rung_streams
+        per_rung[rung] = {
+            "psnr": round(psnr_np(x_host, x_dec), 4),
+            "msssim": round(
+                multiscale_ssim_np(x_host, x_dec, levels=3), 6),
+            "stream_sha256": rung_streams,
+        }
+
+    violations = []
+    ref = per_rung["fp32"]
+    for rung, (psnr_budget, ms_budget) in budgets.items():
+        entry = per_rung[rung]
+        entry["psnr_delta"] = round(ref["psnr"] - entry["psnr"], 4)
+        entry["msssim_delta"] = round(ref["msssim"] - entry["msssim"], 6)
+        entry["budgets"] = {"psnr_db": psnr_budget, "msssim": ms_budget}
+        if entry["psnr_delta"] > psnr_budget:
+            violations.append(
+                f"{rung} PSNR delta {entry['psnr_delta']} dB > budget "
+                f"{psnr_budget}")
+        if entry["msssim_delta"] > ms_budget:
+            violations.append(
+                f"{rung} MS-SSIM delta {entry['msssim_delta']} > budget "
+                f"{ms_budget}")
+    for mode in ("wavefront_np", "wavefront_pl"):
+        digests = {streams[r][mode] for r in precision_lib.RUNGS}
+        if len(digests) != 1:
+            violations.append(
+                f"HARD: probclass stream divergence across rungs in "
+                f"{mode}: { {r: streams[r][mode] for r in streams} }")
+
+    worst = max(per_rung[r]["psnr_delta"] for r in budgets)
+    return {
+        "metric": "precision_rd_psnr_delta_max",
+        "value": round(worst, 4),
+        "unit": "dB",
+        "vs_baseline": None,
+        "shape": [h, w],
+        "per_rung": per_rung,
+        "streams_bit_identical": not any(
+            v.startswith("HARD") for v in violations),
+        "violations": violations,
+        "pass": not violations,
+    }
+
+
 def main():
+    if os.environ.get("BENCH_RD_DELTA", "0") == "1":
+        # the RD gate is host-fast (no TPU, no multi-minute compile);
+        # the watchdog still bounds a pathological hang
+        threading.Thread(target=_watchdog, daemon=True).start()
+        try:
+            payload = run_rd_delta()
+        except BaseException as e:  # noqa: BLE001 — artifact never empty
+            traceback.print_exc(file=sys.stderr)
+            fail = failure_payload(e)
+            fail["metric"] = "precision_rd_psnr_delta_max"
+            fail["unit"] = "dB"
+            emit(fail)
+            return 1
+        emit(payload)
+        return 0 if payload["pass"] else 1
     threading.Thread(target=_watchdog, daemon=True).start()
     try:
         emit(run())

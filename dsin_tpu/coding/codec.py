@@ -26,7 +26,7 @@ Design:
   split of `rans.Decoder`, so a fresh adaptive PMF per position costs one
   tiny jit call + O(L) host work.
 
-Three scan engines share the same stream format (header mode byte — the
+Four scan engines share the same stream format (header mode byte — the
 engine defines both the symbol order and the exact PMF floats, so it is a
 property of the stream):
 
@@ -34,6 +34,10 @@ property of the stream):
   (coding/incremental.py): cached per-layer activations, each computed
   exactly once at its availability front; one fully-conv forward of work
   total and no jax in the loop (~50x the jit wavefront on a 1-core host).
+* **wavefront_pl** — the fused Pallas front kernel
+  (coding/probclass_pallas.py): the whole 4-layer context stack per front
+  in ONE device launch instead of the four-conv XLA dispatch — the
+  device-speed engine for TPU-resident coding (interpret mode off-TPU).
 
 The two jit engines remain as independently-derived cross-checks:
 
@@ -70,8 +74,10 @@ VERSION = 2
 MODE_SEQUENTIAL = 0
 MODE_WAVEFRONT = 1
 MODE_WAVEFRONT_NP = 2
+MODE_WAVEFRONT_PL = 3
 _MODES = {"sequential": MODE_SEQUENTIAL, "wavefront": MODE_WAVEFRONT,
-          "wavefront_np": MODE_WAVEFRONT_NP}
+          "wavefront_np": MODE_WAVEFRONT_NP,
+          "wavefront_pl": MODE_WAVEFRONT_PL}
 
 
 class BottleneckCodec:
@@ -101,7 +107,8 @@ class BottleneckCodec:
 
     def __init__(self, probclass_model, pc_params, centers, pc_config,
                  scale_bits: int = rans.DEFAULT_SCALE_BITS,
-                 pad_value: Optional[float] = None):
+                 pad_value: Optional[float] = None,
+                 pallas_interpret: Optional[bool] = None):
         self.model = probclass_model
         self.pc_params = pc_params
         self.centers = np.asarray(centers, dtype=np.float32)
@@ -136,6 +143,12 @@ class BottleneckCodec:
             jax.jit(jax.vmap(_block_logits, in_axes=(None, 0))), variables)
         # lazy numpy engine (wavefront_np mode)
         self._incremental = None  # guarded-by: self._incremental_lock
+        # lazy fused Pallas front kernel (wavefront_pl mode); None for
+        # `pallas_interpret` resolves to interpret mode off-TPU at first
+        # use — a per-process property, like the engines' same-machine
+        # determinism contract
+        self._pallas = None  # guarded-by: self._incremental_lock
+        self._pallas_interpret = pallas_interpret
         self._incremental_lock = locks_lib.RankedLock("codec.engine")
 
     def _incremental_engine(self):
@@ -156,6 +169,23 @@ class BottleneckCodec:
                     self.pad_value)
             return self._incremental
 
+    def _pallas_engine(self):
+        """Lazy fused-front kernel wrapper (coding/probclass_pallas.py).
+        Same convoy-on-purpose locking rationale as the incremental
+        engine above; read-only once built, so clones share it."""
+        with self._incremental_lock:
+            if self._pallas is None:
+                from dsin_tpu.coding.probclass_pallas import \
+                    ProbclassFrontKernel
+                interpret = self._pallas_interpret
+                if interpret is None:
+                    interpret = jax.default_backend() != "tpu"
+                params_np = jax.tree_util.tree_map(np.asarray,
+                                                   self.pc_params)
+                self._pallas = ProbclassFrontKernel(
+                    params_np, self.pc_config, interpret=interpret)
+            return self._pallas
+
     def thread_clone(self) -> "BottleneckCodec":
         """A per-thread twin for entropy pools (dsin_tpu/serve): shares
         this codec's read-only weights AND its incremental engine — whose
@@ -166,8 +196,12 @@ class BottleneckCodec:
         mutable state a future change might add."""
         clone = BottleneckCodec(self.model, self.pc_params, self.centers,
                                 self.pc_config, scale_bits=self.scale_bits,
-                                pad_value=self.pad_value)
+                                pad_value=self.pad_value,
+                                pallas_interpret=self._pallas_interpret)
         clone._incremental = self._incremental_engine()
+        with self._incremental_lock:
+            # read-only once built; may still be None (lazy)
+            clone._pallas = self._pallas
         return clone
 
     # -- internals ----------------------------------------------------------
@@ -230,7 +264,8 @@ class BottleneckCodec:
         bounds = np.flatnonzero(np.diff(t)) + 1
         return np.split(pos, bounds)
 
-    def _wavefront_pass(self, shape: Tuple[int, int, int], front_symbols):
+    def _wavefront_pass(self, shape: Tuple[int, int, int], front_symbols,
+                        logits_fn=None):
         """Vectorized wavefront driver: for each front (t ascending) compute
         every PMF in one padded batched jit call, obtain the front's symbols
         VECTORIZED via `front_symbols(front, cum_b, freqs_b) -> (n,) ints`
@@ -243,7 +278,15 @@ class BottleneckCodec:
         each front's write-back automatically) plus one jit and one coder
         call per front. Produces byte-identical streams to the previous
         per-position implementation (same fronts, same bucket padding, same
-        batched executable, same write-back order)."""
+        batched executable, same write-back order).
+
+        `logits_fn` swaps the per-front logits launch — the default is
+        the XLA batched jit; the Pallas engine (`_wavefront_pass_pl`)
+        passes the fused front kernel. Everything else (fronts, bucket
+        rule, write-back) is shared, so the engines cannot drift in
+        schedule — only in last-ulp PMF floats, which the header mode
+        byte already accounts for."""
+        fn = logits_fn if logits_fn is not None else self._block_logits_batch
         d, h, w = shape
         buf = self._make_buffer(d, h, w)
         p = self.pad
@@ -261,7 +304,7 @@ class BottleneckCodec:
             bucket = min(1 << (n - 1).bit_length(), max_bucket)
             blocks[:n] = win[front[:, 0], front[:, 1], front[:, 2]]
             blocks[n:bucket] = 0.0  # deterministic padding
-            logits = np.asarray(self._block_logits_batch(
+            logits = np.asarray(fn(
                 jnp.asarray(blocks[:bucket])), dtype=np.float64)[:n]
             freqs_b, cum_b = self._tables_from_logits(logits)
             s = np.asarray(front_symbols(front, cum_b, freqs_b),
@@ -287,6 +330,27 @@ class BottleneckCodec:
                            dtype=np.int64)
             vp.write(i, s)
             yield front, s, cum_b, freqs_b
+
+    def _wavefront_pass_pl(self, shape: Tuple[int, int, int], front_symbols):
+        """`_wavefront_pass` with PMFs from the fused Pallas front kernel
+        (coding/probclass_pallas.py): one device launch per front instead
+        of the four-conv XLA dispatch. Same fronts, same bucket padding,
+        same write-back; encode and decode both run THIS kernel, so the
+        quantized tables agree exactly. Its floats differ from the jit
+        engine's in the last ulp — mode 3 streams are not interchangeable
+        with mode 1 (the header mode byte keeps them apart)."""
+        return self._wavefront_pass(
+            shape, front_symbols,
+            logits_fn=self._pallas_engine().front_logits)
+
+    def _passes_for(self, mode_id: int):
+        """Front-pass driver for a wavefront-family stream mode — the ONE
+        mode->engine map `_encode_lane`, `decode`, and `ideal_bits` share
+        (three private copies is how an engine goes missing from one
+        site and desyncs a stream)."""
+        return {MODE_WAVEFRONT: self._wavefront_pass,
+                MODE_WAVEFRONT_NP: self._wavefront_pass_np,
+                MODE_WAVEFRONT_PL: self._wavefront_pass_pl}[mode_id]
 
     def _scan(self, shape: Tuple[int, int, int], symbol_at):
         """The one sequential driver every public method builds on: walk the
@@ -315,9 +379,8 @@ class BottleneckCodec:
         and batch entry points so the two cannot drift."""
         starts = np.empty(symbols.size, dtype=np.uint32)
         freqs_out = np.empty(symbols.size, dtype=np.uint32)
-        if mode_id in (MODE_WAVEFRONT, MODE_WAVEFRONT_NP):
-            passes = (self._wavefront_pass if mode_id == MODE_WAVEFRONT
-                      else self._wavefront_pass_np)
+        if mode_id != MODE_SEQUENTIAL:
+            passes = self._passes_for(mode_id)
             idx = 0
             known = lambda front, cum_b, freqs_b: \
                 symbols[front[:, 0], front[:, 1], front[:, 2]]
@@ -401,7 +464,7 @@ class BottleneckCodec:
         if version != VERSION:
             raise ValueError(f"unsupported bitstream version {version}")
         if mode_id not in (MODE_SEQUENTIAL, MODE_WAVEFRONT,
-                           MODE_WAVEFRONT_NP):
+                           MODE_WAVEFRONT_NP, MODE_WAVEFRONT_PL):
             raise ValueError(f"unknown scan mode {mode_id}")
         if scale_bits != self.scale_bits:
             raise ValueError(f"stream scale_bits {scale_bits} != codec "
@@ -421,9 +484,8 @@ class BottleneckCodec:
         mode_id, (d, h, w) = self._parse_header(bitstream)
         symbols = np.empty((d, h, w), dtype=np.int32)
         with rans.Decoder(bitstream[13:], self.scale_bits) as dec:
-            if mode_id in (MODE_WAVEFRONT, MODE_WAVEFRONT_NP):
-                passes = (self._wavefront_pass if mode_id == MODE_WAVEFRONT
-                          else self._wavefront_pass_np)
+            if mode_id != MODE_SEQUENTIAL:
+                passes = self._passes_for(mode_id)
                 take = lambda front, cum_b, freqs_b: dec.decode_front(cum_b)
                 for front, s, _, _ in passes((d, h, w), take):
                     symbols[front[:, 0], front[:, 1], front[:, 2]] = s
@@ -519,9 +581,8 @@ class BottleneckCodec:
         symbols = np.asarray(symbols_dhw)
         total = 0.0
         scale = float(1 << self.scale_bits)
-        if mode in ("wavefront", "wavefront_np"):
-            passes = (self._wavefront_pass if mode == "wavefront"
-                      else self._wavefront_pass_np)
+        if mode != "sequential":
+            passes = self._passes_for(_MODES[mode])
             known = lambda front, cum_b, freqs_b: \
                 symbols[front[:, 0], front[:, 1], front[:, 2]]
             for front, s, _, freqs_b in passes(symbols.shape, known):

@@ -28,7 +28,8 @@ import numpy as np
 def load_model_state(ae_config_path: str, pc_config_path: str,
                      ckpt_dir: Optional[str], img_shape: Tuple[int, int],
                      need_sinet: bool, seed: int = 0,
-                     persistent_cache: bool = False):
+                     persistent_cache: bool = False,
+                     precision: str = "fp32"):
     """Build DSIN (+ optional checkpoint restore) with a minimal state.
 
     `seed` drives the parameter init and only matters when no checkpoint
@@ -40,15 +41,23 @@ def load_model_state(ae_config_path: str, pc_config_path: str,
     shared repo cache dir (utils/cache.py) BEFORE anything compiles, so
     a restarted long-lived process (dsin_tpu/serve) re-warms from disk
     instead of re-running XLA — the serve warmup dict reports the split
-    (compiles vs cache_hits, utils/recompile.py)."""
+    (compiles vs cache_hits, utils/recompile.py).
+
+    `precision` is a ladder rung (coding/precision.py): the distortion-
+    side partitions are cast AFTER the manifest verification (identity
+    is checked against what was restored, not what will serve) and the
+    AE config's compute_dtype follows the rung; the entropy-critical
+    probclass/centers partitions stay frozen-point-exact fp32."""
     if persistent_cache:
         from dsin_tpu.utils.cache import enable_compilation_cache
         enable_compilation_cache()
+    from dsin_tpu.coding import precision as precision_lib
     from dsin_tpu.config import parse_config_file
     from dsin_tpu.models.dsin import DSIN
     from dsin_tpu.train import checkpoint as ckpt_lib
     from dsin_tpu.train.step import TrainState
 
+    policy = precision_lib.PrecisionPolicy(precision)
     ae_cfg = parse_config_file(ae_config_path)
     if not need_sinet:
         ae_cfg = ae_cfg.replace(AE_only=True)
@@ -57,6 +66,8 @@ def load_model_state(ae_config_path: str, pc_config_path: str,
         # enable_si service, ISSUE 10) gets siNet built even from a
         # config snapshot whose training phase set AE_only=True
         ae_cfg = ae_cfg.replace(AE_only=False)
+    if policy.rung != "fp32":
+        ae_cfg = ae_cfg.replace(compute_dtype=policy.compute_dtype)
     pc_cfg = parse_config_file(pc_config_path)
     model = DSIN(ae_cfg, pc_cfg)
     variables = model.init_variables(jax.random.PRNGKey(seed),
@@ -82,6 +93,12 @@ def load_model_state(ae_config_path: str, pc_config_path: str,
                 f"WITHOUT identity verification (re-save it to gain "
                 f"digest/pc-hash checks and hot-swap eligibility)",
                 stacklevel=2)
+    if policy.rung != "fp32":
+        # cast AFTER restore + manifest verification: identity checks
+        # run against the checkpoint's own bytes, then the serving copy
+        # drops to the rung. The tripwire re-proves the rANS contract.
+        state = state.replace(params=policy.cast_params(state.params))
+        precision_lib.check_entropy_critical(state.params)
     return model, state
 
 
@@ -116,23 +133,39 @@ def make_codec(model, state):
     return BottleneckCodec.for_model(model, state.params)
 
 
-def params_digest(tree) -> str:
+def params_digest(tree, rung: str = "fp32") -> str:
     """Order-stable digest of a parameter pytree (structure + dtypes +
-    shapes + bytes). The multi-replica front door (serve/router.py)
-    compares every replica's digest at the ready handshake: shared-
-    nothing replicas must have built the SAME model from the same
-    config/seed/checkpoint, or two replicas would answer one request
-    with different bytes — a mismatch is refused at start, not
-    discovered as flaky bit-identity in production."""
+    shapes + bytes + precision rung). The multi-replica front door
+    (serve/router.py) compares every replica's digest at the ready
+    handshake: shared-nothing replicas must have built the SAME model
+    from the same config/seed/checkpoint, or two replicas would answer
+    one request with different bytes — a mismatch is refused at start,
+    not discovered as flaky bit-identity in production.
+
+    Every preimage field is length-prefixed (ISSUE 19): the old plain
+    concatenation let adjacent fields donate bytes to each other, so
+    two different (dtype, shape, bytes) triples could in principle
+    collide. The `rung` tag folds the precision ladder into the same
+    identity — an fp32 and a bf16 cast of one checkpoint hash apart
+    even if a future dtype alias made their leaf descriptions match, so
+    the fleet handshake, hot-swap manifests, and canary goldens can
+    never mix rungs silently."""
     import hashlib
     h = hashlib.sha256()
+
+    def _field(data: bytes) -> None:
+        h.update(len(data).to_bytes(8, "little"))
+        h.update(data)
+
+    _field(b"dsin-params-digest-v2")
+    _field(str(rung).encode())
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    h.update(repr(treedef).encode())
+    _field(repr(treedef).encode())
     for leaf in leaves:
         arr = np.asarray(leaf)
-        h.update(str(arr.dtype).encode())
-        h.update(str(arr.shape).encode())
-        h.update(arr.tobytes())
+        _field(str(arr.dtype).encode())
+        _field(str(arr.shape).encode())
+        _field(arr.tobytes())
     return h.hexdigest()[:16]
 
 
@@ -160,16 +193,23 @@ class CodecSpec:
     pc_config_text: str
     pad_value: float
     scale_bits: int
+    #: precision-ladder rung of the bundle this codec serves alongside
+    #: (ISSUE 19). METADATA ONLY: the codec's own numerics are fp32 at
+    #: every rung (the probclass path is frozen-point-exact), but a
+    #: worker must be able to report which rung its replica runs so
+    #: cross-process identity checks can compare like with like.
+    rung: str = "fp32"
 
 
-def make_codec_spec(codec) -> CodecSpec:
+def make_codec_spec(codec, rung: str = "fp32") -> CodecSpec:
     """Picklable spec from a live BottleneckCodec (the parent side)."""
     return CodecSpec(
         pc_params=jax.tree_util.tree_map(np.asarray, codec.pc_params),
         centers=np.asarray(codec.centers),
         pc_config_text=str(codec.pc_config),
         pad_value=float(codec.pad_value),
-        scale_bits=int(codec.scale_bits))
+        scale_bits=int(codec.scale_bits),
+        rung=str(rung))
 
 
 def codec_from_spec(spec: CodecSpec):

@@ -1,0 +1,132 @@
+"""Precision ladder for the serving path (ISSUE 19).
+
+A `PrecisionPolicy` names one rung of the inference precision ladder and
+knows how to cast a DSIN parameter tree onto it:
+
+* ``fp32``  — the baseline; everything float32 (identity cast).
+* ``bf16``  — distortion-side networks (encoder, decoder, siNet) carry
+  bfloat16 weights and run their convs in bfloat16 (the AE config's
+  ``compute_dtype`` knob, models/autoencoder.py `_ConvBN`).
+* ``int8``  — experimental: distortion-side weights are symmetrically
+  fake-quantized to 8-bit levels (per-tensor scale = max|w|/127, round,
+  dequantize) and stored/run in bfloat16 containers. This measures the
+  RD cost of int8 *weights* with today's kernels; a true int8 matmul
+  path would keep the same levels, so the RD evidence transfers.
+
+The one hard constraint the ladder must never touch is the rANS
+contract: `models/probclass.py` logits feed softmax -> quantized integer
+frequency tables (coding/codec.py `_tables_from_logits`) consumed by
+`coding/rans.py`, and encoder and decoder must reproduce those tables
+BIT-FOR-BIT from their own buffer state. One flipped mantissa bit in a
+probclass activation can move a quantized frequency by 1 and desync the
+coder mid-stream. The entropy-critical partitions (``probclass``, the
+quantizer ``centers`` it conditions on) are therefore *frozen-point-
+exact*: `cast_params` never touches them at any rung, and
+`check_entropy_critical` verifies every leaf is float32 — the
+cross-precision stream bit-identity gate (tests/test_precision.py,
+serve_bench ``--precision``) rests on this invariant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: ladder rungs, cheapest-precision last
+RUNGS = ("fp32", "bf16", "int8")
+
+#: top-level param partitions pinned to float32 at EVERY rung — the
+#: entropy-critical path (probclass logits -> PMFs -> rANS tables)
+ENTROPY_CRITICAL = frozenset({"probclass", "centers"})
+
+#: distortion-side partitions a rung may cast (siNet is optional)
+DISTORTION_SIDE = ("encoder", "decoder", "sinet")
+
+
+class PrecisionError(ValueError):
+    """Typed refusal: unknown rung or a violated fp32 contract."""
+
+
+def _fake_quant_int8(leaf: np.ndarray) -> jnp.ndarray:
+    """Symmetric per-tensor int8 fake-quant, dequantized into bfloat16.
+
+    scale = max|w|/127; levels are exactly representable as
+    (int in [-127, 127]) * scale up to the bf16 rounding of the product,
+    which is what the serving matmuls would see anyway."""
+    arr = np.asarray(leaf, dtype=np.float32)
+    amax = float(np.max(np.abs(arr))) if arr.size else 0.0
+    if amax == 0.0:
+        return jnp.asarray(arr, dtype=jnp.bfloat16)
+    scale = amax / 127.0
+    q = np.clip(np.rint(arr / scale), -127, 127)
+    return jnp.asarray(q * scale, dtype=jnp.bfloat16)
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """One rung of the precision ladder; picklable and hashable so it can
+    ride a ServiceConfig and a CodecSpec across process boundaries."""
+
+    rung: str = "fp32"
+
+    def __post_init__(self):
+        if self.rung not in RUNGS:
+            raise PrecisionError(
+                f"unknown precision rung {self.rung!r}; ladder is "
+                f"{RUNGS}")
+
+    @property
+    def compute_dtype(self) -> str:
+        """The AE-config ``compute_dtype`` this rung runs its convs in
+        (models/autoencoder.py `_ConvBN`): int8 weights still multiply
+        on the bf16 MXU path."""
+        return "float32" if self.rung == "fp32" else "bfloat16"
+
+    def cast_leaf(self, leaf):
+        if self.rung == "fp32":
+            return leaf
+        if self.rung == "bf16":
+            return jnp.asarray(leaf, dtype=jnp.bfloat16)
+        return _fake_quant_int8(leaf)
+
+    def cast_params(self, params: dict) -> dict:
+        """Cast the distortion-side partitions of a DSIN params dict to
+        this rung; entropy-critical partitions pass through UNTOUCHED
+        (same leaves, not copies — the fp32 contract is identity-level).
+        Unknown partitions are refused rather than guessed at: a future
+        partition must be classified here before it can serve."""
+        out = {}
+        for name, sub in params.items():
+            if name in ENTROPY_CRITICAL:
+                out[name] = sub
+            elif name in DISTORTION_SIDE:
+                out[name] = jax.tree_util.tree_map(self.cast_leaf, sub)
+            else:
+                raise PrecisionError(
+                    f"partition {name!r} is neither entropy-critical "
+                    f"{sorted(ENTROPY_CRITICAL)} nor distortion-side "
+                    f"{list(DISTORTION_SIDE)} — classify it in "
+                    f"coding/precision.py before serving it on a "
+                    f"precision ladder")
+        return out
+
+
+def check_entropy_critical(params: dict) -> None:
+    """Raise `PrecisionError` unless every entropy-critical leaf is
+    float32 — the load-time tripwire behind the stream bit-identity
+    gate. Called after any cast touches a tree that will feed a codec."""
+    for name in ENTROPY_CRITICAL:
+        if name not in params:
+            continue
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                params[name])[0]:
+            dt = jnp.asarray(leaf).dtype
+            if dt != jnp.float32:
+                raise PrecisionError(
+                    f"entropy-critical partition {name!r} leaf "
+                    f"{jax.tree_util.keystr(path)} is {dt} — the "
+                    f"probclass->rANS path is frozen-point-exact fp32 "
+                    f"at every ladder rung")

@@ -1,0 +1,166 @@
+"""Pallas TPU kernel: one fused wavefront-front of the probclass model.
+
+The jit wavefront engine (codec.py `_wavefront_pass`) dispatches the
+whole 4-layer masked-conv stack to XLA once per diagonal front — at the
+reference bottleneck (32, 40, 120) that is ~1.5k executable launches per
+volume, each doing four tiny convs over a (B, 5, 9, 9) context batch.
+This kernel fuses the entire per-front network into ONE Pallas call:
+all four masked convolutions, both relus, and the residual skip run
+over VMEM-resident activations, so per front the device sees a single
+launch and HBM sees only the context blocks in and the logits out.
+
+Layout / schedule:
+  * grid = (batch_tiles,): each step loads a (TB, cd, cs, cs) tile of
+    bucket-padded context blocks plus the (pre-masked) weight matrices,
+    and writes a (TB, L) logits tile.
+  * Every conv is a static tap loop (taps = (K//2+1)*K*K, 18 at K=3):
+    tap t contributes `slice(act) @ W[t*Cin:(t+1)*Cin]` with all slice
+    bounds static — no dynamic indexing anywhere, so the whole body is
+    straight-line MXU work.
+  * Weights arrive pre-masked in the SAME (taps*Cin, Cout) row-major
+    matrices the numpy incremental engine builds
+    (coding/incremental.py `IncrementalResShallow.__init__`), so the
+    three engines share one weight-preparation convention.
+  * Everything is float32 with `preferred_element_type=jnp.float32`:
+    this kernel sits on the entropy-critical path (its logits become
+    rANS frequency tables), which the precision ladder pins to
+    frozen-point-exact fp32 at every rung (coding/precision.py).
+
+Stream-format note: the kernel's logits differ from the XLA batch
+engine's in the last ulp (different reduction order), so a stream whose
+PMFs came from this kernel is NOT interchangeable with the other
+engines' — codec.py gives it its own header mode byte
+(`MODE_WAVEFRONT_PL`), exactly like the numpy engine got mode 2.
+
+CPU CI runs this kernel in interpret mode (tests fuzz it against the
+XLA reference); real-Mosaic timing is a `tools/tpu_checks.py` campaign
+row (`probclass_front`), where any TPU-only layout issue would surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsin_tpu.models import probclass as pc_lib
+from dsin_tpu.utils.jax_compat import pl, pltpu, require_pallas
+
+_MAX_TILE = 128     # batch rows per grid step
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (int(n) - 1).bit_length() if n > 1 else 1
+
+
+def _conv_taps(act, w_full, b_full, fshape):
+    """VALID masked conv as a static tap loop: act (TB, D, H, W, Cin),
+    w_full (taps*Cin, Cout) in (td, th, tw) row-major tap order."""
+    tb, d, h, w, cin = act.shape
+    fd, fh, fw = fshape
+    do, ho, wo = d - fd + 1, h - fh + 1, w - fw + 1
+    cout = w_full.shape[1]
+    acc = jnp.zeros((tb * do * ho * wo, cout), dtype=jnp.float32)
+    tap = 0
+    for td in range(fd):
+        for th in range(fh):
+            for tw in range(fw):
+                sl = act[:, td:td + do, th:th + ho, tw:tw + wo, :]
+                acc = acc + jnp.dot(
+                    sl.reshape(tb * do * ho * wo, cin),
+                    w_full[tap * cin:(tap + 1) * cin, :],
+                    preferred_element_type=jnp.float32)
+                tap += 1
+    return (acc + b_full[0]).reshape(tb, do, ho, wo, cout)
+
+
+def _front_kernel(x_ref, w0_ref, b0_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                  w3_ref, b3_ref, out_ref, *, ks: int):
+    fs = pc_lib.filter_shape(ks)
+    act0 = x_ref[...][..., None]                     # (TB, cd, cs, cs, 1)
+    act1 = jnp.maximum(_conv_taps(act0, w0_ref[...], b0_ref[...], fs), 0.0)
+    r1 = jnp.maximum(_conv_taps(act1, w1_ref[...], b1_ref[...], fs), 0.0)
+    dd, hw = 2 * (ks // 2), ks - 1
+    act3 = (_conv_taps(r1, w2_ref[...], b2_ref[...], fs)
+            + act1[:, dd:, hw:-hw, hw:-hw, :])
+    logits = jnp.maximum(_conv_taps(act3, w3_ref[...], b3_ref[...], fs),
+                         0.0)                        # (TB, 1, 1, 1, L)
+    out_ref[...] = logits.reshape(out_ref.shape)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def probclass_front_logits(blocks, w0, b0, w1, b1, w2, b2, w3, b3, *,
+                           interpret: bool = False):
+    """(B, cd, cs, cs) f32 context blocks -> (B, L) f32 logits, one
+    fused Pallas call (batch-tiled). Weights are the pre-masked
+    (taps*Cin, Cout) matrices; biases are (1, Cout). B is padded to a
+    tile multiple internally (zero blocks — same deterministic padding
+    the wavefront driver uses) and the pad rows are sliced back off."""
+    require_pallas()
+    b, cd, cs, _ = blocks.shape
+    ks = (cs - 1) // 4 + 1
+    assert (cd, cs, cs) == pc_lib.context_shape(ks), (blocks.shape, ks)
+    l_out = w3.shape[1]
+
+    tile = min(_MAX_TILE, _next_pow2(b))
+    bp = -(-b // tile) * tile
+    blocks = jnp.pad(blocks, ((0, bp - b), (0, 0), (0, 0), (0, 0)))
+
+    kernel = partial(_front_kernel, ks=ks)
+    full = lambda arr: pl.BlockSpec(arr.shape, lambda i: (0,) * arr.ndim,
+                                    memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bp // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, cd, cs, cs), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            full(w0), full(b0), full(w1), full(b1),
+            full(w2), full(b2), full(w3), full(b3),
+        ],
+        out_specs=pl.BlockSpec((tile, l_out), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bp, l_out), jnp.float32),
+        interpret=interpret,
+    )(blocks, w0, b0, w1, b1, w2, b2, w3, b3)
+    return out[:b]
+
+
+class ProbclassFrontKernel:
+    """Weight-holding wrapper the codec's Pallas engine mode uses.
+
+    Builds the pre-masked weight matrices ONCE (identical convention to
+    `IncrementalResShallow`) and exposes `front_logits` with the jit
+    boundary taking params as traced ARGUMENTS (functools.partial over a
+    module-level jit, the codec.py idiom) — never closure captures.
+    Read-only after construction, so one instance may be shared across
+    codec thread clones."""
+
+    def __init__(self, pc_params, pc_config, *, interpret: bool = False):
+        self.ks = int(pc_config.kernel_size)
+        masks = [pc_lib.make_mask(self.ks, include_center=bool(i))
+                 for i in (0, 1, 1, 1)]
+        names = sorted(pc_params.keys())     # _MaskedConv3D_0 .. _3
+        assert len(names) == 4, names
+        flat = []
+        for name, mask in zip(names, masks):
+            kern = np.asarray(pc_params[name]["kernel"], dtype=np.float32)
+            kern = kern * mask[..., None, None]
+            taps = mask.size
+            flat.append(jnp.asarray(
+                kern.reshape(taps * kern.shape[3], kern.shape[4])))
+            flat.append(jnp.asarray(
+                np.asarray(pc_params[name]["bias"],
+                           dtype=np.float32)[None, :]))
+        self.interpret = bool(interpret)
+        self._fn = functools.partial(probclass_front_logits,
+                                     interpret=self.interpret)
+        self._weights = tuple(flat)
+
+    def front_logits(self, blocks) -> jnp.ndarray:
+        """(B, cd, cs, cs) -> (B, L) f32 logits (device array)."""
+        return self._fn(jnp.asarray(blocks, dtype=jnp.float32),
+                        *self._weights)

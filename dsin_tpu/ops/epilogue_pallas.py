@@ -1,0 +1,200 @@
+"""Pallas TPU kernel: fused decoder epilogue + color transform.
+
+The decoder's tail (models/autoencoder.py `Decoder`) ends with a
+stride-2 5x5 transposed conv to RGB, an inference-mode BatchNorm, the
+KITTI denormalization, and a [0, 255] clip; the SI search then
+immediately re-reads that full-resolution image to apply
+`ops/color.py`'s search transform (KITTI search normalization ->
+H1H2H3). In the XLA path the (N, H, W, 3) decoded image makes an HBM
+round-trip between those two stages. This kernel fuses the whole
+epilogue: the deconv, the BN affine (folded host-side into a
+per-channel scale/bias), the denormalization (folded into the same
+affine), the clip, and the search transform (folded into one 3x3
+matmul + bias) run in a single pass, emitting BOTH the decoded image
+and its search-transformed twin without ever writing the intermediate.
+
+Layout / schedule:
+  * grid = (N,): one image per step; the pre-deconv activation rides in
+    whole, padded by 1 pixel per side so every tap is a static slice.
+  * The stride-2 SAME transposed conv is computed as its 4 polyphase
+    components: output pixel (2i+a, 2j+b) touches only the kernel taps
+    of parity class (a, b) —
+        a = 0: kh in {1, 3} reading rows {i-1, i}
+        a = 1: kh in {0, 2, 4} reading rows {i-1, i, i+1}
+    (and the same table for columns). Each phase is a 4/6/9-tap conv
+    over static slices; one `jnp.dot` per tap against the (Cin, 3)
+    row-block of the flattened kernel matrix, accumulated in f32. The
+    four phase images interleave back via a reshape.
+  * Equivalence to flax: `nn.ConvTranspose(SAME, stride 2, k5, no
+    bias)` == `conv_general_dilated(x, w, strides=(1,1),
+    padding=((3,2),(3,2)), lhs_dilation=(2,2))` with NO kernel flip;
+    the polyphase table above is that convolution re-indexed by output
+    parity (verified against flax in tests/test_epilogue_pallas.py).
+
+Precision: the epilogue is distortion-side, so the matmuls accept the
+ladder's compute dtype (bf16 operands, f32 accumulation via
+`preferred_element_type`); the affine/clip/search tail is always f32 —
+matching the XLA Decoder, which casts to f32 before denormalizing.
+
+CPU CI runs the kernel in interpret mode (fuzzed against
+`epilogue_reference` below); real-Mosaic timing is a
+`tools/tpu_checks.py` campaign row (`epilogue`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dsin_tpu.models import autoencoder as ae_lib
+from dsin_tpu.ops import color as color_lib
+from dsin_tpu.utils.jax_compat import pl, pltpu, require_pallas
+
+_K = 5          # epilogue deconv kernel size (reference CVPR arch)
+_BN_EPS = 1e-5  # models/autoencoder.py _BN_KW
+
+#: polyphase tap table for the stride-2 k5 SAME transposed conv:
+#: parity -> ((kernel_index, input_offset), ...)
+_PHASE_TAPS = {0: ((1, -1), (3, 0)),
+               1: ((0, -1), (2, 0), (4, 1))}
+
+#: H1H2H3 as a matrix on (..., RGB): columns are (R+G, R-G, .5R+.5B)
+_H1H2H3 = np.array([[1.0, 1.0, 0.5],
+                    [1.0, -1.0, 0.0],
+                    [0.0, 0.0, 0.5]], dtype=np.float32)
+
+
+class EpilogueParams(NamedTuple):
+    """Host-folded epilogue operands (all float32 numpy-convertible):
+    wmat (25*Cin, 3) flattened deconv kernel, img_scale/img_bias (1, 3)
+    = BN affine x denormalization, st_mat (3, 3) / st_bias (1, 3) =
+    search normalization folded into the H1H2H3 map."""
+    wmat: jnp.ndarray
+    img_scale: jnp.ndarray
+    img_bias: jnp.ndarray
+    st_mat: jnp.ndarray
+    st_bias: jnp.ndarray
+
+
+def fold_epilogue_params(decoder_params, decoder_stats,
+                         normalization: str) -> EpilogueParams:
+    """Fold the decoder's final `_ConvBN_2` + denormalization + search
+    transform into the kernel's operand set. `decoder_params` /
+    `decoder_stats` are the DSIN `params["decoder"]` /
+    `batch_stats["decoder"]` subtrees; `normalization` is the AE
+    config's style ('FIXED' or 'OFF')."""
+    final = decoder_params["_ConvBN_2"]
+    w = np.asarray(final["ConvTranspose_0"]["kernel"], dtype=np.float32)
+    assert w.shape[:2] == (_K, _K) and w.shape[3] == 3, w.shape
+    bn = final["BatchNorm_0"]
+    stats = decoder_stats["_ConvBN_2"]["BatchNorm_0"]
+    inv_std = 1.0 / np.sqrt(np.asarray(stats["var"], np.float32) + _BN_EPS)
+    bn_scale = np.asarray(bn["scale"], np.float32) * inv_std
+    bn_bias = (np.asarray(bn["bias"], np.float32)
+               - np.asarray(stats["mean"], np.float32) * bn_scale)
+    if normalization == "FIXED":
+        dn_scale = np.sqrt(ae_lib.KITTI_VAR + 1e-10)
+        dn_mean = ae_lib.KITTI_MEAN
+    elif normalization == "OFF":
+        dn_scale = np.ones(3, np.float32)
+        dn_mean = np.zeros(3, np.float32)
+    else:
+        raise ValueError(f"invalid normalization style {normalization!r}")
+    img_scale = bn_scale * dn_scale
+    img_bias = bn_bias * dn_scale + dn_mean
+    inv_sv = 1.0 / color_lib.SEARCH_VARS
+    st_mat = inv_sv[:, None] * _H1H2H3
+    st_bias = -(color_lib.SEARCH_MEANS * inv_sv) @ _H1H2H3
+    cin = w.shape[2]
+    return EpilogueParams(
+        wmat=jnp.asarray(w.reshape(_K * _K * cin, 3)),
+        img_scale=jnp.asarray(img_scale[None, :]),
+        img_bias=jnp.asarray(img_bias[None, :]),
+        st_mat=jnp.asarray(st_mat),
+        st_bias=jnp.asarray(st_bias[None, :].astype(np.float32)))
+
+
+def _epilogue_kernel(x_ref, w_ref, s_ref, t_ref, m_ref, c_ref,
+                     img_out, srch_out):
+    _, hp, wp, cin = x_ref.shape
+    h2, w2 = hp - 2, wp - 2
+    xp = x_ref[0]                                    # (H2+2, W2+2, Cin)
+    wmat = w_ref[...]
+    phases = []
+    for a in (0, 1):
+        row = []
+        for b in (0, 1):
+            acc = jnp.zeros((h2 * w2, 3), dtype=jnp.float32)
+            for kh, oh in _PHASE_TAPS[a]:
+                for kw, ow in _PHASE_TAPS[b]:
+                    sl = xp[1 + oh:1 + oh + h2, 1 + ow:1 + ow + w2, :]
+                    acc = acc + jnp.dot(
+                        sl.reshape(h2 * w2, cin),
+                        wmat[(kh * _K + kw) * cin:(kh * _K + kw + 1) * cin],
+                        preferred_element_type=jnp.float32)
+            row.append(acc.reshape(h2, w2, 3))
+        phases.append(jnp.stack(row, axis=2))        # (H2, W2, 2, 3)
+    full = jnp.stack(phases, axis=1)                 # (H2, 2, W2, 2, 3)
+    conv = full.reshape(2 * h2, 2 * w2, 3)
+    img = jnp.clip(conv * s_ref[0] + t_ref[0], 0.0, 255.0)
+    srch = (jnp.dot(img.reshape(-1, 3), m_ref[...],
+                    preferred_element_type=jnp.float32)
+            + c_ref[0]).reshape(img.shape)
+    img_out[0] = img
+    srch_out[0] = srch
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fused_decode_epilogue(x, wmat, img_scale, img_bias, st_mat, st_bias,
+                          *, interpret: bool = False):
+    """x (N, H2, W2, Cin) pre-deconv activation -> (decoded image
+    (N, 2*H2, 2*W2, 3) f32 in [0, 255], search-transformed image of the
+    same shape), one fused Pallas pass per image. Operands come from
+    `fold_epilogue_params`; cast `x`/`wmat` to the ladder's compute
+    dtype before calling — accumulation stays f32 either way."""
+    require_pallas()
+    n, h2, w2, cin = x.shape
+    xpad = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    full = lambda arr: pl.BlockSpec(arr.shape, lambda i: (0,) * arr.ndim,
+                                    memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((1, 2 * h2, 2 * w2, 3),
+                            lambda i: (i, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+    img, srch = pl.pallas_call(
+        _epilogue_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h2 + 2, w2 + 2, cin),
+                         lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            full(wmat), full(img_scale), full(img_bias),
+            full(st_mat), full(st_bias),
+        ],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 2 * h2, 2 * w2, 3), jnp.float32),
+            jax.ShapeDtypeStruct((n, 2 * h2, 2 * w2, 3), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xpad, wmat, img_scale, img_bias, st_mat, st_bias)
+    return img, srch
+
+
+def epilogue_reference(x, wmat, img_scale, img_bias, st_mat, st_bias):
+    """XLA reference the kernel is fuzzed against: the lhs-dilated-conv
+    form of the flax transposed conv, then the same folded affine,
+    clip, and search map. Shares the kernel's operand convention so a
+    fold bug cannot hide between two preparation paths."""
+    n, h2, w2, cin = x.shape
+    w = jnp.reshape(wmat, (_K, _K, cin, 3)).astype(x.dtype)
+    conv = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=((3, 2), (3, 2)),
+        lhs_dilation=(2, 2),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")).astype(jnp.float32)
+    img = jnp.clip(conv * img_scale[0] + img_bias[0], 0.0, 255.0)
+    srch = (img.reshape(-1, 3) @ st_mat + st_bias[0]).reshape(img.shape)
+    return img, srch

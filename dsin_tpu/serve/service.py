@@ -393,6 +393,16 @@ class ServiceConfig:
     canary_seed: int = 0
     #: per-op result timeout inside one canary probe
     canary_timeout_s: float = 120.0
+    #: precision-ladder rung (ISSUE 19, coding/precision.py): "fp32"
+    #: (baseline), "bf16" (distortion-side nets in bfloat16), or "int8"
+    #: (experimental fake-quantized weights in bf16 containers). The
+    #: entropy-critical probclass/centers path stays frozen-point-exact
+    #: fp32 at every rung — streams are byte-identical across rungs for
+    #: the same symbols. The rung folds into the model digest
+    #: (loader.params_digest), so fleet handshake / hot-swap / canary
+    #: can never mix rungs silently, and hot swaps re-cast incoming
+    #: checkpoints onto THIS rung after manifest verification.
+    precision: str = "fp32"
     #: persistent XLA compilation cache (utils/cache.py) at start(), so
     #: a restarted service re-warms from disk instead of recompiling
     persistent_cache: bool = True
@@ -777,6 +787,10 @@ class CompressionService:
         if self.config.transport not in ("pipe", "shm"):
             raise ValueError(f"transport must be 'pipe' or 'shm', got "
                              f"{self.config.transport!r}")
+        # precision rung (ISSUE 19): constructing the policy validates
+        # the rung name with the same typo-costs-milliseconds timing
+        from dsin_tpu.coding import precision as precision_lib
+        precision_lib.PrecisionPolicy(self.config.precision)
         # canary knobs (ISSUE 13), validated with the rest up front
         if self.config.canary_every_s is not None \
                 and self.config.canary_every_s <= 0:
@@ -852,7 +866,8 @@ class CompressionService:
         self.model, state = load_model_state(
             self.config.ae_config, self.config.pc_config, self.config.ckpt,
             init_shape, need_sinet=self._si_enabled, seed=self.config.seed,
-            persistent_cache=self.config.persistent_cache)
+            persistent_cache=self.config.persistent_cache,
+            precision=self.config.precision)
         codec = make_codec(self.model, state)
         self._encode_fn, self._decode_fn = _make_batched_fns(self.model)
         if self._si_enabled:
@@ -924,7 +939,8 @@ class CompressionService:
             # the spec is built per BUNDLE (numpy pulls happen here, on
             # the caller's thread, never under the pool-slot lock) and
             # reused by that bundle's child-death rebuilds
-            initargs = (loader_lib.make_codec_spec(codec),
+            initargs = (loader_lib.make_codec_spec(
+                codec, rung=self.config.precision),
                         list(self._warm_shapes))
         # the start-time bundle keeps its checkpoint's manifest too
         # (swapped-in bundles always did): the canary prober compares
@@ -939,7 +955,8 @@ class CompressionService:
                 start_manifest = None   # legacy/corrupt: load_model_state
                 #                         already owns that verdict
         bundle = swap_lib.ModelBundle(
-            0, loader_lib.params_digest((state.params, state.batch_stats)),
+            0, loader_lib.params_digest((state.params, state.batch_stats),
+                                        rung=self.config.precision),
             state, codec, device_state, ckpt=self.config.ckpt,
             proc_initargs=initargs, manifest=start_manifest)
         if initargs is not None:
@@ -1212,11 +1229,23 @@ class CompressionService:
                 pc_config=self.model.pc_config,
                 buckets=self.policy.buckets,
                 need_sinet=self._si_enabled)
+            if self.config.precision != "fp32":
+                # re-cast the incoming checkpoint onto THIS service's
+                # rung AFTER its manifest verified (identity against the
+                # checkpoint's own bytes, then the serving copy drops
+                # precision) — a swap must never change rungs silently
+                from dsin_tpu.coding import precision as precision_lib
+                policy = precision_lib.PrecisionPolicy(
+                    self.config.precision)
+                new_state = new_state.replace(
+                    params=policy.cast_params(new_state.params))
+                precision_lib.check_entropy_critical(new_state.params)
             # the prepare window: a kill here must leave the service
             # serving the old params with the claim released
             faults.inject("serve.swap")
             digest = loader_lib.params_digest(
-                (new_state.params, new_state.batch_stats))
+                (new_state.params, new_state.batch_stats),
+                rung=self.config.precision)
             codec = loader_lib.make_codec(self.model, new_state)
             device_state = [
                 self.placement.replicate(
@@ -1224,7 +1253,8 @@ class CompressionService:
                 for d in range(self._num_devices)]
             initargs = None
             if self._proc_backend:
-                initargs = (loader_lib.make_codec_spec(codec),
+                initargs = (loader_lib.make_codec_spec(
+                    codec, rung=self.config.precision),
                             list(self._warm_shapes))
             bundle = swap_lib.ModelBundle(
                 epoch, digest, new_state, codec, device_state,

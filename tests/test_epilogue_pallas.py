@@ -1,0 +1,152 @@
+"""Fused decode+color-transform epilogue kernel vs. its references.
+
+Three independent anchors pin the kernel:
+  * `epilogue_reference` (the lhs-dilated-conv + folded-affine form) —
+    fuzzed at several geometries/batches through the Pallas interpreter;
+  * the REAL flax tail it replaces — `_ConvBN(3, 5, stride=2,
+    transpose=True, relu=False)` in inference mode, then the f32 cast,
+    KITTI denormalization, and clip (models/autoencoder.py Decoder's
+    last stage) — applied with the smoke model's actual decoder
+    subtree, so `fold_epilogue_params` is checked against flax itself,
+    not against our own re-derivation;
+  * `ops/color.py` `search_transform` — the second kernel output must
+    BE the search image of the first.
+
+Real-Mosaic timing is the tools/tpu_checks.py `epilogue` campaign row.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dsin_tpu.coding import loader
+from dsin_tpu.models import autoencoder as ae_lib
+from dsin_tpu.ops import color as color_lib
+from dsin_tpu.ops.epilogue_pallas import (epilogue_reference,
+                                          fold_epilogue_params,
+                                          fused_decode_epilogue)
+
+# KITTI denorm scales conv outputs by ~75 per channel, so f32
+# reduction-order slack lands around 1e-4 in [0, 255] pixel units
+_ATOL = 1e-3
+
+
+@pytest.fixture(scope="module")
+def folded(tmp_path_factory):
+    from tools.serve_bench import _write_smoke_cfgs
+    d = str(tmp_path_factory.mktemp("epilogue_cfgs"))
+    ae_p, pc_p = _write_smoke_cfgs(d)
+    model, state = loader.load_model_state(ae_p, pc_p, None, (48, 96),
+                                           need_sinet=False, seed=0)
+    epi = fold_epilogue_params(state.params["decoder"],
+                               state.batch_stats["decoder"], "FIXED")
+    return state, epi
+
+
+def _x_pre(epi, n, h2, w2, seed, scale=1.0):
+    cin = epi.wmat.shape[0] // 25
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(scale=scale, size=(n, h2, w2, cin))
+                       .astype(np.float32))
+
+
+@pytest.mark.parametrize("shape", [(1, 6, 12), (2, 5, 9), (1, 7, 16)])
+def test_kernel_matches_reference_fuzz(folded, shape):
+    _, epi = folded
+    x = _x_pre(epi, *shape, seed=sum(shape))
+    img_k, srch_k = fused_decode_epilogue(x, *epi, interpret=True)
+    img_r, srch_r = epilogue_reference(x, *epi)
+    n, h2, w2 = shape
+    assert img_k.shape == (n, 2 * h2, 2 * w2, 3)
+    np.testing.assert_allclose(np.asarray(img_k), np.asarray(img_r),
+                               rtol=1e-5, atol=_ATOL)
+    np.testing.assert_allclose(np.asarray(srch_k), np.asarray(srch_r),
+                               rtol=1e-5, atol=_ATOL)
+
+
+def test_kernel_matches_real_flax_decoder_tail(folded):
+    """The fused epilogue against the flax ops it replaces, using the
+    smoke model's OWN `_ConvBN_2` params and running BN stats — a fold
+    bug (BN affine, denorm, polyphase table) cannot hide here."""
+    import flax.linen as nn
+
+    state, epi = folded
+
+    class _Tail(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = ae_lib._ConvBN(3, 5, stride=2, transpose=True,
+                               relu=False)(x, train=False)
+            x = jnp.asarray(x, jnp.float32)
+            x = ae_lib.denormalize_image(x, "FIXED")
+            return jnp.clip(x, 0.0, 255.0)
+
+    variables = {
+        "params": {"_ConvBN_0": state.params["decoder"]["_ConvBN_2"]},
+        "batch_stats":
+            {"_ConvBN_0": state.batch_stats["decoder"]["_ConvBN_2"]},
+    }
+    x = _x_pre(epi, 2, 6, 12, seed=21)
+    ref = _Tail().apply(variables, x)
+    img_k, srch_k = fused_decode_epilogue(x, *epi, interpret=True)
+    np.testing.assert_allclose(np.asarray(img_k), np.asarray(ref),
+                               rtol=1e-5, atol=_ATOL)
+    # the search output IS ops/color.py's transform of that image
+    srch_ref = color_lib.search_transform(ref, False)
+    np.testing.assert_allclose(np.asarray(srch_k), np.asarray(srch_ref),
+                               rtol=1e-4, atol=_ATOL)
+
+
+def test_reference_matches_flax_convtranspose_form():
+    """The documented equivalence the polyphase table is derived from:
+    flax `nn.ConvTranspose(SAME, stride 2, k5, no bias)` == the
+    lhs-dilated conv with padding ((3,2),(3,2)) and NO kernel flip —
+    checked with a random kernel, independent of any fold."""
+    import flax.linen as nn
+    import jax
+
+    rng = np.random.default_rng(2)
+    cin = 4
+    x = jnp.asarray(rng.normal(size=(1, 5, 9, cin)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(5, 5, cin, 3)).astype(np.float32))
+    mod = nn.ConvTranspose(3, (5, 5), strides=(2, 2), padding="SAME",
+                           use_bias=False)
+    ref = mod.apply({"params": {"kernel": w}}, x)
+    dil = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=((3, 2), (3, 2)),
+        lhs_dilation=(2, 2),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(dil), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_clip_saturates_to_pixel_range(folded):
+    """Large pre-activations must pin the image to [0, 255] with both
+    rails actually hit — the clip lives INSIDE the kernel, before the
+    search transform reads the image."""
+    _, epi = folded
+    x = _x_pre(epi, 1, 6, 12, seed=9, scale=50.0)
+    img_k, srch_k = fused_decode_epilogue(x, *epi, interpret=True)
+    img = np.asarray(img_k)
+    assert img.min() == 0.0 and img.max() == 255.0
+    # and the search twin saw the CLIPPED image, not the raw conv
+    srch_ref = color_lib.search_transform(jnp.asarray(img), False)
+    np.testing.assert_allclose(np.asarray(srch_k), np.asarray(srch_ref),
+                               rtol=1e-4, atol=_ATOL)
+
+
+def test_off_normalization_fold(folded):
+    """normalization='OFF' folds to identity denorm; an unknown style is
+    refused at fold time."""
+    state, _ = folded
+    epi_off = fold_epilogue_params(state.params["decoder"],
+                                   state.batch_stats["decoder"], "OFF")
+    x = _x_pre(epi_off, 1, 5, 9, seed=4)
+    img_k, _ = fused_decode_epilogue(x, *epi_off, interpret=True)
+    img_r, _ = epilogue_reference(x, *epi_off)
+    np.testing.assert_allclose(np.asarray(img_k), np.asarray(img_r),
+                               rtol=1e-5, atol=_ATOL)
+    with pytest.raises(ValueError, match="normalization"):
+        fold_epilogue_params(state.params["decoder"],
+                             state.batch_stats["decoder"], "WAT")

@@ -1,0 +1,247 @@
+"""Precision ladder (ISSUE 19): policy casting, digest rung-awareness,
+and the cross-precision codec invariant.
+
+The one contract everything here defends: the entropy-critical path
+(probclass logits -> quantized PMFs -> rANS) is frozen-point-exact fp32
+at EVERY rung, so streams produced by codecs built from fp32/bf16/int8
+serving bundles are byte-identical — a flipped mantissa bit anywhere in
+that path desyncs the coder mid-stream, which is why `cast_params` must
+pass the entropy-critical partitions through untouched (identity, not
+copies) and `check_entropy_critical` trips on any drift.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dsin_tpu.coding import loader
+from dsin_tpu.coding import precision as precision_lib
+from dsin_tpu.coding.precision import (PrecisionError, PrecisionPolicy,
+                                       check_entropy_critical)
+
+
+def _fake_params(seed=0):
+    """Minimal DSIN-shaped params dict: two distortion-side partitions,
+    the two entropy-critical ones, plus nested leaves."""
+    rng = np.random.default_rng(seed)
+    leaf = lambda *s: jnp.asarray(rng.normal(size=s).astype(np.float32))
+    return {
+        "encoder": {"_ConvBN_0": {"kernel": leaf(3, 3, 4, 8),
+                                  "bias": leaf(8)}},
+        "decoder": {"_ConvBN_2": {"kernel": leaf(5, 5, 4, 3)}},
+        "probclass": {"_MaskedConv3D_0": {"kernel": leaf(2, 3, 3, 1, 24),
+                                          "bias": leaf(24)}},
+        "centers": leaf(6),
+    }
+
+
+# -- policy casting ----------------------------------------------------------
+
+def test_fp32_rung_is_identity():
+    params = _fake_params()
+    out = PrecisionPolicy("fp32").cast_params(params)
+    for name in params:
+        flat_in = jax.tree_util.tree_leaves(params[name])
+        flat_out = jax.tree_util.tree_leaves(out[name])
+        assert all(a is b for a, b in zip(flat_in, flat_out)), name
+
+
+@pytest.mark.parametrize("rung", ["bf16", "int8"])
+def test_entropy_critical_partitions_pass_through_untouched(rung):
+    """Not equal — IDENTICAL. The fp32 contract is identity-level: the
+    codec must see the exact restored arrays, not even a copy."""
+    params = _fake_params()
+    out = PrecisionPolicy(rung).cast_params(params)
+    for name in precision_lib.ENTROPY_CRITICAL:
+        flat_in = jax.tree_util.tree_leaves(params[name])
+        flat_out = jax.tree_util.tree_leaves(out[name])
+        assert all(a is b for a, b in zip(flat_in, flat_out)), name
+    check_entropy_critical(out)
+
+
+def test_bf16_rung_casts_distortion_side():
+    params = _fake_params()
+    out = PrecisionPolicy("bf16").cast_params(params)
+    for name in precision_lib.DISTORTION_SIDE:
+        if name not in out:
+            continue
+        for leaf in jax.tree_util.tree_leaves(out[name]):
+            assert leaf.dtype == jnp.bfloat16, name
+    # values are the bf16 rounding of the originals
+    orig = np.asarray(params["encoder"]["_ConvBN_0"]["kernel"])
+    cast = np.asarray(out["encoder"]["_ConvBN_0"]["kernel"],
+                      dtype=np.float32)
+    np.testing.assert_allclose(cast, orig, rtol=2 ** -8)
+
+
+def test_int8_rung_fake_quant_properties():
+    """Symmetric per-tensor int8: at most 255 distinct levels, error
+    bounded by one quantization step, zero tensors stay zero, sign
+    symmetry holds."""
+    params = _fake_params()
+    out = PrecisionPolicy("int8").cast_params(params)
+    orig = np.asarray(params["encoder"]["_ConvBN_0"]["kernel"])
+    cast = np.asarray(out["encoder"]["_ConvBN_0"]["kernel"],
+                      dtype=np.float32)
+    assert out["encoder"]["_ConvBN_0"]["kernel"].dtype == jnp.bfloat16
+    assert len(np.unique(cast)) <= 255
+    amax = float(np.max(np.abs(orig)))
+    # half a step of rounding plus the bf16 container's own rounding
+    assert float(np.max(np.abs(cast - orig))) <= amax / 127.0
+    zeros = precision_lib._fake_quant_int8(np.zeros((4, 4), np.float32))
+    assert np.all(np.asarray(zeros, np.float32) == 0.0)
+    sym = precision_lib._fake_quant_int8(np.array([1.0, -1.0], np.float32))
+    vals = np.asarray(sym, np.float32)
+    assert vals[0] == -vals[1]
+
+
+def test_unknown_rung_refused():
+    with pytest.raises(PrecisionError, match="unknown precision rung"):
+        PrecisionPolicy("fp16")
+
+
+def test_unknown_partition_refused_not_guessed():
+    """A future partition must be CLASSIFIED before it can ride the
+    ladder — silently passing it through (entropy-critical semantics) or
+    silently casting it (distortion semantics) are both wrong guesses."""
+    params = _fake_params()
+    params["adapter"] = {"kernel": jnp.ones((2, 2), jnp.float32)}
+    with pytest.raises(PrecisionError, match="adapter"):
+        PrecisionPolicy("bf16").cast_params(params)
+
+
+def test_compute_dtype_follows_rung():
+    assert PrecisionPolicy("fp32").compute_dtype == "float32"
+    assert PrecisionPolicy("bf16").compute_dtype == "bfloat16"
+    # int8 weights still multiply on the bf16 MXU path
+    assert PrecisionPolicy("int8").compute_dtype == "bfloat16"
+
+
+def test_check_entropy_critical_trips_on_drift():
+    params = _fake_params()
+    check_entropy_critical(params)  # fp32 baseline passes
+    params["probclass"]["_MaskedConv3D_0"]["kernel"] = jnp.asarray(
+        params["probclass"]["_MaskedConv3D_0"]["kernel"],
+        dtype=jnp.bfloat16)
+    with pytest.raises(PrecisionError, match="frozen-point-exact"):
+        check_entropy_critical(params)
+
+
+# -- params digest rung-awareness (satellite b) ------------------------------
+
+def test_digest_differs_across_rung_tags():
+    params = _fake_params()
+    digests = {r: loader.params_digest(params, rung=r)
+               for r in precision_lib.RUNGS}
+    assert len(set(digests.values())) == len(precision_lib.RUNGS), digests
+
+
+def test_digest_fp32_and_bf16_casts_cannot_collide():
+    """Regression for the fleet-handshake hazard the preimage rework
+    closes: an fp32 bundle and its bf16 cast must hash apart BOTH via
+    the explicit rung tag and via the per-leaf dtype field — two
+    replicas serving different rungs of one checkpoint can never pass
+    the router's identity comparison."""
+    params = _fake_params()
+    cast = PrecisionPolicy("bf16").cast_params(params)
+    d_fp32 = loader.params_digest(params, rung="fp32")
+    d_bf16 = loader.params_digest(cast, rung="bf16")
+    assert d_fp32 != d_bf16
+    # even with the rung tags FORCED equal the leaf dtypes separate them
+    assert loader.params_digest(params, rung="fp32") != \
+        loader.params_digest(cast, rung="fp32")
+
+
+def test_digest_dtype_in_preimage_same_bytes_same_shape():
+    """Two trees whose leaves have identical shape AND identical raw
+    bytes but different dtypes must hash apart — the dtype field has to
+    carry the distinction on its own (the old concatenated preimage
+    relied on the bytes differing)."""
+    a = {"w": np.zeros(4, np.float32)}
+    b = {"w": np.zeros(4, np.int32)}
+    assert a["w"].tobytes() == b["w"].tobytes()
+    assert loader.params_digest(a) != loader.params_digest(b)
+
+
+def test_digest_stable_and_order_independent_of_insertion():
+    params = _fake_params()
+    again = {k: params[k] for k in reversed(list(params))}
+    assert loader.params_digest(params) == loader.params_digest(again)
+
+
+# -- cross-precision codec invariant (satellite c) ---------------------------
+
+@pytest.fixture(scope="module")
+def smoke_model(tmp_path_factory):
+    from tools.serve_bench import _write_smoke_cfgs
+    d = str(tmp_path_factory.mktemp("precision_cfgs"))
+    ae_p, pc_p = _write_smoke_cfgs(d)
+    model, state = loader.load_model_state(ae_p, pc_p, None, (48, 96),
+                                           need_sinet=False, seed=0)
+    return ae_p, pc_p, model, state
+
+
+def test_cross_precision_streams_byte_identical(smoke_model):
+    """Fuzz encode->decode at every rung over mixed bucket shapes: the
+    rANS streams must be BYTE-identical across rungs (same probclass
+    params + centers => same quantized tables => same bytes), every
+    stream must round-trip, and a stream from one rung must decode on
+    another rung's codec — the wire format carries no rung at all."""
+    _, _, model, state = smoke_model
+    codecs = {}
+    for rung in precision_lib.RUNGS:
+        policy = PrecisionPolicy(rung)
+        st = state.replace(params=policy.cast_params(state.params))
+        check_entropy_critical(st.params)
+        codecs[rung] = loader.make_codec(model, st)
+
+    rng = np.random.default_rng(1234)
+    d = codecs["fp32"].num_centers
+    for shape in [(4, 6, 12), (4, 8, 12), (4, 5, 7)]:
+        vol = rng.integers(0, d, size=shape).astype(np.int32)
+        for mode in ("wavefront_np", "wavefront"):
+            streams = {r: codecs[r].encode(vol, mode=mode)
+                       for r in precision_lib.RUNGS}
+            assert len(set(streams.values())) == 1, (
+                shape, mode, {r: len(s) for r, s in streams.items()})
+            # cross-rung decode: int8's codec reads fp32's bytes
+            np.testing.assert_array_equal(
+                codecs["int8"].decode(streams["fp32"]), vol)
+            np.testing.assert_array_equal(
+                codecs["fp32"].decode(streams["int8"]), vol)
+
+
+def test_load_model_state_casts_after_restore(smoke_model):
+    """The loader's precision hook: distortion-side params at the rung's
+    dtype, probclass/centers untouched fp32, compute_dtype threaded into
+    the AE config — and the rung-aware digest separates the bundles."""
+    ae_p, pc_p, _, state_fp32 = smoke_model
+    model_bf16, state_bf16 = loader.load_model_state(
+        ae_p, pc_p, None, (48, 96), need_sinet=False, seed=0,
+        precision="bf16")
+    assert model_bf16.ae_config.compute_dtype == "bfloat16"
+    for leaf in jax.tree_util.tree_leaves(state_bf16.params["encoder"]):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree_util.tree_leaves(state_bf16.params["probclass"]):
+        assert leaf.dtype == jnp.float32
+    # same seed, same init: the probclass partitions are bit-equal, so
+    # the two bundles build byte-compatible codecs...
+    np.testing.assert_array_equal(
+        np.asarray(state_fp32.params["centers"]),
+        np.asarray(state_bf16.params["centers"]))
+    # ...yet their serving identities stay distinct
+    assert loader.params_digest(state_fp32.params, rung="fp32") != \
+        loader.params_digest(state_bf16.params, rung="bf16")
+
+
+def test_codec_spec_carries_rung(smoke_model):
+    _, _, model, state = smoke_model
+    codec = loader.make_codec(model, state)
+    spec = loader.make_codec_spec(codec, rung="bf16")
+    assert spec.rung == "bf16"
+    rebuilt = loader.codec_from_spec(spec)
+    vol = np.random.default_rng(7).integers(
+        0, codec.num_centers, size=(4, 6, 12)).astype(np.int32)
+    assert rebuilt.encode(vol) == codec.encode(vol)

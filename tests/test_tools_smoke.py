@@ -487,6 +487,112 @@ def test_chaos_bench_smoke_json_contract(tmp_path):
     assert report["invariants"]["flight_dumps"] >= 1
 
 
+@pytest.mark.slow
+def test_serve_bench_precision_smoke_json_contract(tmp_path):
+    """The precision-bench stage's first artifact (ISSUE 19): every
+    ladder rung present with all eight per-stage device-ms timings
+    (both Pallas kernels AND their XLA references), zero steady-state
+    compiles, every stream round-tripping, and the cross-rung rANS
+    streams BYTE-identical in both incremental modes — the bench itself
+    exits 1 otherwise; re-pin the artifact shape here so a silent gate
+    removal cannot pass the suite."""
+    out = tmp_path / "precision.json"
+    r = _run("serve_bench.py", "--smoke", "--precision", "--devices", "",
+             "--out", str(out))
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(out.read_text())
+    sec = report["precision"]
+    assert sec["rungs"] == ["fp32", "bf16", "int8"]
+    assert sec["streams_bit_identical"] is True
+    stages = {"encode", "decode", "probclass_front_pallas",
+              "probclass_front_xla", "si_search", "sinet",
+              "epilogue_pallas", "epilogue_xla"}
+    digests = set()
+    for rung in sec["rungs"]:
+        entry = sec["per_rung"][rung]
+        assert set(entry["stage_device_ms"]) == stages, rung
+        for name, ms in entry["stage_device_ms"].items():
+            assert ms > 0, (rung, name, ms)
+        assert entry["steady_compiles"] == 0, (rung, entry)
+        assert entry["roundtrip_ok"] == {"wavefront_np": True,
+                                         "wavefront_pl": True}
+        digests.add(tuple(sorted(entry["stream_sha256"].items())))
+    assert len(digests) == 1, "cross-rung stream digests diverged"
+    # the two modes are distinct stream FORMATS (last-ulp PMF floats)
+    assert sec["per_rung"]["fp32"]["stream_sha256"]["wavefront_np"] != \
+        sec["per_rung"]["fp32"]["stream_sha256"]["wavefront_pl"]
+
+
+@pytest.mark.slow
+def test_bench_rd_delta_gate_smoke():
+    """The precision-bench stage's second artifact: bench.py's RD-delta
+    gate must emit its one-line JSON with per-rung PSNR/MS-SSIM deltas
+    inside the pinned budgets, cross-rung stream bit-identity, and
+    pass=true (rc 1 otherwise; stream divergence is a HARD violation)."""
+    env = dict(os.environ, BENCH_RD_DELTA="1", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["pass"] is True
+    assert payload["violations"] == []
+    assert payload["metric"] == "precision_rd_psnr_delta_max"
+    assert payload["streams_bit_identical"] is True
+    for rung in ("bf16", "int8"):
+        entry = payload["per_rung"][rung]
+        assert entry["psnr_delta"] <= entry["budgets"]["psnr_db"], entry
+        assert entry["msssim_delta"] <= entry["budgets"]["msssim"], entry
+        assert entry["stream_sha256"] == \
+            payload["per_rung"]["fp32"]["stream_sha256"], entry
+
+
+def test_tpu_campaign_manifest_matches_code():
+    """The committed artifacts/tpu_campaign.json must equal what
+    tools/tpu_checks.py generates TODAY — a campaign edit without a
+    manifest regen (or vice versa) ships a runnable manifest that lies
+    about what the runner will do."""
+    from tools import tpu_checks
+    with open(os.path.join(REPO, "artifacts", "tpu_campaign.json")) as f:
+        committed = json.load(f)
+    assert committed == tpu_checks.build_manifest()
+    names = [c["name"] for c in committed["checks"]]
+    # the four deferred real-TPU measurements plus the ISSUE 19 rows
+    assert names == ["sifinder", "probclass_front", "epilogue",
+                     "precision", "multichip", "swap_latency",
+                     "add_drain"]
+    for check in committed["checks"]:
+        assert check["kind"] in ("inline", "subprocess")
+        assert check["deferred_from"] and check["why"] and check["writes"]
+        if check["kind"] == "subprocess":
+            assert check["argv"][0].startswith("tools/")
+
+
+def test_tpu_checks_cli_list_and_refusal():
+    """--list needs no backend and names every campaign row; a real run
+    on a non-TPU backend must refuse (rc 1) WITHOUT touching the
+    committed evidence file."""
+    r = _run("tpu_checks.py", "--list")
+    assert r.returncode == 0, r.stderr[-2000:]
+    for name in ("sifinder", "probclass_front", "epilogue", "precision",
+                 "multichip", "swap_latency", "add_drain"):
+        assert name in r.stdout, r.stdout
+    r2 = _run("tpu_checks.py", "--only", "nonexistent_check")
+    assert r2.returncode == 2
+    evidence = os.path.join(REPO, "artifacts", "TPU_CHECKS.json")
+    before = open(evidence, "rb").read() if os.path.exists(evidence) \
+        else None
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_checks.py"),
+         "--only", "swap_latency"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r3.returncode == 1
+    assert "refus" in (r3.stdout + r3.stderr).lower()
+    after = open(evidence, "rb").read() if os.path.exists(evidence) \
+        else None
+    assert after == before, "non-TPU run touched the evidence file"
+
+
 def test_cache_dir_keyed_by_host_fingerprint(monkeypatch, tmp_path):
     """XLA:CPU AOT cache entries embed the COMPILE host's CPU features;
     a dir shared across hosts loads mismatched code with documented

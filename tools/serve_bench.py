@@ -92,6 +92,23 @@ before AND after the promotion, a scrape that missed a member, or any
 bench-process compile — the fail-fast `federation-bench`
 tpu_session.sh stage.
 
+Precision-ladder axis (ISSUE 19): the full (artifact) run and the
+dedicated `--precision` stage build the serving model once per ladder
+rung (fp32 / bf16 / int8, coding/precision.py) and record per-stage
+device-ms — encode, decode, the probclass wavefront front (fused Pallas
+kernel vs the XLA batch reference), the prepped SI search, siNet, and
+the fused decode+color epilogue (Pallas vs XLA) — every timed call
+under `CompilationSentinel(budget=0)`. One deterministic symbol volume
+is encoded through every rung's codec in both incremental modes; the
+streams MUST be byte-identical across rungs (the entropy-critical path
+is frozen-point-exact fp32 at every rung — a probclass bit that moves
+with the rung is data corruption, not a quality trade). In --smoke mode
+(`--precision` only) the bench FAILS on any cross-rung stream
+divergence, any failed round-trip, any steady-state compile, or a
+missing stage timing — the fail-fast `precision-bench` tpu_session.sh
+stage. Decode-quality drift (bf16/int8 PSNR / MS-SSIM deltas on the
+distortion side) is bench.py's RD-delta gate, not this axis.
+
 Emits a SERVE_BENCH.json trajectory artifact: totals (throughput,
 rejections by cause), latency quantiles, batch occupancy, compile
 counts, per-stage times, the device-scaling section, and a sampled time
@@ -2314,6 +2331,190 @@ def run_bench(args) -> dict:
     return report
 
 
+def _run_precision_section(args) -> dict:
+    """Precision-ladder axis (ISSUE 19): per-rung per-stage device-ms
+    plus the cross-rung stream bit-identity evidence.
+
+    For every ladder rung (coding/precision.py RUNGS) the section builds
+    the full model at that rung via `load_model_state(precision=rung)`
+    and times each serving stage — encode, decode, the probclass
+    wavefront front (fused Pallas kernel AND the XLA batch reference),
+    the prepped SI search, siNet, and the fused decode+color epilogue
+    (Pallas AND its XLA reference) — as median wall-ms over `reps`
+    blocking calls AFTER a warmup pass, with every timed call under
+    `CompilationSentinel(budget=0)` (a steady-state compile is a
+    violation, not noise).
+
+    Bit-identity: ONE deterministic symbol volume is encoded through
+    every rung's codec in both incremental modes (wavefront_np and the
+    new wavefront_pl). The streams must be byte-identical across rungs —
+    the ladder's contract is that casting the distortion side can never
+    move a probclass bit — and every stream must round-trip. Encoder-
+    side symbol drift on real images is bench.py's RD-delta territory,
+    not this gate's."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from dsin_tpu.coding import loader as loader_lib
+    from dsin_tpu.coding import precision as precision_lib
+    from dsin_tpu.ops import epilogue_pallas as epi_lib
+    from dsin_tpu.ops import sifinder as sifinder_lib
+    from dsin_tpu.serve.service import _make_batched_fns, _make_si_fns
+    from dsin_tpu.utils import CompilationSentinel
+
+    bh, bw = min(_parse_shapes(args.buckets), key=lambda s: s[0] * s[1])
+    reps = max(2, int(args.precision_reps))
+    rng = np.random.default_rng(args.seed)
+    batch = 2
+    x = rng.uniform(0.0, 255.0, size=(batch, bh, bw, 3)).astype(np.float32)
+    y_side = rng.uniform(0.0, 255.0, size=(bh, bw, 3)).astype(np.float32)
+    interpret = jax.default_backend() != "tpu"
+
+    def _stage_ms(fn):
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn())
+            times.append((time.monotonic() - t0) * 1000.0)
+        return round(statistics.median(times), 3)
+
+    fixed_sym = None       # one volume, shared by every rung
+    per_rung = {}
+    for rung in precision_lib.RUNGS:
+        policy = precision_lib.PrecisionPolicy(rung)
+        model, state = loader_lib.load_model_state(
+            args.ae_config, args.pc_config, args.ckpt, (bh, bw),
+            need_sinet=True, seed=args.seed, precision=rung)
+        params, bstats = state.params, state.batch_stats
+        encode_fn, decode_fn = _make_batched_fns(model)
+        si_prep_fn, _ = _make_si_fns(model, for_pallas=False)
+        cfg = model.ae_config
+        ph, pw = (int(v) for v in cfg.y_patch_size)
+        factors = (tuple(
+            jnp.asarray(m) for m in
+            sifinder_lib.gaussian_position_mask_factors(bh, bw, ph, pw))
+            if bool(cfg.use_gauss_mask) else None)
+        # model is a static bundle / cfg is static config — closure over
+        # them is the _make_si_fns idiom; params/prep stay traced args
+        sinet_jit = jax.jit(
+            lambda p, xd, ys: model.apply_sinet(p, xd, ys))
+        search_jit = jax.jit(
+            lambda xd, prep: sifinder_lib.synthesize_side_image_prepped(
+                xd, prep, ph, pw, cfg))
+        codec = loader_lib.make_codec(model, state)
+
+        sym = np.asarray(encode_fn(params, bstats, jnp.asarray(x)))
+        if fixed_sym is None:
+            # (D, H', W') volume every rung's codec sees — symbols drawn
+            # once so the stream comparison is about codec numerics only
+            d, hh, ww = sym.shape[3], sym.shape[1], sym.shape[2]
+            fixed_sym = rng.integers(
+                0, codec.num_centers, size=(d, hh, ww)).astype(np.int32)
+        sym_dev = jnp.asarray(sym)
+        x_dec = np.asarray(decode_fn(params, bstats, sym_dev))
+        x_dec_dev = jnp.asarray(x_dec)
+        y_syn_dev = jnp.asarray(
+            rng.uniform(0.0, 255.0, size=x_dec.shape).astype(np.float32))
+        prep = si_prep_fn(params, bstats, jnp.asarray(y_side), factors)
+
+        cd, cs, _ = codec.ctx_shape
+        blocks = rng.choice(
+            codec.centers, size=(64, cd, cs, cs)).astype(np.float32)
+        blocks_dev = jnp.asarray(blocks)
+        pallas_engine = codec._pallas_engine()
+
+        epi = epi_lib.fold_epilogue_params(
+            params["decoder"], bstats["decoder"], cfg.normalization)
+        cin = epi.wmat.shape[0] // 25
+        x_pre = jnp.asarray(rng.standard_normal(
+            (batch, bh // 2, bw // 2, cin)).astype(np.float32))
+        epi_ref_jit = jax.jit(epi_lib.epilogue_reference)
+
+        stages = {
+            "encode": lambda: encode_fn(params, bstats, jnp.asarray(x)),
+            "decode": lambda: decode_fn(params, bstats, sym_dev),
+            "probclass_front_pallas":
+                lambda: pallas_engine.front_logits(blocks_dev),
+            "probclass_front_xla":
+                lambda: codec._block_logits_batch(blocks_dev),
+            "si_search": lambda: search_jit(x_dec_dev, prep),
+            "sinet": lambda: sinet_jit(params, x_dec_dev, y_syn_dev),
+            "epilogue_pallas": lambda: epi_lib.fused_decode_epilogue(
+                x_pre, *epi, interpret=interpret),
+            "epilogue_xla": lambda: epi_ref_jit(x_pre, *epi),
+        }
+        for fn in stages.values():       # warmup: compiles land here
+            jax.block_until_ready(fn())
+        with CompilationSentinel(budget=0, label=f"precision[{rung}]",
+                                 raise_on_exceed=False) as sentinel:
+            stage_ms = {name: _stage_ms(fn)
+                        for name, fn in stages.items()}
+
+        streams, roundtrip = {}, {}
+        for mode in ("wavefront_np", "wavefront_pl"):
+            stream = codec.encode(fixed_sym, mode=mode)
+            streams[mode] = hashlib.sha256(stream).hexdigest()
+            roundtrip[mode] = bool(
+                np.array_equal(codec.decode(stream), fixed_sym))
+        per_rung[rung] = {
+            "compute_dtype": policy.compute_dtype,
+            "stage_device_ms": stage_ms,
+            "steady_compiles": sentinel.compilations,
+            "stream_sha256": streams,
+            "roundtrip_ok": roundtrip,
+        }
+
+    modes = ("wavefront_np", "wavefront_pl")
+    identical = all(
+        len({per_rung[r]["stream_sha256"][m]
+             for r in precision_lib.RUNGS}) == 1
+        for m in modes)
+    return {
+        "rungs": list(precision_lib.RUNGS),
+        "bucket": [bh, bw], "reps": reps, "batch": batch,
+        "pallas_interpret": interpret,
+        "per_rung": per_rung,
+        "streams_bit_identical": identical,
+    }
+
+
+def _gate_precision(section) -> list:
+    """--smoke violations for the precision axis: any missing rung, any
+    cross-rung stream byte divergence (the rANS contract — HARD failure,
+    never a note), any stream that does not round-trip, any steady-state
+    compile during the timed reps, or a missing/non-positive stage
+    timing."""
+    from dsin_tpu.coding import precision as precision_lib
+    violations = []
+    per_rung = section.get("per_rung", {})
+    for rung in precision_lib.RUNGS:
+        if rung not in per_rung:
+            violations.append(f"precision rung {rung} missing")
+            continue
+        entry = per_rung[rung]
+        for name, ms in entry.get("stage_device_ms", {}).items():
+            if not isinstance(ms, (int, float)) or ms <= 0:
+                violations.append(
+                    f"precision[{rung}] stage {name} device-ms {ms!r}")
+        if entry.get("steady_compiles") != 0:
+            violations.append(
+                f"precision[{rung}] compiled "
+                f"{entry.get('steady_compiles')}x in steady state")
+        for mode, ok in entry.get("roundtrip_ok", {}).items():
+            if not ok:
+                violations.append(
+                    f"precision[{rung}] {mode} stream failed to "
+                    f"round-trip")
+    if not section.get("streams_bit_identical"):
+        digests = {r: e.get("stream_sha256")
+                   for r, e in per_rung.items()}
+        violations.append(
+            f"probclass stream divergence across rungs: {digests}")
+    return violations
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="open-loop load bench for dsin_tpu/serve")
@@ -2470,6 +2671,19 @@ def main(argv=None) -> int:
                         "overhead + budget-0) — the quality-smoke "
                         "tpu_session.sh stage; the leg also rides "
                         "every full/--smoke run")
+    p.add_argument("--precision", "--precision_only",
+                   dest="precision_only", action="store_true",
+                   help="run ONLY the precision-ladder leg (ISSUE 19): "
+                        "per-rung per-stage device-ms (encode / decode "
+                        "/ probclass-front Pallas-vs-XLA / si-search / "
+                        "siNet / epilogue Pallas-vs-XLA) under "
+                        "CompilationSentinel(budget=0), plus the "
+                        "cross-rung stream bit-identity gate — the "
+                        "fail-fast precision-bench tpu_session.sh "
+                        "stage")
+    p.add_argument("--precision_reps", type=int, default=5,
+                   help="timed blocking calls per stage per rung on "
+                        "the precision leg (median reported)")
     p.add_argument("--out", default="SERVE_BENCH.json")
     p.add_argument("--smoke_model", action="store_true",
                    help="use the built-in tiny model configs but keep "
@@ -2511,7 +2725,8 @@ def main(argv=None) -> int:
     only_flags = [f for f in ("devices_only", "backends_only",
                               "frontdoor_only", "si_only", "trace_only",
                               "quality_only", "autoscale_only",
-                              "transport_only", "federation_only")
+                              "transport_only", "federation_only",
+                              "precision_only")
                   if getattr(args, f)]
     if len(only_flags) > 1:
         print(f"SERVE_BENCH_FAILED: {only_flags} are mutually "
@@ -2527,7 +2742,8 @@ def main(argv=None) -> int:
                                or args.quality_only
                                or args.autoscale_only
                                or args.transport_only
-                               or args.federation_only)
+                               or args.federation_only
+                               or args.precision_only)
                         else "1 2" if args.smoke else "1 2 4 8")
     axis = [int(v) for v in args.devices.split()]
     if any(n < 1 for n in axis):
@@ -2690,6 +2906,18 @@ def main(argv=None) -> int:
             },
             "federation": _run_federation_section(args),
         }
+    elif args.precision_only:
+        shapes = _parse_shapes(args.shapes)
+        buckets = _parse_shapes(args.buckets)
+        report = {
+            "config": {
+                "shapes": [list(s) for s in shapes],
+                "buckets": [list(b) for b in buckets],
+                "precision_reps": args.precision_reps,
+                "smoke": args.smoke,
+            },
+            "precision": _run_precision_section(args),
+        }
     else:
         report = run_bench(args)
         report["config"]["entropy_backend"] = args.entropy_backend
@@ -2720,6 +2948,11 @@ def main(argv=None) -> int:
             # spawned replica processes, so it likewise rides only the
             # full run and the dedicated --federation_only stage
             report["federation"] = _run_federation_section(args)
+            # precision ladder (ISSUE 19): builds the model once per
+            # rung, so it rides only the full (artifact) run and the
+            # dedicated --precision stage
+            report["config"]["precision_reps"] = args.precision_reps
+            report["precision"] = _run_precision_section(args)
         # session-cached SI serving (ISSUE 10): rides every run — the
         # smoke gate holds the warm-vs-per-request-prep speedup floor
         # (host-weather escape) and zero compiles under session churn
@@ -2742,7 +2975,7 @@ def main(argv=None) -> int:
     summary_keys = ("load", "latency_ms", "batch_occupancy",
                     "steady_compiles", "pipeline", "entropy_backends",
                     "devices", "frontdoor", "si", "trace", "quality",
-                    "autoscale", "transport", "federation")
+                    "autoscale", "transport", "federation", "precision")
     print(json.dumps({k: report[k] for k in summary_keys if k in report},
                      indent=1))
     if args.smoke and args.devices_only:
@@ -2795,6 +3028,12 @@ def main(argv=None) -> int:
         return 0
     if args.smoke and args.federation_only:
         violations = _gate_federation(report["federation"])
+        if violations:
+            print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
+            return 1
+        return 0
+    if args.smoke and args.precision_only:
+        violations = _gate_precision(report["precision"])
         if violations:
             print(f"SERVE_BENCH_FAILED: {violations}", file=sys.stderr)
             return 1

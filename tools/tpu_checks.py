@@ -1,25 +1,52 @@
-"""On-chip validation: Pallas siFinder kernel vs the XLA path on real TPU.
+"""On-chip validation campaign: every deferred real-TPU measurement in
+ONE runnable batch.
 
-Runs the fused Pallas search under real Mosaic at several shapes (up to the
-reference operating point) in float32 and bfloat16, compares the produced
-y_syn against the XLA search, times both, and writes TPU_CHECKS.json.
-This is the hardware evidence behind keeping `sifinder_impl='auto'` on the
-Pallas path (the CPU test suite can only run the kernel in interpret mode;
-ADVICE r1 asked for on-chip proof).
+Four PRs deferred a hardware measurement because CI has no chip, and
+ISSUE 19 adds two Pallas kernels plus a precision ladder whose timings
+only mean anything under real Mosaic. This driver consolidates all of
+them into a single named-check CAMPAIGN so one TPU session settles the
+whole backlog:
 
-Each check is independently guarded and results are written incrementally:
-at the 320x960 operating point the XLA path's materialized (301, 937, 640)
-score-map program is too large for the axon relay's remote-compile channel
-(observed: "remote_compile ... Broken pipe") — when the XLA reference is
-unavailable at a shape, the Pallas dtypes are still run and cross-checked
-against each other (both gather pixels from the original y, so equal patch
-choices mean bit-equal outputs).
+  * `sifinder`        — fused Pallas siFinder search vs the XLA paths
+                        across shapes/dtypes (the original TPU_CHECKS
+                        evidence behind sifinder_impl='auto'; PR10/ADVICE
+                        r1, extended with tiled rows in VERDICT r02).
+  * `probclass_front` — ISSUE 19 wavefront-front kernel vs the XLA batch
+                        reference: per-front-size device-ms + logits
+                        agreement under real Mosaic.
+  * `epilogue`        — ISSUE 19 fused decode+color epilogue vs its XLA
+                        reference at the operating-point shape.
+  * `precision`       — serve_bench --precision on-chip: per-rung
+                        per-stage device-ms + cross-rung stream
+                        bit-identity (ISSUE 19; the CPU numbers in the
+                        committed SERVE_BENCH.json are interpret-mode).
+  * `multichip`       — serve_bench --devices_only over the REAL device
+                        axis (PR 6 deferred the multi-chip scaling
+                        measurement; CI runs it on forced host devices).
+  * `swap_latency`    — prepare_swap/commit_swap wall latency against a
+                        real staged bundle (PR 9 deferred on-chip swap
+                        timing; the dual-bundle device residency cost
+                        only exists on real HBM).
+  * `add_drain`       — serve_bench --autoscale on-chip: add_replica /
+                        drain_replica latency under load (PR 14 deferred
+                        the real spawn-replica admit/drain numbers).
+
+The campaign spec is COMMITTED as artifacts/tpu_campaign.json
+(`--manifest` regenerates it; tests/test_tools_smoke.py pins the two in
+sync), so the next TPU session runs `python tools/tpu_checks.py` with no
+archaeology. `--list` names the rows, `--only NAME` (repeatable) runs a
+subset; `--list`/`--manifest` never touch a jax backend. Results write
+incrementally to TPU_CHECKS.json after every row (the axon relay can
+drop mid-campaign; a lost row must not lose its predecessors), with
+subprocess rows' full artifacts under artifacts/.
 
 Usage (needs the real chip):  python tools/tpu_checks.py
 """
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 from functools import partial
@@ -28,8 +55,82 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "TPU_CHECKS.json")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_PATH = os.path.join(REPO, "TPU_CHECKS.json")
+MANIFEST_PATH = os.path.join(REPO, "artifacts", "tpu_campaign.json")
+
+#: the campaign spec. Pure data (no jax): `--manifest` serializes it
+#: verbatim. `argv` templates may reference {num_devices}, resolved from
+#: the live backend at run time.
+CAMPAIGN = [
+    {
+        "name": "sifinder",
+        "deferred_from": "PR10 / ADVICE r1 (+ tiled rows, VERDICT r02)",
+        "kind": "inline",
+        "why": "hardware evidence behind sifinder_impl='auto': the fused "
+               "Pallas search vs both XLA engines across shapes/dtypes",
+        "writes": "TPU_CHECKS.json checks[]",
+    },
+    {
+        "name": "probclass_front",
+        "deferred_from": "ISSUE 19 (this PR)",
+        "kind": "inline",
+        "why": "fused wavefront-front kernel vs the XLA batch reference "
+               "under real Mosaic: logits agreement + device-ms per "
+               "front size (CPU CI only runs interpret mode)",
+        "writes": "TPU_CHECKS.json campaign.probclass_front",
+    },
+    {
+        "name": "epilogue",
+        "deferred_from": "ISSUE 19 (this PR)",
+        "kind": "inline",
+        "why": "fused decode+color epilogue vs its XLA reference at the "
+               "operating-point shape: output agreement + device-ms "
+               "(the skipped HBM round-trip only exists on real HBM)",
+        "writes": "TPU_CHECKS.json campaign.epilogue",
+    },
+    {
+        "name": "precision",
+        "deferred_from": "ISSUE 19 (this PR)",
+        "kind": "subprocess",
+        "argv": ["tools/serve_bench.py", "--smoke", "--precision",
+                 "--out", "artifacts/tpu_precision.json"],
+        "why": "per-rung per-stage device-ms + cross-rung stream "
+               "bit-identity with the kernels under real Mosaic",
+        "writes": "artifacts/tpu_precision.json",
+    },
+    {
+        "name": "multichip",
+        "deferred_from": "PR 6 (device-axis measured on forced host "
+                         "devices only)",
+        "kind": "subprocess",
+        "argv": ["tools/serve_bench.py", "--smoke", "--devices_only",
+                 "--devices", "1 {num_devices}",
+                 "--out", "artifacts/tpu_multichip.json"],
+        "why": "bucket->device placement and scaling over REAL chips "
+               "instead of virtual host devices sharing one core pool",
+        "writes": "artifacts/tpu_multichip.json",
+    },
+    {
+        "name": "swap_latency",
+        "deferred_from": "PR 9 (hot-swap latency never timed on-chip)",
+        "kind": "inline",
+        "why": "prepare_swap (stage + verify + canary) and commit_swap "
+               "wall latency with real dual-bundle HBM residency",
+        "writes": "TPU_CHECKS.json campaign.swap_latency",
+    },
+    {
+        "name": "add_drain",
+        "deferred_from": "PR 14 (admit/drain latency measured with "
+                         "host-device replicas only)",
+        "kind": "subprocess",
+        "argv": ["tools/serve_bench.py", "--smoke", "--autoscale",
+                 "--out", "artifacts/tpu_add_drain.json"],
+        "why": "real spawn-replica add_replica/drain_replica latency "
+               "under open-loop load on the chip",
+        "writes": "artifacts/tpu_add_drain.json",
+    },
+]
 
 
 def _write(results):
@@ -48,32 +149,34 @@ def _time_fn(fn, *args, reps=5):
     return out, (time.perf_counter() - t0) / reps * 1e3
 
 
-def main() -> int:
+def _smoke_model(buckets=((48, 96),), precision="fp32", need_sinet=False):
+    """One tiny model + state from serve_bench's smoke configs — the
+    campaign has no checkpoint on a fresh TPU host, and every check here
+    measures mechanics (kernel timings, swap plumbing), not RD."""
+    import tempfile
+
+    from dsin_tpu.coding import loader as loader_lib
+    from tools.serve_bench import _write_smoke_cfgs
+    ae_p, pc_p = _write_smoke_cfgs(tempfile.mkdtemp())
+    model, state = loader_lib.load_model_state(
+        ae_p, pc_p, None, tuple(buckets[0]), need_sinet=need_sinet,
+        seed=0, precision=precision)
+    return model, state, (ae_p, pc_p)
+
+
+# -- inline checks ------------------------------------------------------------
+
+def _check_sifinder(entry_sink):
+    """The original TPU_CHECKS sweep (kept row-compatible): fused Pallas
+    search vs search_single and search_single_tiled per shape/dtype."""
     import jax
     import jax.numpy as jnp
 
     from dsin_tpu.ops import sifinder, sifinder_pallas
 
-    # the axon relay can be transiently unavailable (same failure mode
-    # bench.py retries); back off a few times before giving up
-    for attempt in range(3):
-        try:
-            backend = jax.default_backend()
-            break
-        except RuntimeError as e:
-            print(f"backend init failed (attempt {attempt + 1}/3): {e}",
-                  flush=True)
-            if attempt == 2:
-                raise
-            time.sleep(30 * (attempt + 1))
-    results = {"backend": backend, "device": str(jax.devices()[0]),
-               "checks": []}
-    if backend != "tpu":
-        print(f"not a TPU backend ({backend}); refusing to write evidence")
-        return 1
-
     shapes = [(80, 96, 20, 24), (160, 480, 20, 24), (320, 960, 20, 24)]
     rng = np.random.default_rng(0)
+    rows = []
     for h, w, ph, pw in shapes:
         x = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32))
         y = jnp.asarray(np.clip(np.asarray(x) + rng.normal(0, 8, x.shape),
@@ -150,11 +253,236 @@ def main() -> int:
               f"{entry.get('xla_tiled_ms', entry.get('xla_tiled_error'))}",
               flush=True)
 
-        results["checks"].append(entry)
+        rows.append(entry)
+        entry_sink(rows)
+    return {"rows": len(rows)}
+
+
+def _check_probclass_front():
+    """Wavefront-front kernel (coding/probclass_pallas.py) vs the XLA
+    batch reference, real Mosaic: logits agreement + device-ms per
+    representative front-bucket size."""
+    import jax.numpy as jnp
+
+    from dsin_tpu.coding import loader as loader_lib
+    model, state, _ = _smoke_model()
+    codec = loader_lib.make_codec(model, state)
+    # force real Mosaic regardless of what the default would resolve to
+    codec._pallas_interpret = False
+    engine = codec._pallas_engine()
+    cd, cs, _ = codec.ctx_shape
+    rng = np.random.default_rng(0)
+    out = {"context_shape": [cd, cs, cs], "fronts": []}
+    for b in (32, 128, 512):     # bucket ladder a (C, H/8, W/8) volume sees
+        blocks = jnp.asarray(rng.choice(
+            codec.centers, size=(b, cd, cs, cs)).astype(np.float32))
+        pal, pal_ms = _time_fn(engine.front_logits, blocks)
+        ref, xla_ms = _time_fn(codec._block_logits_batch, blocks)
+        out["fronts"].append({
+            "batch": b,
+            "pallas_ms": round(pal_ms, 3),
+            "xla_ms": round(xla_ms, 3),
+            "speedup_vs_xla": round(xla_ms / pal_ms, 2),
+            "max_abs_diff": float(jnp.abs(pal - ref).max()),
+        })
+        print(f"probclass_front b={b}: {out['fronts'][-1]}", flush=True)
+    return out
+
+
+def _check_epilogue():
+    """Fused decode+color epilogue vs its XLA reference at the reference
+    operating point (320x960 image -> 160x480 pre-deconv activation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dsin_tpu.ops import epilogue_pallas as epi_lib
+    model, state, _ = _smoke_model()
+    cfg = model.ae_config
+    epi = epi_lib.fold_epilogue_params(
+        state.params["decoder"], state.batch_stats["decoder"],
+        cfg.normalization)
+    cin = epi.wmat.shape[0] // 25
+    rng = np.random.default_rng(0)
+    out = {"cin": cin, "shapes": []}
+    for h2, w2 in ((24, 48), (160, 480)):
+        x_pre = jnp.asarray(
+            rng.standard_normal((1, h2, w2, cin)).astype(np.float32))
+        fused = partial(epi_lib.fused_decode_epilogue, interpret=False)
+        pal, pal_ms = _time_fn(fused, x_pre, *epi)
+        ref_jit = jax.jit(epi_lib.epilogue_reference)
+        ref, xla_ms = _time_fn(ref_jit, x_pre, *epi)
+        out["shapes"].append({
+            "pre_deconv_shape": [h2, w2],
+            "pallas_ms": round(pal_ms, 3),
+            "xla_ms": round(xla_ms, 3),
+            "speedup_vs_xla": round(xla_ms / pal_ms, 2),
+            "img_max_abs_diff": float(jnp.abs(pal[0] - ref[0]).max()),
+            "search_max_abs_diff": float(jnp.abs(pal[1] - ref[1]).max()),
+        })
+        print(f"epilogue {h2}x{w2}: {out['shapes'][-1]}", flush=True)
+    return out
+
+
+def _check_swap_latency():
+    """Hot-swap wall latency on-chip: stage (restore+verify+warm) and
+    commit against a REAL saved bundle, smoke model (the mechanics cost
+    — dual-bundle residency, per-bucket warm compiles — not RD)."""
+    import shutil
+    import tempfile
+
+    from dsin_tpu.serve import CompressionService, ServiceConfig
+    from dsin_tpu.train import checkpoint as ckpt_lib
+    from tools.serve_bench import _write_smoke_cfgs
+
+    tmp = tempfile.mkdtemp()
+    ae_p, pc_p = _write_smoke_cfgs(tmp)
+    buckets = [(48, 96)]
+    svc = CompressionService(ServiceConfig(
+        ae_config=ae_p, pc_config=pc_p, ckpt=None, seed=0,
+        buckets=buckets, max_batch=2, workers=1)).start()
+    try:
+        svc.warmup()
+        extra = {"pc_config_sha256":
+                 ckpt_lib.config_sha256(svc.model.pc_config),
+                 "buckets": [list(b) for b in buckets]}
+        ckpt = os.path.join(tmp, "swap_ckpt")
+        # swap the service to a re-save of its OWN state: identical
+        # numerics, so the measurement isolates the swap machinery
+        ckpt_lib.save_checkpoint(ckpt, svc.state, manifest_extra=extra)
+        t0 = time.perf_counter()
+        svc.prepare_swap(ckpt)
+        prepare_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        svc.commit_swap()
+        commit_ms = (time.perf_counter() - t0) * 1e3
+        out = {"prepare_swap_ms": round(prepare_ms, 1),
+               "commit_ms": round(commit_ms, 1),
+               "buckets": [list(b) for b in buckets]}
+        print(f"swap_latency: {out}", flush=True)
+        return out
+    finally:
+        svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -- driver -------------------------------------------------------------------
+
+def _run_subprocess_check(spec, num_devices: int) -> dict:
+    argv = [a.format(num_devices=num_devices) for a in spec["argv"]]
+    os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+    t0 = time.perf_counter()
+    r = subprocess.run([sys.executable] + argv, cwd=REPO,
+                       capture_output=True, text=True, timeout=3600)
+    elapsed = round(time.perf_counter() - t0, 1)
+    sys.stderr.write(r.stderr[-2000:])
+    out = {"argv": argv, "rc": r.returncode, "elapsed_s": elapsed,
+           "artifact": spec["writes"]}
+    if r.returncode != 0:
+        out["stderr_tail"] = r.stderr[-500:]
+    return out
+
+
+def build_manifest() -> dict:
+    """The committed campaign spec (artifacts/tpu_campaign.json): pure
+    data, no backend touched — test_tools_smoke.py pins file == code."""
+    return {
+        "format": 1,
+        "runner": "python tools/tpu_checks.py",
+        "results": "TPU_CHECKS.json (+ per-row artifacts under artifacts/)",
+        "checks": CAMPAIGN,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="consolidated real-TPU measurement campaign")
+    p.add_argument("--list", action="store_true",
+                   help="print check names and exit (no backend)")
+    p.add_argument("--manifest", nargs="?", const="-", default=None,
+                   metavar="PATH",
+                   help="write the campaign manifest JSON (no backend); "
+                        "'-' or no value prints to stdout")
+    p.add_argument("--only", action="append", default=None,
+                   metavar="NAME", help="run only the named check(s)")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for spec in CAMPAIGN:
+            print(f"{spec['name']:16s} [{spec['kind']}] "
+                  f"(deferred from {spec['deferred_from']})")
+        return 0
+    if args.manifest is not None:
+        text = json.dumps(build_manifest(), indent=1)
+        if args.manifest == "-":
+            print(text)
+        else:
+            with open(args.manifest, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {args.manifest}")
+        return 0
+
+    known = {spec["name"] for spec in CAMPAIGN}
+    selected = set(args.only) if args.only else known
+    unknown = selected - known
+    if unknown:
+        print(f"unknown checks {sorted(unknown)}; have {sorted(known)}")
+        return 2
+
+    import jax
+
+    # the axon relay can be transiently unavailable (same failure mode
+    # bench.py retries); back off a few times before giving up
+    for attempt in range(3):
+        try:
+            backend = jax.default_backend()
+            break
+        except RuntimeError as e:
+            print(f"backend init failed (attempt {attempt + 1}/3): {e}",
+                  flush=True)
+            if attempt == 2:
+                raise
+            time.sleep(30 * (attempt + 1))
+    results = {"backend": backend, "device": str(jax.devices()[0]),
+               "checks": [], "campaign": {}}
+    if backend != "tpu":
+        print(f"not a TPU backend ({backend}); refusing to write evidence")
+        return 1
+    num_devices = jax.device_count()
+
+    rc = 0
+    for spec in CAMPAIGN:
+        name = spec["name"]
+        if name not in selected:
+            continue
+        print(f"== campaign check: {name} ==", flush=True)
+        t0 = time.perf_counter()
+        try:
+            if name == "sifinder":
+                def sink(rows):
+                    results["checks"] = rows
+                    _write(results)
+                summary = _check_sifinder(sink)
+            elif spec["kind"] == "subprocess":
+                summary = _run_subprocess_check(spec, num_devices)
+                if summary["rc"] != 0:
+                    rc = 1
+            else:
+                summary = {"probclass_front": _check_probclass_front,
+                           "epilogue": _check_epilogue,
+                           "swap_latency": _check_swap_latency}[name]()
+            status = "ok" if summary.get("rc", 0) == 0 else "failed"
+        except Exception as e:  # noqa: BLE001 — one lost row, not the batch
+            summary, status, rc = {"error": repr(e)[:500]}, "error", 1
+            print(f"{name} FAILED: {e!r}", flush=True)
+        results["campaign"][name] = {
+            "status": status,
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+            **summary,
+        }
         _write(results)
 
     print(f"wrote {OUT_PATH}")
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

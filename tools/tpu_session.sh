@@ -5,14 +5,14 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench bench checks breakdown mfu rd_sweep
+# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
@@ -294,6 +294,37 @@ federation-bench)
     exit 1
   fi
   ;;
+precision-bench)
+  # fail fast (ISSUE 19): the precision-ladder leg — serve_bench builds
+  # the serving model at every rung (fp32/bf16/int8) and must show ONE
+  # deterministic symbol volume encoding to BYTE-IDENTICAL rANS streams
+  # across rungs in both incremental modes (the entropy-critical path is
+  # frozen-point-exact fp32 at every rung), every stream round-tripping,
+  # zero steady-state compiles during the per-stage timing reps, and all
+  # eight stage timings present (encode/decode/probclass-front
+  # Pallas-vs-XLA/si-search/siNet/epilogue Pallas-vs-XLA); bench.py's
+  # RD-delta gate then pins the DISTORTION-side cost — bf16/int8 PSNR
+  # and MS-SSIM deltas vs fp32 inside the committed budgets, with any
+  # probclass stream divergence a HARD rc-1, never a budgeted delta.
+  # Both run on CPU in seconds; real-Mosaic kernel timings are the
+  # checks stage's campaign rows.
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --precision \
+    --devices "" --out artifacts/precision_bench.json \
+    > artifacts/precision_bench.log 2>&1 || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/precision_bench.log
+    echo "TPU_SESSION_FAILED: precision-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  JAX_PLATFORMS=cpu BENCH_RD_DELTA=1 python bench.py \
+    > artifacts/precision_rd_delta.json \
+    2> artifacts/precision_rd_delta.log || rc=$?
+  if [ "$rc" -ne 0 ]; then
+    cat artifacts/precision_rd_delta.log
+    echo "TPU_SESSION_FAILED: precision-bench (queue aborted before chip stages)"
+    exit 1
+  fi
+  ;;
 bench)
   # warms the persistent compile cache for the driver's end-of-round run;
   # temp+rename so a mid-run kill cannot truncate committed evidence
@@ -365,7 +396,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
