@@ -131,6 +131,7 @@ class FleetHealthSignals:
         default_factory=dict)
 
 
+# contract: pure — replayable policy math (the scenario-lab replay gate)
 class AutoscalePolicy:
     """Pure windowed scale decision with hysteresis + cooldown (no
     locks: single-caller by contract — the Autoscaler's one thread)."""
@@ -158,12 +159,12 @@ class AutoscalePolicy:
                 f"cooldowns must be >= 0, got {cfg.up_cooldown_s}/"
                 f"{cfg.down_cooldown_s}")
         self.cfg = cfg
-        self._up_streak = 0
-        self._idle_streak = 0
-        self._last_scale: Optional[float] = None
-        self._last_sheds: Optional[int] = None
+        self._up_streak = 0            # contract: state (hysteresis)
+        self._idle_streak = 0          # contract: state (hysteresis)
+        self._last_scale: Optional[float] = None   # contract: state
+        self._last_sheds: Optional[int] = None     # contract: state
         #: last check's classification, for gauges/debugging
-        self.last_verdict: Dict[str, Any] = {}
+        self.last_verdict: Dict[str, Any] = {}     # contract: state
 
     def observe(self, now: float, sig: ScaleSignals) -> int:
         """One check -> +1 (scale up), -1 (drain), 0 (hold)."""
@@ -227,6 +228,7 @@ class AutoscalePolicy:
             self._idle_streak = self.cfg.idle_checks
 
 
+# contract: pure — replayable policy math (the scenario-lab replay gate)
 class FleetHealthPolicy:
     """Pure fleet-level rollback decision: fire only when the COMMITTED
     model is sick on EVERY live replica (unanimous canary failure, or a
@@ -254,10 +256,10 @@ class FleetHealthPolicy:
         self.error_rate_high = float(error_rate_high)
         self.min_window_resolved = int(min_window_resolved)
         self.max_error_skew = float(max_error_skew)
-        self._canary_streak = 0
-        self._error_streak = 0
-        self._last_fire: Optional[float] = None
-        self._last_errors: Dict[str, Mapping[str, int]] = {}
+        self._canary_streak = 0        # contract: state (hysteresis)
+        self._error_streak = 0         # contract: state (hysteresis)
+        self._last_fire: Optional[float] = None    # contract: state
+        self._last_errors: Dict[str, Mapping[str, int]] = {}   # contract: state
 
     def observe(self, now: float,
                 sig: FleetHealthSignals) -> Optional[str]:
@@ -308,6 +310,7 @@ class FleetHealthPolicy:
 
 # -- snapshot -> signals (pure, shape-tolerant) -------------------------------
 
+# contract: pure
 def signals_from_snapshot(snap: Mapping[str, Any]) -> ScaleSignals:
     """Derive the scale policy's inputs from one AggregatedMetrics
     snapshot (serve/router.py): the `replica_occupancy` info roll-up
@@ -337,6 +340,7 @@ def signals_from_snapshot(snap: Mapping[str, Any]) -> ScaleSignals:
         stale_replicas=len(info.get("replicas_stale", [])))
 
 
+# contract: pure
 def health_from_snapshot(snap: Mapping[str, Any]) -> FleetHealthSignals:
     """Derive the health policy's inputs from one AggregatedMetrics
     snapshot: the quality roll-up's per-replica canary verdicts and
@@ -530,6 +534,7 @@ class Autoscaler:
 
 # -- federation tier (ISSUE 18) -----------------------------------------------
 
+# contract: pure
 def federation_health_from_snapshot(
         snap: Mapping[str, Any]) -> FleetHealthSignals:
     """Derive health-policy inputs from one FederatedMetrics snapshot

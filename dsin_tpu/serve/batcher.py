@@ -110,6 +110,14 @@ class DeadlineExceeded(ServeError):
         self.priority = priority
 
 
+class UnknownPriorityClass(ServeError, ValueError):
+    """The request names a traffic class this service was not
+    configured with — client misuse, typed (contract-typed-raise) so
+    the front door can reject it as a 4xx instead of a crash. Also a
+    ValueError: callers that treated the old bare raise as argument
+    validation keep working."""
+
+
 @dataclass(frozen=True)
 class PriorityClass:
     """One traffic class: its queue bound and its default deadline.
@@ -344,6 +352,7 @@ class MicroBatcher:
             return True
         return False
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit(self, request: Request) -> None:
         with self._cond:
             if self._closed:
@@ -354,7 +363,7 @@ class MicroBatcher:
                 cls = request.priority = self.default_class
             pc = self._by_name.get(cls)
             if pc is None:
-                raise ValueError(
+                raise UnknownPriorityClass(
                     f"unknown priority class {cls!r} (configured: "
                     f"{[c.name for c in self.classes]})")
             if request.deadline is None and pc.default_deadline_ms is not None:

@@ -63,6 +63,10 @@ class BucketPolicy:
 
     def bucket_for(self, h: int, w: int) -> Tuple[int, int]:
         if h <= 0 or w <= 0:
+            # jaxlint: disable=contract-typed-raise -- synchronous arg
+            # validation at the submission boundary (no future exists
+            # yet); ValueError on malformed input is the documented
+            # misuse contract
             raise ValueError(f"bad image shape ({h}, {w})")
         for bh, bw in self.buckets:
             if h <= bh and w <= bw:
@@ -85,6 +89,10 @@ def pad_to_bucket(img: np.ndarray, bucket: Tuple[int, int]) -> np.ndarray:
     h, w = img.shape[:2]
     bh, bw = bucket
     if h > bh or w > bw:
+        # jaxlint: disable=contract-typed-raise -- unreachable on the
+        # request path by construction: submit_encode picked this bucket
+        # via bucket_for, which only returns covering buckets; defensive
+        # invariant guard for direct callers
         raise ValueError(f"image ({h}, {w}) does not fit bucket {bucket}")
     if (h, w) == (bh, bw):
         return img.copy()

@@ -642,10 +642,12 @@ class FederatedRouter:
         self.flight.note_error(exc)
         raise exc
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_encode(self, img, deadline_ms: Optional[float] = None,
                       priority: Optional[str] = None) -> Future:
         return self._submit("encode", img, priority, deadline_ms)
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_decode(self, blob: bytes,
                       deadline_ms: Optional[float] = None,
                       priority: Optional[str] = None) -> Future:
@@ -710,6 +712,7 @@ class FederatedRouter:
         except (MemberUnreachable, ServiceUnavailable, ServeError):
             return False    # the pin is dropped either way
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_decode_si(self, blob: bytes, session_id: str,
                          deadline_ms: Optional[float] = None,
                          priority: Optional[str] = None) -> Future:

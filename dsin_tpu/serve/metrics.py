@@ -30,6 +30,148 @@ from urllib.parse import parse_qs, urlparse
 from dsin_tpu.utils import locks as locks_lib
 
 
+#: every metric name the serve stack emits — the one central registry
+#: `contract-registry-drift` resolves `.counter/.gauge/.histogram`
+#: literals against (entries ending `*` are prefixes and cover the
+#: f-string families, e.g. per-bucket/per-replica names). A new metric
+#: is added HERE first; a literal that resolves to no row is a lint
+#: finding, and a row no call site visits is one too.
+METRIC_REGISTRY = (
+    "federation_digest_skew",
+    "federation_health_driver_errors",
+    "federation_health_rollbacks",
+    "federation_member_call_failures_*",
+    "federation_member_evictions",
+    "federation_member_readmissions",
+    "federation_members",
+    "federation_members_live",
+    "federation_reconcile_failures",
+    "federation_reconciles",
+    "federation_rollbacks",
+    "federation_rollout_aborts",
+    "federation_rollout_promotions",
+    "federation_rollout_wave_rollbacks",
+    "federation_rollout_waves",
+    "federation_rollouts",
+    "federation_routed_*",
+    "federation_routed_m_*",
+    "federation_sessions_dropped_*",
+    "federation_sessions_opened",
+    "federation_sessions_pinned",
+    "serve_admitted_*",
+    "serve_auto_rebalance_errors",
+    "serve_auto_rebalances",
+    "serve_autoscale_downs",
+    "serve_autoscale_errors",
+    "serve_autoscale_fleet_rollbacks",
+    "serve_autoscale_outstanding",
+    "serve_autoscale_ups",
+    "serve_batch_ms",
+    "serve_batch_occupancy",
+    "serve_batches",
+    "serve_bpp_payload_*",
+    "serve_bpp_wire_*",
+    "serve_bucket_requests_*",
+    "serve_buckets",
+    "serve_canary_errors",
+    "serve_canary_failures",
+    "serve_canary_ms",
+    "serve_canary_ok",
+    "serve_canary_races",
+    "serve_canary_runs",
+    "serve_canary_swap_passes",
+    "serve_canary_swap_refusals",
+    "serve_canary_swap_skipped",
+    "serve_coding_gap_bits",
+    "serve_coding_gap_errors",
+    "serve_coding_gap_pct_*",
+    "serve_coding_gap_samples",
+    "serve_completed",
+    "serve_device_batches_d*",
+    "serve_device_ms",
+    "serve_device_skipped_batches",
+    "serve_devices",
+    "serve_entropy_batch_ms",
+    "serve_entropy_ms",
+    "serve_entropy_proc_rebuilds",
+    "serve_executable_census",
+    "serve_expired_*",
+    "serve_flight_dumps",
+    "serve_integrity_errors",
+    "serve_latency_ms",
+    "serve_latency_ms_*",
+    "serve_overlap_ratio",
+    "serve_pipeline_inflight",
+    "serve_placement_rebalances",
+    "serve_queue_depth",
+    "serve_rejected_deadline",
+    "serve_rejected_drain",
+    "serve_rejected_overload",
+    "serve_rejected_unavailable",
+    "serve_resolved",
+    "serve_rollbacks",
+    "serve_router_digest_skew",
+    "serve_router_evictions",
+    "serve_router_expired_*",
+    "serve_router_readmissions",
+    "serve_router_replica_deaths",
+    "serve_router_replicas",
+    "serve_router_replicas_total",
+    "serve_router_reroutes",
+    "serve_router_rollbacks",
+    "serve_router_routed_*",
+    "serve_router_routed_r*",
+    "serve_router_scale_downs",
+    "serve_router_scale_ups",
+    "serve_router_session_orphans",
+    "serve_router_sessions_dropped_*",
+    "serve_router_sessions_opened",
+    "serve_router_sessions_pinned",
+    "serve_router_swap_aborts",
+    "serve_router_swaps",
+    "serve_session_bytes",
+    "serve_session_evictions",
+    "serve_session_evictions_*",
+    "serve_sessions_live",
+    "serve_sessions_opened",
+    "serve_shed_*",
+    "serve_shed_admission_*",
+    "serve_shm_bytes",
+    "serve_shm_fallback_*",
+    "serve_shm_fallbacks",
+    "serve_shm_frees",
+    "serve_shm_integrity_errors",
+    "serve_shm_sends",
+    "serve_si_match_alarm_transitions",
+    "serve_si_match_alarms",
+    "serve_si_match_min_score",
+    "serve_si_match_score",
+    "serve_si_prep_ms",
+    "serve_si_search_ms",
+    "serve_submitted",
+    "serve_swap_errors",
+    "serve_swap_state",
+    "serve_swaps",
+    "serve_template_admits",
+    "serve_template_failures",
+    "serve_template_misses",
+    "serve_template_ready",
+    "serve_template_restocks",
+    "serve_template_stale",
+    "serve_trace_proc_mismatch",
+    "serve_trace_spans",
+    "serve_traffic_skew",
+    "serve_typed_errors",
+    "serve_warmup_compiles",
+    "serve_watchdog_refused",
+    "serve_watchdog_rollbacks",
+    "serve_worker_crashes",
+    "serve_worker_restarts",
+    "serve_workers_live",
+    "serve_xla_compiles",
+)
+
+
 class Counter:
     def __init__(self):
         self._lock = locks_lib.RankedLock("metrics.metric")

@@ -66,6 +66,7 @@ def _bucket_key(bucket: Bucket) -> str:
     return f"{bucket[0]}x{bucket[1]}"
 
 
+# contract: pure — immutable plan; methods are pure views
 @dataclass(frozen=True)
 class PlacementPlan:
     """Immutable bucket -> replica-device-set assignment.
@@ -104,6 +105,7 @@ class PlacementPlan:
                 for b, devs in sorted(self.assignments.items())}
 
 
+# contract: pure — deterministic ladder -> mesh assignment
 def plan_placement(buckets: Sequence[Bucket], num_devices: int,
                    weights: Optional[Mapping[Bucket, float]] = None
                    ) -> PlacementPlan:
@@ -168,6 +170,7 @@ def plan_placement(buckets: Sequence[Bucket], num_devices: int,
         weights=dict(w))
 
 
+# contract: pure — replayable policy math (the scenario-lab replay gate)
 class RebalanceTrigger:
     """Load-aware automatic rebalance decision (ISSUE 8 satellite:
     before this, `rebalance_placement()` was operator-called only).
@@ -212,11 +215,11 @@ class RebalanceTrigger:
         self.hysteresis_checks = int(hysteresis_checks)
         self.cooldown_s = float(cooldown_s)
         self.min_window_requests = int(min_window_requests)
-        self._last_counts: Dict[Bucket, int] = {}
-        self._streak = 0
-        self._last_fire: Optional[float] = None
+        self._last_counts: Dict[Bucket, int] = {}  # contract: state
+        self._streak = 0               # contract: state (hysteresis)
+        self._last_fire: Optional[float] = None    # contract: state
         #: most recent window's skew (1.0 = uniform; gauge fodder)
-        self.last_skew = 1.0
+        self.last_skew = 1.0                       # contract: state
 
     def observe(self, now: float, counts: Mapping[Bucket, int]
                 ) -> Optional[Dict[Bucket, float]]:

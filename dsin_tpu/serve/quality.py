@@ -105,6 +105,7 @@ def canary_inputs(buckets: Sequence[Tuple[int, int]],
     return out
 
 
+# contract: pure
 def goldens_struct(seed: int, buckets: Sequence[Tuple[int, int]],
                    digests: Dict[str, Dict[str, Optional[str]]]
                    ) -> Dict[str, Any]:
@@ -118,6 +119,7 @@ def goldens_struct(seed: int, buckets: Sequence[Tuple[int, int]],
             "digests": {k: dict(v) for k, v in sorted(digests.items())}}
 
 
+# contract: pure
 def validate_goldens(goldens: Any) -> Optional[str]:
     """Structural check of a manifest `canary` entry; returns a human
     reason when malformed, None when well-formed. Shared by the
@@ -143,6 +145,7 @@ def validate_goldens(goldens: Any) -> Optional[str]:
     return None
 
 
+# contract: pure
 def compare_goldens(expected: Dict[str, Any],
                     observed: Dict[str, Dict[str, Optional[str]]], *,
                     seed: int,
@@ -181,6 +184,7 @@ def compare_goldens(expected: Dict[str, Any],
     return problems
 
 
+# contract: pure
 def wave_canary_verdict(quality: Optional[Dict[str, Any]],
                         expect_digest: str) -> Optional[bool]:
     """One member's aggregated quality roll-up -> wave-gate verdict for

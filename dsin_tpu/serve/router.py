@@ -146,7 +146,8 @@ from dsin_tpu.serve import protocol
 from dsin_tpu.serve import shmlane
 from dsin_tpu.serve import trace as trace_lib
 from dsin_tpu.serve.batcher import (DeadlineExceeded, Future, ServeError,
-                                    ServiceOverloaded, ServiceUnavailable)
+                                    ServiceOverloaded, ServiceUnavailable,
+                                    UnknownPriorityClass)
 from dsin_tpu.serve.session import SessionExpired
 from dsin_tpu.serve.swap import SwapError
 from dsin_tpu.utils import locks as locks_lib
@@ -226,8 +227,9 @@ class AdmissionController:
     def admit(self, cls: str) -> None:
         limit = self.limits.get(cls)
         if limit is None:
-            raise ValueError(f"unknown priority class {cls!r} "
-                             f"(admission classes: {sorted(self.limits)})")
+            raise UnknownPriorityClass(
+                f"unknown priority class {cls!r} "
+                f"(admission classes: {sorted(self.limits)})")
         with self._lock:
             n = self._outstanding[cls]
             shed = n >= limit
@@ -254,9 +256,16 @@ class AdmissionController:
         simply sheds new admits until the backlog drains."""
         bad = {c: n for c, n in limits.items() if int(n) < 1}
         if bad:
+            # jaxlint: disable=contract-typed-raise -- operator reconfig
+            # validation (the autoscale rescale hook), not client request
+            # data: it fails the reconfig call synchronously, no request
+            # future exists to hang
             raise ValueError(f"admission limits must be >= 1: {bad}")
         with self._lock:
             if set(map(str, limits)) != set(self._outstanding):
+                # jaxlint: disable=contract-typed-raise -- operator
+                # reconfig validation, same boundary as above: fails the
+                # reconfig call, never a request future
                 raise ValueError(
                     f"admission classes are fixed at construction "
                     f"(have {sorted(self._outstanding)}, got "
@@ -883,12 +892,14 @@ class FrontDoorRouter:
     # positional calls written against one must mean the same thing
     # against the other.
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_encode(self, img, deadline_ms: Optional[float] = None,
                       priority: Optional[str] = None,
                       trace=None) -> Future:
         return self._submit("encode", img, priority, deadline_ms,
                             trace=trace)
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_decode(self, blob: bytes,
                       deadline_ms: Optional[float] = None,
                       priority: Optional[str] = None,
@@ -1060,6 +1071,7 @@ class FrontDoorRouter:
         except Exception:   # noqa: BLE001 — the pin is dropped either way
             return False
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_decode_si(self, blob: bytes, session_id: str,
                          deadline_ms: Optional[float] = None,
                          priority: Optional[str] = None,

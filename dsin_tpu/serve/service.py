@@ -438,13 +438,20 @@ def frame_stream(payload: bytes, shape: Tuple[int, int],
     return SERVE_MAGIC + head + struct.pack("<I", crc) + payload
 
 
+class StreamCorrupt(ValueError):
+    """Structurally damaged DSRV frame (bad magic, truncation, version
+    or geometry skew) — typed (contract-typed-raise) so request-path
+    corruption maps to one registered error family; still a ValueError
+    for every caller that catches the documented base."""
+
+
 def parse_stream(blob: bytes):
     """-> (payload, (h, w), (bh, bw)); every corruption mode is a typed
-    error — ValueError for structural damage, IntegrityError (a
-    ValueError subclass) for a v2 CRC mismatch. v1 frames predate the
-    CRC and parse without one."""
+    error — StreamCorrupt (a ValueError subclass) for structural
+    damage, IntegrityError (also under ValueError) for a v2 CRC
+    mismatch. v1 frames predate the CRC and parse without one."""
     if len(blob) < _FRAME_LEN_V1 or blob[:4] != SERVE_MAGIC:
-        raise ValueError("not a DSRV stream")
+        raise StreamCorrupt("not a DSRV stream")
     version = blob[4]
     if version == 1:
         version, h, w, bh, bw, n = struct.unpack(
@@ -453,23 +460,23 @@ def parse_stream(blob: bytes):
         crc = None
     elif version == SERVE_VERSION:
         if len(blob) < _FRAME_LEN:
-            raise ValueError(f"truncated DSRV v2 header: {len(blob)} of "
-                             f"{_FRAME_LEN} bytes")
+            raise StreamCorrupt(f"truncated DSRV v2 header: {len(blob)} "
+                                f"of {_FRAME_LEN} bytes")
         version, h, w, bh, bw, n, crc = struct.unpack(
             "<BHHHHII", blob[4:_FRAME_LEN])
         payload = blob[_FRAME_LEN:_FRAME_LEN + n]
     else:
-        raise ValueError(f"unsupported DSRV version {version}")
+        raise StreamCorrupt(f"unsupported DSRV version {version}")
     if len(payload) != n:
-        raise ValueError(f"truncated stream: payload {len(payload)} of "
-                         f"{n} bytes")
+        raise StreamCorrupt(f"truncated stream: payload {len(payload)} "
+                            f"of {n} bytes")
     if crc is not None:
         verify_crc(crc, "DSRV stream",
                    struct.pack("<BHHHHI", version, h, w, bh, bw, n),
                    payload)
     if h > bh or w > bw:
-        raise ValueError(f"corrupt frame: image ({h}, {w}) exceeds its "
-                         f"own bucket ({bh}, {bw})")
+        raise StreamCorrupt(f"corrupt frame: image ({h}, {w}) exceeds "
+                            f"its own bucket ({bh}, {bw})")
     return payload, (h, w), (bh, bw)
 
 
@@ -1855,6 +1862,7 @@ class CompressionService:
         self.flight.note_error(
             exc, trace_id=ctx.trace_id if ctx is not None else None)
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_encode(self, img: np.ndarray,
                       deadline_ms: Optional[float] = None,
                       priority: Optional[str] = None,
@@ -1868,6 +1876,10 @@ class CompressionService:
         None = mint one here."""
         img = np.asarray(img)
         if img.ndim != 3 or img.shape[-1] != 3:
+            # jaxlint: disable=contract-typed-raise -- synchronous arg
+            # validation at the submission boundary: the caller still
+            # holds the thread, no future exists to hang, and ValueError
+            # on malformed input is the documented misuse contract
             raise ValueError(f"expected (h, w, 3) image, got {img.shape}")
         h, w = img.shape[:2]
         bucket = self.policy.bucket_for(h, w)
@@ -1878,6 +1890,7 @@ class CompressionService:
             deadline=self._deadline(deadline_ms), priority=priority,
             trace=trace))
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_decode(self, blob: bytes,
                       deadline_ms: Optional[float] = None,
                       priority: Optional[str] = None,
@@ -1968,6 +1981,7 @@ class CompressionService:
         sessions = self._require_si()
         return sessions.evict(session_id, "closed")
 
+    # contract: request-path — every reachable raise must be a typed error
     def submit_decode_si(self, blob: bytes, session_id: str,
                          deadline_ms: Optional[float] = None,
                          priority: Optional[str] = None,

@@ -180,7 +180,8 @@ class _LockStats:
     def __init__(self):
         # raw by necessity: the wrappers cannot bootstrap on themselves.
         # Leaf by construction — nothing under it touches another lock.
-        # jaxlint: disable=raw-lock-construction -- wrapper-internal per-ledger micro-lock; a RankedLock here would recurse
+        # raw-lock ok: wrapper-internal per-ledger micro-lock; a RankedLock
+        # here would recurse (the rule exempts lock_modules by stem)
         self.lock = threading.Lock()
         self.acquisitions = 0
         self.contentions = 0
@@ -208,7 +209,8 @@ class _LockStats:
 # ONLY (never the per-ledger counters — those live under each ledger's
 # own micro-lock, see _LockStats). Raw by necessity, leaf by
 # construction.
-# jaxlint: disable=raw-lock-construction -- the wrapper module's own internal leaf lock; cannot be a RankedLock without infinite regress
+# raw-lock ok: the wrapper module's own internal leaf lock; cannot be
+# a RankedLock without infinite regress (rule exempts lock_modules)
 _meta_lock = threading.Lock()
 _stats: Dict[str, _LockStats] = {}    # guarded-by: _meta_lock (module)
 _inversion_log: List[str] = []        # guarded-by: _meta_lock (module)
@@ -317,7 +319,8 @@ class RankedLock:
                     f"an explicit rank= in tests)")
         self.name = name
         self.rank = int(rank)
-        # jaxlint: disable=raw-lock-construction -- this IS the sanctioned wrapper; the one place raw primitives are built
+        # raw-lock ok: this IS the sanctioned wrapper; the one place raw
+        # primitives are built (the rule exempts lock_modules by stem)
         self._lock = threading.Lock()
         self._stats = _stats_for(name)
         self._t_acquire = 0.0
@@ -412,7 +415,8 @@ class RankedCondition:
 
     def __init__(self, name: str, rank: Optional[int] = None):
         self._rlock = RankedLock(name, rank)
-        # jaxlint: disable=raw-lock-construction -- wrapper-internal: the Condition shares the RankedLock's raw lock so wait() keeps single-lock semantics
+        # raw-lock ok: wrapper-internal — the Condition shares the
+        # RankedLock's raw lock so wait() keeps single-lock semantics
         self._cond = threading.Condition(self._rlock._lock)
 
     @property

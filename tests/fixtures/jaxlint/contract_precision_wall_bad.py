@@ -1,0 +1,21 @@
+"""Bad: low-precision casts crossing the entropy-critical wall — a
+direct partition cast, a cast of a local drawn from a partition, and a
+low-cast value stored INTO a partition. Self-contained: carries its own
+partition literals so the pass analyzes it without coding/precision.py."""
+
+ENTROPY_CRITICAL = frozenset({"probclass", "centers"})
+DISTORTION_SIDE = ("encoder", "decoder")
+
+
+def narrow_probclass(params):
+    return params["probclass"].astype("bfloat16")
+
+
+def narrow_local(params):
+    table = params.get("centers")
+    return table.astype("int8")
+
+
+def store_low(params, x):
+    params["centers"] = x.astype("float16")
+    return params

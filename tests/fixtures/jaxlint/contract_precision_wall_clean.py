@@ -1,0 +1,20 @@
+"""Clean: casts that keep the precision wall — distortion-side
+partitions may narrow, fp32 casts of critical partitions are the
+contract itself — plus one justified suppression."""
+
+ENTROPY_CRITICAL = frozenset({"probclass", "centers"})
+DISTORTION_SIDE = ("encoder", "decoder")
+
+
+def narrow_encoder(params):
+    return params["encoder"].astype("bfloat16")   # distortion side: legal
+
+
+def keep_wall(params):
+    return params["probclass"].astype("float32")  # fp32 IS the wall
+
+
+def sanctioned(params):
+    # jaxlint: disable=contract-precision-wall -- fixture: stands in for
+    # cast_params' sanctioned identity path; justified-suppression half
+    return params["probclass"].astype("bfloat16")

@@ -1,0 +1,49 @@
+"""Bad: `# contract: pure` entities reaching effects — one direct, one
+through the call graph, one undeclared self-mutation, one ranked-lock
+acquisition. Self-contained: carries its own HIERARCHY + RankedLock
+stub so the whole-repo passes analyze it without the repo's locks.py."""
+
+import random
+import time
+
+HIERARCHY = {"fixture.policy": 10}
+
+
+class RankedLock:
+    def __init__(self, name, rank=None):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _helper():
+    return random.random()
+
+
+# contract: pure
+def jitter(x):
+    return x + _helper()        # random reaches the pure root via a call
+
+
+# contract: pure
+def stamp(x):
+    return x, time.time()       # direct time effect
+
+
+# contract: pure
+class Policy:
+    def __init__(self):
+        self._streak = 0        # deliberately NOT declared as state
+        self._lock = RankedLock("fixture.policy")
+
+    def observe(self, sig):
+        self._streak += 1       # undeclared self-mutation
+        return self._streak
+
+    def locked(self):
+        with self._lock:        # pure method acquires a ranked lock
+            return self._streak

@@ -1,0 +1,32 @@
+"""Clean: pure policy math that keeps the contract — declared windowed
+state is the one sanctioned mutation — plus one justified suppression
+(the suppressed-clean half of the golden pair)."""
+
+HIERARCHY = {"fixture.policy": 10}
+
+
+def _double(x):
+    return 2 * x
+
+
+# contract: pure
+def gain(x):
+    return _double(x) + 1
+
+
+# contract: pure
+class Trigger:
+    def __init__(self):
+        self._streak = 0        # contract: state (hysteresis counter)
+
+    def observe(self, now, sig):
+        self._streak += 1       # declared state: sanctioned mutation
+        return self._streak >= 2
+
+
+# contract: pure
+def audited(x):
+    # jaxlint: disable=contract-pure-policy -- fixture: debug print kept
+    # deliberately; demonstrates the justified-suppression half
+    print("audited", x)
+    return x

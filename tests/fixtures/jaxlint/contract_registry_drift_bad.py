@@ -1,0 +1,29 @@
+"""Bad: registry drift in both directions — an injection literal and a
+metric name that resolve to no registry row, plus a registered fault
+site and a registry entry nothing ever visits. Self-contained: carries
+its own SITES + METRIC_REGISTRY literals."""
+
+SITES = ("fixture.alpha", "fixture.beta", "fixture.gamma")
+
+METRIC_REGISTRY = (
+    "fixture_dead_gauge",
+    "fixture_requests",
+    "fixture_shed_*",
+)
+
+
+class FaultSpec:
+    def __init__(self, site=None):
+        self.site = site
+
+
+def tick(faults, metrics, cls):
+    faults.inject("fixture.alpha")
+    faults.inject("fixture.rogue")           # not in SITES
+    metrics.counter("fixture_requests")
+    metrics.counter("fixture_unregistered")  # not in METRIC_REGISTRY
+    metrics.counter(f"fixture_shed_{cls}")
+
+
+def chaos_battery():
+    return [FaultSpec(site="fixture.beta")]

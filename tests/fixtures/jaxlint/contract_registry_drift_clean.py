@@ -1,0 +1,28 @@
+"""Clean: every injection literal and metric name resolves to its
+registry, every registered row is visited, plus one justified
+suppression for a deliberately out-of-registry probe."""
+
+SITES = ("fixture.alpha", "fixture.beta")
+
+METRIC_REGISTRY = (
+    "fixture_requests",
+    "fixture_shed_*",
+)
+
+
+class FaultSpec:
+    def __init__(self, site=None):
+        self.site = site
+
+
+def tick(faults, metrics, cls):
+    faults.inject("fixture.alpha")
+    metrics.counter("fixture_requests")
+    metrics.counter(f"fixture_shed_{cls}")
+    # jaxlint: disable=contract-registry-drift -- fixture: deliberately
+    # out-of-registry probe site; justified-suppression half
+    faults.inject("fixture.experimental")
+
+
+def chaos_battery():
+    return [FaultSpec(site="fixture.beta")]

@@ -1,0 +1,15 @@
+"""Bad: bare builtin raises reachable from a `# contract: request-path`
+entry — one direct, one through a helper the entry calls."""
+
+
+def _validate(x):
+    if x < 0:
+        raise ValueError("negative")        # reachable via submit()
+
+
+# contract: request-path
+def submit(x):
+    _validate(x)
+    if x > 100:
+        raise RuntimeError("too big")       # direct bare raise
+    return x

@@ -1,0 +1,22 @@
+"""Clean: every reachable raise constructs a registered typed error (a
+walked class whose base chain reaches a builtin exception), plus one
+justified suppression for boundary validation."""
+
+
+class FixtureError(RuntimeError):
+    """Registered typed error: base chain reaches RuntimeError."""
+
+
+def _validate(x):
+    if x < 0:
+        raise FixtureError("negative")
+
+
+# contract: request-path
+def submit(x):
+    _validate(x)
+    if x > 100:
+        # jaxlint: disable=contract-typed-raise -- fixture: synchronous
+        # boundary validation, no future exists; justified-suppression half
+        raise ValueError("too big")
+    return x

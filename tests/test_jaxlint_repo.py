@@ -79,7 +79,8 @@ THREADED_MODULES = [os.path.join(REPO, *parts) for parts in (
 
 
 def test_concurrency_gate_via_cli_contract(capsys):
-    """The tpu_session.sh threadlint stage: the concurrency family alone
+    """The concurrency family alone (part of the tpu_session.sh lint
+    stage, which runs all four families together)
     must also exit clean over the production trees."""
     assert run(["--concurrency",
                 os.path.join(REPO, "dsin_tpu"),
@@ -89,8 +90,8 @@ def test_concurrency_gate_via_cli_contract(capsys):
 
 def test_lockgraph_gate_via_cli_contract(capsys):
     """ISSUE 16 acceptance: the whole-repo interprocedural pass exits
-    clean over every production tree — the exact invocation the
-    tpu_session.sh threadlint stage runs (both families together)."""
+    clean over every production tree, composed with the concurrency
+    family (the tpu_session.sh lint stage runs all four families)."""
     assert run(["--concurrency", "--lockgraph"]
                + LINT_TARGETS) == EXIT_CLEAN
     assert "0 finding(s)" in capsys.readouterr().out
@@ -154,3 +155,57 @@ def test_suppression_audit_lists_the_repo_and_is_stale_free(capsys):
     out = capsys.readouterr().out
     assert "0 stale" in out
     assert "disable=" in out and "-- " in out
+
+
+# -- contractlint: the contracts family is part of the gate -------------------
+
+CONTRACT_MODULES = [os.path.join(REPO, *parts) for parts in (
+    ("dsin_tpu", "serve", "autoscale.py"),   # AutoscalePolicy, FleetHealth
+    ("dsin_tpu", "serve", "placement.py"),   # plan_placement, Rebalance
+    ("dsin_tpu", "serve", "quality.py"),     # golden gap / alarm math
+    ("dsin_tpu", "serve", "service.py"),     # request-path roots
+    ("dsin_tpu", "serve", "router.py"),
+    ("dsin_tpu", "serve", "federation.py"),
+    ("dsin_tpu", "serve", "batcher.py"),
+    ("dsin_tpu", "serve", "metrics.py"),     # METRIC_REGISTRY
+    ("dsin_tpu", "coding", "precision.py"),  # the precision wall itself
+    ("dsin_tpu", "utils", "faults.py"),      # fault-site registry
+)]
+
+
+def test_contracts_gate_via_cli_contract(capsys):
+    """ISSUE 20 acceptance: the contracts family exits clean over every
+    production tree — alone and composed with the other repo families
+    (the exact invocation the tpu_session.sh lint stage runs)."""
+    assert run(["--contracts"] + LINT_TARGETS) == EXIT_CLEAN
+    assert "0 finding(s)" in capsys.readouterr().out
+    assert run(["--concurrency", "--lockgraph", "--contracts"]
+               + LINT_TARGETS) == EXIT_CLEAN
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_contract_modules_are_in_the_contracts_walk():
+    """Pinning the walked file set: carving serve/, the policy modules,
+    or coding/precision.py out of the lint targets would silently drop
+    the purity / precision-wall / typed-raise gates. Mirrors
+    test_threaded_modules_are_in_the_concurrency_walk."""
+    from tools.jaxlint import LintConfig
+    walked = set(LintConfig().iter_files(LINT_TARGETS))
+    missing = [p for p in CONTRACT_MODULES if p not in walked]
+    assert not missing, f"contract-bearing modules exempted from the " \
+                        f"contracts walk: {missing}"
+
+
+def test_policy_roster_is_covered_interprocedurally():
+    """The pure-policy walk must actually reach the policy surface the
+    issue names: AutoscalePolicy, FleetHealthPolicy, RebalanceTrigger,
+    plan_placement, and the quality gap/alarm math."""
+    from tools.jaxlint import contracts
+    analysis = contracts.analyze_paths(LINT_TARGETS)
+    roster = {e.rsplit(".", 1)[-1] for e in analysis.pure_entities}
+    for name in ("AutoscalePolicy", "FleetHealthPolicy",
+                 "RebalanceTrigger", "plan_placement",
+                 "compare_goldens", "validate_goldens",
+                 "wave_canary_verdict"):
+        assert name in roster, f"{name} missing from pure roster {roster}"
+    assert len(analysis.request_roots) >= 10, analysis.request_roots

@@ -175,7 +175,7 @@ def test_cli_json_format(tmp_path, capsys):
 
 def test_cli_json_findings_schema_is_stable(tmp_path, capsys):
     """The machine-readable contract CI consumes: every finding is
-    exactly {rule, path, line, message, suppressed}; suppressed
+    exactly {rule, family, path, line, message, suppressed}; suppressed
     findings are present with the flag set but do not drive exit 1."""
     import json
     bad = tmp_path / "bad.py"
@@ -185,9 +185,10 @@ def test_cli_json_findings_schema_is_stable(tmp_path, capsys):
         "  # jaxlint: disable=host-call-in-jit -- exercised by tests"))
     assert run([str(bad), str(ok), "--format", "json"]) == EXIT_FINDINGS
     payload = json.loads(capsys.readouterr().out)
-    assert all(sorted(row) == ["line", "message", "path", "rule",
-                               "suppressed"]
+    assert all(sorted(row) == ["family", "line", "message", "path",
+                               "rule", "suppressed"]
                for row in payload["findings"])
+    assert all(row["family"] == "core" for row in payload["findings"])
     flags = [(row["path"], row["suppressed"])
              for row in payload["findings"]]
     assert (str(bad), False) in flags
@@ -386,3 +387,105 @@ def test_list_suppressions_audit_mode(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "STALE(retired-rule)" in out
     assert "1 stale" in out
+
+
+# -- contractlint (contracts family) ------------------------------------------
+
+def test_expected_counts_on_contracts_bad_fixtures():
+    """Pin exact firing counts for every contracts fixture: a rule that
+    silently widens or narrows must move a number here."""
+    active, _ = _lint_fixture("contract_pure_policy_bad.py")
+    assert len([f for f in active
+                if f.rule == "contract-pure-policy"]) == 4
+    active, _ = _lint_fixture("contract_precision_wall_bad.py")
+    assert len([f for f in active
+                if f.rule == "contract-precision-wall"]) == 3
+    active, _ = _lint_fixture("contract_typed_raise_bad.py")
+    assert len([f for f in active
+                if f.rule == "contract-typed-raise"]) == 2
+    active, _ = _lint_fixture("contract_registry_drift_bad.py")
+    assert len([f for f in active
+                if f.rule == "contract-registry-drift"]) == 4
+
+
+def test_contracts_pure_reports_the_call_path():
+    """The interprocedural finding names the chain from the pure root
+    to the effect site — that is what makes it actionable."""
+    active, _ = _lint_fixture("contract_pure_policy_bad.py")
+    paths = [f for f in active if f.rule == "contract-pure-policy"
+             and "->" in f.message]
+    assert paths, active
+    (f,) = paths
+    assert "jitter" in f.message and "_helper" in f.message
+    assert "random" in f.message
+
+
+def test_contracts_flag_runs_only_the_family(tmp_path):
+    """--contracts fires on a contract break and stays silent on JAX
+    rules; composed with --lockgraph both whole-repo families run."""
+    import io
+    fix = os.path.join(FIXDIR, "contract_typed_raise_bad.py")
+    buf = io.StringIO()
+    assert run(["--contracts", fix], out=buf) == EXIT_FINDINGS
+    assert "contract-typed-raise" in buf.getvalue()
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        "import jax\n"
+        "import numpy as np\n\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.mean(x)\n")
+    buf2 = io.StringIO()
+    assert run(["--contracts", str(mixed)], out=buf2) == EXIT_CLEAN
+    assert "host-call-in-jit" not in buf2.getvalue()
+    both = os.path.join(FIXDIR, "lockgraph_rank_inversion_bad.py")
+    buf3 = io.StringIO()
+    assert run(["--contracts", "--lockgraph", both, fix],
+               out=buf3) == EXIT_FINDINGS
+    out3 = buf3.getvalue()
+    assert "lockgraph-rank-inversion" in out3
+    assert "contract-typed-raise" in out3
+    # family ∩ --select that names no family rule is still an error
+    assert run(["--contracts", "--select", "host-call-in-jit",
+                str(mixed)]) == EXIT_INTERNAL
+
+
+def test_contracts_partial_walk_finds_partitions_on_disk(tmp_path):
+    """Linting a subtree with no partition literal must still resolve
+    the precision wall — the analyzer climbs to coding/precision.py
+    from the walked files, exactly like the lockgraph HIERARCHY."""
+    pkg = tmp_path / "dsin_tpu" / "coding"
+    pkg.mkdir(parents=True)
+    (pkg / "precision.py").write_text(
+        'ENTROPY_CRITICAL = frozenset({"probclass", "centers"})\n'
+        'DISTORTION_SIDE = ("encoder",)\n')
+    sub = tmp_path / "dsin_tpu" / "serve"
+    sub.mkdir()
+    (sub / "mod.py").write_text(
+        "def narrow(params):\n"
+        '    return params["probclass"].astype("bfloat16")\n')
+    findings, _, _ = lint_paths([str(sub)])
+    assert [f.rule for f in findings] == ["contract-precision-wall"], \
+        findings
+
+
+def test_list_suppressions_flags_no_longer_firing_sites(tmp_path,
+                                                        capsys):
+    """The staleness audit is semantic, not just registry-based: a
+    suppression naming a REAL rule that no longer fires at that site is
+    stale (the hazard was fixed; the justification now rots)."""
+    dead = tmp_path / "dead.py"
+    dead.write_text(
+        "import numpy as np\n\n\n"
+        "def f(x):   # jaxlint: disable=host-call-in-jit -- fixed since\n"
+        "    return np.mean(x)\n")
+    assert run(["--list-suppressions", str(dead)]) == EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert "STALE(host-call-in-jit)" in out
+
+    import json
+    assert run(["--list-suppressions", "--format", "json",
+                str(dead)]) == EXIT_FINDINGS
+    payload = json.loads(capsys.readouterr().out)
+    (row,) = payload["suppressions"]
+    assert row["stale"] == ["host-call-in-jit"]

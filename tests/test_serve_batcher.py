@@ -335,10 +335,14 @@ def test_default_policy_rejects_explicit_zero_bulk_queue():
 
 
 def test_unknown_priority_class_rejected_typed():
+    from dsin_tpu.serve.batcher import UnknownPriorityClass
     b = MicroBatcher(max_batch=2, max_wait_ms=0, max_queue=4,
                      classes=_classes())
-    with pytest.raises(ValueError, match="unknown priority class"):
+    with pytest.raises(UnknownPriorityClass,
+                       match="unknown priority class"):
         b.submit(_preq(priority="vip"))
+    # still a ValueError: pre-typed callers' except clauses keep working
+    assert issubclass(UnknownPriorityClass, ValueError)
 
 
 def test_default_class_is_the_most_latency_sensitive():

@@ -34,8 +34,10 @@ def test_admission_validates_limits():
 
 
 def test_admission_unknown_class_is_typed():
+    from dsin_tpu.serve.batcher import UnknownPriorityClass
     gate = AdmissionController({INTERACTIVE: 2})
-    with pytest.raises(ValueError, match="unknown priority class"):
+    with pytest.raises(UnknownPriorityClass,
+                       match="unknown priority class"):
         gate.admit("vip")
 
 

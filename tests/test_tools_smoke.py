@@ -638,3 +638,40 @@ def test_cache_dir_keyed_by_host_fingerprint(monkeypatch, tmp_path):
         jax.config.update("jax_compilation_cache_dir", prior_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           prior_floor)
+
+
+# -- jaxlint machine-readable output (the CI surface) -------------------------
+
+def test_jaxlint_json_carries_the_rule_family(tmp_path):
+    """`--format json` findings carry a `family` key (core /
+    concurrency / lockgraph / contracts) so CI can route them without
+    re-deriving the rule taxonomy. Schema per finding (pinned in
+    test_jaxlint_rules.py too, but this is the subprocess surface
+    tpu_session.sh and CI actually shell out to):
+    {rule, family, path, line, message, suppressed}."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading\n"
+        "import jax\n"
+        "import numpy as np\n\n"
+        "LOCK = threading.Lock()\n\n\n"
+        "# contract: pure\n"
+        "def f(x):\n"
+        "    print(x)\n"
+        "    return x\n\n\n"
+        "@jax.jit\n"
+        "def g(x):\n"
+        "    return np.mean(x)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--format", "json",
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    assert all(sorted(row) == ["family", "line", "message", "path",
+                               "rule", "suppressed"]
+               for row in payload["findings"])
+    fam = {row["rule"]: row["family"] for row in payload["findings"]}
+    assert fam["host-call-in-jit"] == "core"
+    assert fam["raw-lock-construction"] == "concurrency"
+    assert fam["contract-pure-policy"] == "contracts"

@@ -11,12 +11,18 @@ without their lock, blocking calls under locks, thread-local escapes —
 plus the whole-repo lockgraph family (tools/jaxlint/lockgraph.py):
 interprocedural rank-inversion paths, blocking calls and guarded-field
 touches reachable through the call graph while ranked locks are held,
-and unresolvable RankedLock constructions.
+and unresolvable RankedLock constructions — plus the whole-repo
+contracts family (tools/jaxlint/contracts.py): `# contract: pure`
+policy math reaching effects on any call path, bf16/int8 casts crossing
+the entropy-critical precision wall, bare builtin raises reachable from
+`# contract: request-path` serve entries, and fault-site / metric-name
+registry drift.
 
 Entry points:
     python -m tools.jaxlint dsin_tpu/           # CLI (exit 0/1/2)
     python -m tools.jaxlint --concurrency ...   # threadlint family only
     python -m tools.jaxlint --lockgraph ...     # whole-repo lock pass
+    python -m tools.jaxlint --contracts ...     # whole-repo contracts
     python -m tools.jaxlint --format json ...   # machine-readable
     python -m tools.jaxlint --list-suppressions ...  # audit; 1 on stale
     from tools.jaxlint import lint_paths        # in-process (tests, CI)
@@ -31,9 +37,10 @@ from tools.jaxlint.framework import Finding, Rule, lint_source
 from tools.jaxlint.rules import ALL_RULES, RULES_BY_NAME
 from tools.jaxlint.concurrency import CONCURRENCY_RULE_NAMES
 from tools.jaxlint.lockgraph import LOCKGRAPH_RULE_NAMES
+from tools.jaxlint.contracts import CONTRACTS_RULE_NAMES
 from tools.jaxlint.cli import audit_suppressions, lint_paths, run
 
-__all__ = ["ALL_RULES", "CONCURRENCY_RULE_NAMES",
+__all__ = ["ALL_RULES", "CONCURRENCY_RULE_NAMES", "CONTRACTS_RULE_NAMES",
            "LOCKGRAPH_RULE_NAMES", "RULES_BY_NAME", "Finding",
            "LintConfig", "Rule", "audit_suppressions", "lint_paths",
            "lint_source", "run"]

@@ -10,6 +10,7 @@
 from __future__ import annotations
 
 import argparse
+import ast
 import sys
 import traceback
 from typing import List, Optional, Sequence, Tuple
@@ -28,10 +29,13 @@ def lint_paths(paths: Sequence[str], config: Optional[LintConfig] = None
     """Lint files/directories. Returns (findings, suppressed_findings,
     files_count). Raises on unreadable paths (CLI maps that to exit 2).
 
-    Per-file rules run file by file; if any lockgraph rule is enabled,
-    the whole-repo interprocedural pass runs once over every walked
-    file together and its findings merge in."""
-    from tools.jaxlint.lockgraph import LOCKGRAPH_RULE_NAMES, lint_repo
+    Per-file rules run file by file; if any lockgraph or contracts rule
+    is enabled, that whole-repo interprocedural pass runs once over
+    every walked file together and its findings merge in."""
+    from tools.jaxlint.lockgraph import LOCKGRAPH_RULE_NAMES
+    from tools.jaxlint.lockgraph import lint_repo as lockgraph_repo
+    from tools.jaxlint.contracts import CONTRACTS_RULE_NAMES
+    from tools.jaxlint.contracts import lint_repo as contracts_repo
     config = config or LintConfig()
     findings: List[Finding] = []
     suppressed: List[Finding] = []
@@ -44,8 +48,13 @@ def lint_paths(paths: Sequence[str], config: Optional[LintConfig] = None
         active, sup = lint_source(source, path, config)
         findings.extend(active)
         suppressed.extend(sup)
-    if any(n in LOCKGRAPH_RULE_NAMES for n in config.enabled_rules()):
-        repo_active, repo_sup = lint_repo(sources, config)
+    enabled = set(config.enabled_rules())
+    if enabled & set(LOCKGRAPH_RULE_NAMES):
+        repo_active, repo_sup = lockgraph_repo(sources, config)
+        findings.extend(repo_active)
+        suppressed.extend(repo_sup)
+    if enabled & set(CONTRACTS_RULE_NAMES):
+        repo_active, repo_sup = contracts_repo(sources, config)
         findings.extend(repo_active)
         suppressed.extend(repo_sup)
     return findings, suppressed, len(files)
@@ -55,20 +64,56 @@ def audit_suppressions(paths: Sequence[str],
                        config: Optional[LintConfig] = None
                        ) -> Tuple[list, int]:
     """The `--list-suppressions` audit: every inline disable with its
-    file:line and justification, plus how many are STALE (name a rule
-    that no longer exists — dead suppressions otherwise rot invisibly
-    as rules are renamed or retired). Returns (rows, stale_count) where
-    each row is (path, line, rules, reason, stale_rules)."""
+    file:line and justification, plus how many are STALE. A
+    suppression is stale when a rule it names no longer exists, OR
+    when the named rule no longer FIRES at that site (the audit
+    re-lints everything with every rule enabled and checks which
+    suppressed findings each entry actually absorbs) — dead
+    suppressions otherwise rot the justification trail as rules are
+    renamed, retired, or the code under them is fixed. A `disable=all`
+    entry is stale only if it absorbs nothing. Returns
+    (rows, stale_count) where each row is
+    (path, line, rules, reason, stale_rules)."""
+    from tools.jaxlint.framework import _statement_start_lines
     from tools.jaxlint.rules import RULES_BY_NAME
     config = config or LintConfig()
+    # re-lint with EVERY rule enabled (not the CLI-narrowed family) so
+    # a cross-family suppression is never falsely stale
+    full = LintConfig(select=(), ignore=(),
+                      exclude_dirs=config.exclude_dirs,
+                      compat_modules=config.compat_modules,
+                      lock_modules=config.lock_modules)
+    _, suppressed, _ = lint_paths(paths, full)
+    by_path = {}
+    for f in suppressed:
+        by_path.setdefault(f.path, []).append(f)
     rows = []
     stale_total = 0
-    for path in config.iter_files(paths):
+    for path in full.iter_files(paths):
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
-        for entry in Suppressions(source).entries:
-            stale = sorted(r for r in entry.rules - {"*"}
-                           if r not in RULES_BY_NAME)
+        entries = Suppressions(source).entries
+        if not entries:
+            continue
+        try:
+            stmt_start = _statement_start_lines(ast.parse(source))
+        except SyntaxError:
+            stmt_start = {}
+        absorbed = {entry.line: set() for entry in entries}
+        for f in by_path.get(path, ()):
+            lines = {f.line, stmt_start.get(f.line, f.line)}
+            for entry in entries:
+                if entry.applies_to in lines and (
+                        f.rule in entry.rules or "*" in entry.rules):
+                    absorbed[entry.line].add(f.rule)
+        for entry in entries:
+            hits = absorbed[entry.line]
+            if entry.rules == {"*"}:
+                stale = [] if hits else ["*"]
+            else:
+                stale = sorted(r for r in entry.rules - {"*"}
+                               if r not in RULES_BY_NAME
+                               or r not in hits)
             stale_total += len(stale)
             rows.append((path, entry.line, sorted(entry.rules),
                          entry.reason, stale))
@@ -97,10 +142,21 @@ def _parse_args(argv: Optional[Sequence[str]]) -> argparse.Namespace:
                         "calls and guarded-field touches reachable "
                         "through the call graph, unresolved lock "
                         "constructions); combines with --concurrency")
+    p.add_argument("--contracts", action="store_true",
+                   help="run only the whole-repo contracts family "
+                        "(pure-policy effects, precision wall, typed "
+                        "raises on request paths, fault-site/metric "
+                        "registry drift); combines with --concurrency "
+                        "and --lockgraph")
     p.add_argument("--emit-lockgraph", metavar="PREFIX", default="",
                    help="write the derived lock-order graph to "
                         "PREFIX.json and PREFIX.dot (implies the "
                         "lockgraph analysis pass)")
+    p.add_argument("--emit-contracts", metavar="PREFIX", default="",
+                   help="write the derived contract surface (pure "
+                        "roster, precision partitions, typed-error "
+                        "registry, fault/metric coverage) to "
+                        "PREFIX.json")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit 0")
     p.add_argument("--list-suppressions", action="store_true",
@@ -130,6 +186,9 @@ def run(argv: Optional[Sequence[str]] = None,
         if args.lockgraph:
             from tools.jaxlint.lockgraph import LOCKGRAPH_RULE_NAMES
             family += tuple(LOCKGRAPH_RULE_NAMES)
+        if args.contracts:
+            from tools.jaxlint.contracts import CONTRACTS_RULE_NAMES
+            family += tuple(CONTRACTS_RULE_NAMES)
         if family:
             if select:
                 select = tuple(n for n in family if n in select)
@@ -159,6 +218,12 @@ def run(argv: Optional[Sequence[str]] = None,
             analysis = lockgraph.analyze_paths(args.paths, config)
             for path in lockgraph.emit_artifacts(analysis,
                                                  args.emit_lockgraph):
+                print(f"jaxlint: wrote {path}", file=sys.stderr)
+        if args.emit_contracts:
+            from tools.jaxlint import contracts
+            analysis = contracts.analyze_paths(args.paths, config)
+            for path in contracts.emit_artifacts(analysis,
+                                                 args.emit_contracts):
                 print(f"jaxlint: wrote {path}", file=sys.stderr)
         fmt = (reporting.format_json if args.format == "json"
                else reporting.format_text)

@@ -1,0 +1,984 @@
+"""contracts: whole-repo effect/purity, precision-wall, typed-error and
+registry-drift verification (the contractlint family, PR 20).
+
+The repo runs on contracts that were only enforced at runtime or by
+convention; lockgraph (PR 16) showed how to promote one — the lock rank
+hierarchy — into a whole-repo static theorem. This family does the same
+for three more, reusing the shared call-graph + summary-propagation
+machinery in tools/jaxlint/callgraph.py:
+
+* `contract-pure-policy` — functions/classes under a `# contract: pure`
+  annotation (the autoscale/placement/quality policy math whose replay
+  the ROADMAP scenario-lab depends on) must not, on ANY call path,
+  touch time/random/IO/env, mutate module globals or `self` outside
+  `__init__`, acquire ranked locks, or call device/jit entry points.
+  Windowed hysteresis counters are the one sanctioned mutable state:
+  declare them on their `__init__` seeding line with
+  `# contract: state` and mutation of those fields by the declaring
+  class stays legal (and auditable — the roster + declared state land
+  in artifacts/contracts.json).
+* `contract-precision-wall` — a dtype-flow pass over every cast site:
+  `.astype(...)`, `asarray/array(..., dtype=...)` and
+  `convert_element_type` to bf16/int8/fp16 whose value is drawn from —
+  or stored into — an entropy-critical partition (the
+  `ENTROPY_CRITICAL` frozenset parsed from coding/precision.py, disk
+  fallback like lockgraph's HIERARCHY parse) is a finding.
+  `PrecisionPolicy.cast_params`' identity path never casts those
+  partitions, so the sanctioned path is silent by construction.
+* `contract-typed-raise` — every `raise` of a bare builtin exception
+  (Exception, RuntimeError, ValueError, ...) reachable through the
+  call graph from a `# contract: request-path` entry point is a
+  finding: the serve stack's zero-hung-futures story depends on every
+  reachable failure being a REGISTERED typed error (the registry is
+  the set of walked exception classes whose base chain reaches a
+  builtin exception).
+* `contract-registry-drift` — fault-site string literals
+  (`faults.inject/corrupt/FaultSpec(site=...)/fault_site=`) must
+  resolve to `utils/faults.py SITES`, and metric-name literals
+  (`.counter/.gauge/.histogram("...")`) to `serve/metrics.py
+  METRIC_REGISTRY` (entries ending `*` are prefixes, matching the
+  f-string families). Registered-but-never-visited rows fire only
+  when the registry module itself is in the walk, so partial walks
+  cannot false-positive on coverage.
+
+Known conservatism (deliberate — each gap under-reports):
+
+* Effects propagate only over resolved call edges (the callgraph.py
+  resolution rules); dynamic dispatch, callbacks and thread targets
+  are not edges. numpy host math is NOT an effect — only
+  numpy.random/jax.random (random), jnp/jax device entry points.
+* `raise` of an unresolvable non-builtin name (a caught variable, an
+  import from outside the walk) is not flagged.
+* The precision wall follows function-local flow (`p = params["x"];
+  p.astype(...)`) and stores into critical partitions, not
+  cross-function value flow; cross-function reach is covered by the
+  store check at the partition boundary.
+* Metric f-strings are checked by their leading literal; a metric
+  name with no leading literal is skipped. `set_info`-style
+  free-text keys are not metric names and are not checked.
+
+The derived artifact — artifacts/contracts.json: pure-policy roster
+(+declared state), precision-wall partition map, typed-error registry,
+fault-site coverage matrix (with the chaos batteries' covered-site
+list), metric registry — is committed and three-way drift-pinned by
+tests/test_contracts_repo.py (code == artifact == README tables).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.framework import Finding, dotted_name
+from tools.jaxlint.callgraph import (CallGraph, RepoRule, _Func, _Line,
+                                     _Module, _display, _is_test_path,
+                                     climb_for, filter_suppressed)
+
+CONTRACT_RE = re.compile(r"#\s*contract:\s*(pure|state|request-path)\b")
+
+#: builtin exception names whose bare `raise` on a request path is a
+#: finding; control-flow and interface sentinels stay legal
+_BUILTIN_EXC = frozenset(
+    n for n in dir(builtins)
+    if isinstance(getattr(builtins, n), type)
+    and issubclass(getattr(builtins, n), BaseException))
+_ALLOWED_BUILTIN_RAISES = frozenset({
+    "StopIteration", "StopAsyncIteration", "GeneratorExit",
+    "KeyboardInterrupt", "SystemExit", "NotImplementedError",
+    "AssertionError"})
+FLAGGED_BUILTIN_RAISES = _BUILTIN_EXC - _ALLOWED_BUILTIN_RAISES
+
+# -- the effect model ---------------------------------------------------------
+
+TIME_EXACT = frozenset({"datetime.datetime.now", "datetime.datetime.utcnow",
+                        "datetime.date.today"})
+TIME_PREFIXES = ("time.",)
+RANDOM_EXACT = frozenset({"os.urandom"})
+RANDOM_PREFIXES = ("random.", "numpy.random.", "jax.random.", "secrets.")
+IO_EXACT = frozenset({"open", "input", "print", "os.getenv", "os.putenv",
+                      "os.unsetenv", "os.system", "os.remove", "os.unlink",
+                      "os.rename", "os.replace", "os.makedirs", "os.mkdir"})
+IO_PREFIXES = ("subprocess.", "socket.", "os.environ", "sys.stdout.",
+               "sys.stderr.", "shutil.", "logging.")
+DEVICE_EXACT = frozenset({"jax.jit", "jax.pmap", "jax.device_put",
+                          "jax.device_get", "jax.devices",
+                          "jax.local_devices", "jax.block_until_ready"})
+DEVICE_PREFIXES = ("jax.numpy.",)
+
+#: receiver-method calls that mutate the receiver in place
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "add", "update", "insert", "remove", "discard",
+    "clear", "pop", "popitem", "setdefault", "appendleft", "extendleft",
+    "sort", "reverse", "write"})
+
+#: dtypes behind the precision wall (fp32 is the contract)
+LOW_DTYPE_STRS = frozenset({"bfloat16", "bf16", "int8", "float16",
+                            "fp16", "half"})
+LOW_DTYPE_ATTRS = frozenset({"bfloat16", "int8", "float16", "half"})
+CAST_CALLS = frozenset({"asarray", "array", "convert_element_type",
+                        "full", "zeros", "ones"})
+METRIC_METHODS = frozenset({"counter", "gauge", "histogram"})
+FAULT_CALLS = frozenset({"inject", "corrupt"})
+
+
+def _impure_call(canon: str) -> Optional[Tuple[str, str]]:
+    """(category, desc) when a canonical dotted call is an effect."""
+    if canon in TIME_EXACT or canon.startswith(TIME_PREFIXES):
+        return ("time", canon)
+    if canon in RANDOM_EXACT or canon.startswith(RANDOM_PREFIXES):
+        return ("random", canon)
+    if canon in IO_EXACT or canon.startswith(IO_PREFIXES):
+        return ("io/env", canon)
+    if canon in DEVICE_EXACT or canon.startswith(DEVICE_PREFIXES):
+        return ("device/jit", canon)
+    return None
+
+
+def _canon(mod: _Module, dn: str) -> str:
+    """Canonicalize a dotted name through the module's imports
+    (`jnp.asarray` -> `jax.numpy.asarray`)."""
+    parts = dn.split(".")
+    head = parts[0]
+    if head == "self":
+        return dn
+    return ".".join([mod.imports.get(head, head)] + parts[1:])
+
+
+def _annotations(source: str) -> Dict[int, str]:
+    """`# contract: <kind>` comments resolved to the code line they
+    cover — a trailing comment covers its own line, a comment-only
+    line covers the next code line (same convention as suppressions)."""
+    out: Dict[int, str] = {}
+    lines = source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = CONTRACT_RE.search(text)
+        if not m:
+            continue
+        comment_only = text[:m.start()].strip() == ""
+        applies = i
+        if comment_only:
+            applies = i + 1
+            while applies <= len(lines):
+                stripped = lines[applies - 1].strip()
+                if stripped and not stripped.startswith("#"):
+                    break
+                applies += 1
+        out.setdefault(applies, m.group(1))
+    return out
+
+
+def _body_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's own body, excluding nested defs/lambdas/classes
+    (they are separate scopes, scanned as their own functions)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _self_attr_root(node: ast.AST) -> Optional[str]:
+    """`self.x`, `self.x[k]`, `self.x.y` -> 'x' (the mutated field)."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        parent = node.value
+        if isinstance(node, ast.Attribute) and \
+                isinstance(parent, ast.Name) and parent.id == "self":
+            return node.attr
+        node = parent
+    return None
+
+
+def _parse_str_collection(tree: ast.Module, name: str
+                          ) -> Optional[Tuple[List[str], int]]:
+    """A top-level `NAME = (str, ...)` / `frozenset({...})` literal of
+    strings -> (values in declared order, lineno), else None."""
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if not (isinstance(target, ast.Name) and target.id == name):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call):
+            fn = dotted_name(value.func)
+            if fn and fn.split(".")[-1] in ("frozenset", "set", "tuple",
+                                            "list") and value.args:
+                value = value.args[0]
+        if not isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            continue
+        vals = [e.value for e in value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+        if vals and len(vals) == len(value.elts):
+            return vals, node.lineno
+    return None
+
+
+def _metric_matches(name: str, registry: Sequence[str],
+                    is_prefix: bool = False) -> List[str]:
+    """Registry entries a metric name (or f-string leading literal)
+    satisfies; entries ending `*` are prefixes."""
+    out = []
+    for entry in registry:
+        if entry.endswith("*"):
+            if name.startswith(entry[:-1]):
+                out.append(entry)
+        elif is_prefix:
+            # a leading literal can only witness a prefix entry
+            continue
+        elif name == entry:
+            out.append(entry)
+    return out
+
+
+# -- whole-repo analysis ------------------------------------------------------
+
+class ContractAnalysis(CallGraph):
+    """The whole-repo contract model one lint invocation builds."""
+
+    def __init__(self, sources: Sequence[Tuple[str, str]], config):
+        super().__init__(sources, config)
+        self.ann: Dict[str, Dict[int, str]] = {
+            mod.name: _annotations(mod.source)
+            for mod in self.modules.values()}
+        self.pure_entities: Dict[str, dict] = {}
+        self.request_entities: Dict[str, dict] = {}
+        self.pure_roots: Dict[str, str] = {}      # func -> entity
+        self.request_roots: Dict[str, str] = {}   # func -> entity
+        self._attach_annotations()
+        self.state_decls: Dict[str, List[str]] = {}
+        self._collect_state_decls()
+        self._eff: Dict[str, dict] = {}
+        self._raises: Dict[str, dict] = {}
+        self._seed_summaries()
+        self._te = self._fix(lambda f: self._eff.get(f.qname, {}))
+        self._tr = self._fix(lambda f: self._raises.get(f.qname, {}))
+        self.error_registry = self._typed_error_registry()
+        (self.entropy_critical, self.distortion_side,
+         self.precision_source) = self._find_partitions()
+        (self.fault_sites, self.fault_source,
+         self.fault_site_line) = self._find_registry(
+            "SITES", "faults", "dsin_tpu/utils/faults.py")
+        (self.metric_registry, self.metric_source,
+         self.metric_reg_line) = self._find_registry(
+            "METRIC_REGISTRY", "metrics", "dsin_tpu/serve/metrics.py")
+        self.fault_visits: Dict[str, List[str]] = {}
+        self.chaos_sites: Dict[str, List[str]] = {}
+        self.metric_uses: Dict[str, List[str]] = {}
+        self._registry_findings: List[Finding] = []
+        self._scan_registries()
+        self._precision_findings = list(self._scan_precision())
+
+    # -- annotations ----------------------------------------------------------
+
+    def _attach_annotations(self) -> None:
+        for mod in self.modules.values():
+            ann = self.ann[mod.name]
+            if not ann:
+                continue
+
+            def kind_for(node) -> Optional[str]:
+                headers = {node.lineno} | {
+                    d.lineno for d in getattr(node, "decorator_list", ())}
+                for ln in headers:
+                    k = ann.get(ln)
+                    if k in ("pure", "request-path"):
+                        return k
+                return None
+
+            def note(qname, node, k, entity_kind):
+                entry = {"entity": qname, "kind": entity_kind,
+                         "path": _display(mod.path), "line": node.lineno}
+                reg = (self.pure_entities if k == "pure"
+                       else self.request_entities)
+                reg.setdefault(qname, entry)
+
+            for name, fn in mod.funcs.items():
+                k = kind_for(fn)
+                if k:
+                    q = f"{mod.name}.{name}"
+                    note(q, fn, k, "function")
+                    (self.pure_roots if k == "pure"
+                     else self.request_roots).setdefault(q, q)
+            for cls in mod.classes.values():
+                k = kind_for(cls.node)
+                if k:
+                    note(cls.qname, cls.node, k, "class")
+                    for mname in cls.methods:
+                        (self.pure_roots if k == "pure"
+                         else self.request_roots).setdefault(
+                            f"{cls.qname}.{mname}", cls.qname)
+                for mname, meth in cls.methods.items():
+                    mk = kind_for(meth)
+                    if mk:
+                        q = f"{cls.qname}.{mname}"
+                        note(q, meth, mk, "method")
+                        (self.pure_roots if mk == "pure"
+                         else self.request_roots).setdefault(q, q)
+
+    def _collect_state_decls(self) -> None:
+        for cls in self.classes.values():
+            ann = self.ann.get(cls.module, {})
+            if not ann:
+                continue
+            fields: Set[str] = set()
+            for meth in cls.methods.values():
+                for sub in ast.walk(meth):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    end = getattr(sub, "end_lineno", sub.lineno) \
+                        or sub.lineno
+                    if not any(ann.get(ln) == "state"
+                               for ln in range(sub.lineno, end + 1)):
+                        continue
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            fields.add(t.attr)
+            if fields:
+                self.state_decls[cls.qname] = sorted(fields)
+
+    # -- per-function effect / raise seeds -----------------------------------
+
+    def _seed_summaries(self) -> None:
+        for f in self.funcs.values():
+            mod = self.modules.get(f.module)
+            if mod is None:
+                continue
+            eff = self._effect_seeds(mod, f)
+            if eff:
+                self._eff[f.qname] = eff
+            rs = self._raise_seeds(mod, f)
+            if rs:
+                self._raises[f.qname] = rs
+
+    def _effect_seeds(self, mod: _Module, f: _Func) -> dict:
+        out: dict = {}
+
+        def note(key, line):
+            out.setdefault(key, (line, None))
+
+        for lock, line, _held in f.acquires:
+            note(("lock", lock), line)
+
+        globals_declared: Set[str] = set()
+        for node in _body_nodes(f.node):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+
+        init_like = f.name in ("__init__", "__post_init__", "__new__")
+        for node in _body_nodes(f.node):
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn:
+                    hit = _impure_call(_canon(mod, dn))
+                    if hit:
+                        note(("effect",) + hit, node.lineno)
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr == "block_until_ready":
+                        note(("effect", "device/jit",
+                              ".block_until_ready()"), node.lineno)
+                    if node.func.attr in MUTATOR_METHODS and \
+                            not init_like and f.cls is not None:
+                        root = _self_attr_root(node.func.value)
+                        if root is not None:
+                            note(("selfmut", f.cls, root), node.lineno)
+            elif isinstance(node, ast.Attribute):
+                if _canon(mod, dotted_name(node) or "") == "os.environ":
+                    note(("effect", "io/env", "os.environ"), node.lineno)
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in globals_declared:
+                    note(("global", t.id), node.lineno)
+                    continue
+                if not init_like and f.cls is not None:
+                    root = _self_attr_root(t)
+                    if root is not None:
+                        note(("selfmut", f.cls, root), node.lineno)
+        return out
+
+    def _raise_seeds(self, mod: _Module, f: _Func) -> dict:
+        out: dict = {}
+        for node in _body_nodes(f.node):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            target = node.exc.func if isinstance(node.exc, ast.Call) \
+                else node.exc
+            dn = dotted_name(target)
+            if dn is None:
+                continue
+            if self._resolve_symbol(mod, dn) is not None:
+                # resolves to a repo symbol (typed-error class or a
+                # walked import) — registry membership is audited via
+                # the artifact; unresolved repo classes are skipped
+                continue
+            if dn in FLAGGED_BUILTIN_RAISES:
+                out.setdefault(("raise", dn, f.path, node.lineno),
+                               (node.lineno, None))
+        return out
+
+    # -- registries -----------------------------------------------------------
+
+    def _typed_error_registry(self) -> List[str]:
+        reg: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for cls in self.classes.values():
+                if cls.qname in reg:
+                    continue
+                mod = self.modules.get(cls.module)
+                for b in cls.bases:
+                    bq = self._resolve_symbol(mod, b) if mod else None
+                    if bq is None and b in _BUILTIN_EXC:
+                        reg.add(cls.qname)
+                        changed = True
+                        break
+                    if bq in reg:
+                        reg.add(cls.qname)
+                        changed = True
+                        break
+        return sorted(reg)
+
+    def _find_partitions(self) -> Tuple[frozenset, List[str], str]:
+        best = None
+        for mod in self.modules.values():
+            got = _parse_str_collection(mod.tree, "ENTROPY_CRITICAL")
+            if got is None:
+                continue
+            side = _parse_str_collection(mod.tree, "DISTORTION_SIDE")
+            cand = (frozenset(got[0]), list(side[0]) if side else [],
+                    _display(mod.path))
+            if mod.stem == "precision":
+                return cand
+            best = best or cand
+        if best is not None:
+            return best
+        tree, path = climb_for(self.modules,
+                               "dsin_tpu/coding/precision.py")
+        if tree is not None:
+            got = _parse_str_collection(tree, "ENTROPY_CRITICAL")
+            side = _parse_str_collection(tree, "DISTORTION_SIDE")
+            if got is not None:
+                return (frozenset(got[0]),
+                        list(side[0]) if side else [], _display(path))
+        return frozenset(), [], ""
+
+    def _find_registry(self, name: str, stem: str, relpath: str
+                       ) -> Tuple[Optional[List[str]], str, int]:
+        """(entries, source, line). line > 0 only when the registry
+        module is IN the walk — never-visited-row findings anchor there
+        and are skipped for disk-fallback registries (partial walks
+        cannot see every visit site)."""
+        best = None
+        for mod in self.modules.values():
+            got = _parse_str_collection(mod.tree, name)
+            if got is None:
+                continue
+            cand = (got[0], _display(mod.path), got[1], mod.path)
+            if mod.stem == stem:
+                best = cand
+                break
+            best = best or cand
+        if best is not None:
+            return best[0], best[1], best[2]
+        tree, path = climb_for(self.modules, relpath)
+        if tree is not None:
+            got = _parse_str_collection(tree, name)
+            if got is not None:
+                return got[0], _display(path), 0
+        return None, "", 0
+
+    def _registry_module_path(self, source: str) -> Optional[str]:
+        for mod in self.modules.values():
+            if _display(mod.path) == source:
+                return mod.path
+        return None
+
+    # -- registry-drift scan --------------------------------------------------
+
+    def _metric_wrapper_positions(self) -> Dict[str, int]:
+        """One level of indirection: a function whose body forwards one
+        of its own parameters as the metric name to .counter/.gauge/
+        .histogram is a metric wrapper, and const-str arguments at its
+        call sites are metric sites (shmlane-style
+        `self._count("serve_shm_sends")`). Maps (module, bare name) to
+        the positional index of the name argument at call sites
+        (leading self/cls excluded). Keyed per defining module so an
+        unrelated same-named helper elsewhere (rans.py has its own
+        `_count`) is not mistaken for a metric site."""
+        out: Dict[Tuple[str, str], int] = {}
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                params = [a.arg for a in node.args.args]
+                skip = 1 if params and params[0] in ("self", "cls") \
+                    else 0
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr in METRIC_METHODS and \
+                            sub.args and \
+                            isinstance(sub.args[0], ast.Name) and \
+                            sub.args[0].id in params[skip:]:
+                        out[mod.name, node.name] = \
+                            params.index(sub.args[0].id) - skip
+                        break
+        return out
+
+    def _scan_registries(self) -> None:
+        rule = RULES["contract-registry-drift"]
+        wrappers = self._metric_wrapper_positions()
+        for mod in self.modules.values():
+            is_test = _is_test_path(mod.path)
+            here = _display(mod.path)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else None)
+                if last is None:
+                    continue
+                site = None
+                is_spec = False
+                if last in FAULT_CALLS and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    site = node.args[0].value
+                elif last == "FaultSpec":
+                    is_spec = True
+                    if node.args and isinstance(node.args[0],
+                                                ast.Constant) and \
+                            isinstance(node.args[0].value, str):
+                        site = node.args[0].value
+                for kw in node.keywords:
+                    if kw.arg in ("site", "fault_site") and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        if kw.arg == "fault_site" or is_spec:
+                            site = kw.value.value
+                if site is not None:
+                    where = f"{here}:{node.lineno}"
+                    self.fault_visits.setdefault(site, []).append(where)
+                    if is_spec:
+                        self.chaos_sites.setdefault(site, []).append(
+                            where)
+                    if self.fault_sites is not None and \
+                            site not in self.fault_sites and \
+                            not is_test:
+                        self._registry_findings.append(rule.finding_at(
+                            mod.path, node,
+                            f"fault site '{site}' is not registered in "
+                            f"faults.SITES ({self.fault_source}) — "
+                            f"every injection literal must resolve to "
+                            f"the one site registry"))
+                    continue
+                argpos = 0 if last in METRIC_METHODS \
+                    else wrappers.get((mod.name, last))
+                if argpos is not None and len(node.args) > argpos and \
+                        self.metric_registry is not None:
+                    arg = node.args[argpos]
+                    name, is_prefix = None, False
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str):
+                        name = arg.value
+                    elif isinstance(arg, ast.JoinedStr) and arg.values \
+                            and isinstance(arg.values[0], ast.Constant) \
+                            and isinstance(arg.values[0].value, str):
+                        name, is_prefix = arg.values[0].value, True
+                    if name is None:
+                        continue
+                    hits = _metric_matches(name, self.metric_registry,
+                                           is_prefix)
+                    for h in hits:
+                        self.metric_uses.setdefault(h, []).append(
+                            f"{here}:{node.lineno}")
+                    if not hits and not is_test:
+                        kind = "f-string metric prefix" if is_prefix \
+                            else "metric name"
+                        self._registry_findings.append(rule.finding_at(
+                            mod.path, node,
+                            f"{kind} '{name}' does not resolve to "
+                            f"METRIC_REGISTRY ({self.metric_source}) — "
+                            f"add the name (or its `*` prefix row) to "
+                            f"the one metric-name registry"))
+        # registered-but-never-visited rows: only when the registry
+        # module itself was walked (a partial walk cannot see every
+        # visit site, so disk-fallback registries skip this half)
+        if self.fault_sites is not None and self.fault_site_line:
+            path = self._registry_module_path(self.fault_source)
+            for s in self.fault_sites:
+                if s not in self.fault_visits and path:
+                    self._registry_findings.append(rule.finding_at(
+                        path, _Line(self.fault_site_line),
+                        f"registered fault site '{s}' has no "
+                        f"inject/corrupt/FaultSpec site in the walked "
+                        f"sources — dead registry rows hide coverage "
+                        f"gaps; remove the row or add the injection "
+                        f"point"))
+        if self.metric_registry is not None and self.metric_reg_line:
+            path = self._registry_module_path(self.metric_source)
+            for e in self.metric_registry:
+                if e not in self.metric_uses and path:
+                    self._registry_findings.append(rule.finding_at(
+                        path, _Line(self.metric_reg_line),
+                        f"METRIC_REGISTRY entry '{e}' matches no "
+                        f"metric call site in the walked sources — "
+                        f"remove the dead row or wire the metric"))
+
+    # -- precision-wall scan --------------------------------------------------
+
+    def _low_dtype(self, mod: _Module, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value.lower() in LOW_DTYPE_STRS \
+                else None
+        dn = dotted_name(node)
+        if dn and dn.split(".")[-1] in LOW_DTYPE_ATTRS:
+            canon = _canon(mod, dn)
+            head = canon.split(".")[0]
+            if head in ("jax", "numpy", "jnp", "np") or "." not in dn:
+                return dn.split(".")[-1]
+        return None
+
+    def _cast_site(self, mod: _Module, node: ast.Call
+                   ) -> Optional[Tuple[str, Optional[ast.AST]]]:
+        """(low_dtype, value_expr) when `node` casts to a low dtype."""
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype":
+            dt = None
+            if node.args:
+                dt = self._low_dtype(mod, node.args[0])
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = dt or self._low_dtype(mod, kw.value)
+            if dt:
+                return dt, node.func.value
+            return None
+        dn = dotted_name(node.func)
+        last = dn.split(".")[-1] if dn else None
+        if last in CAST_CALLS:
+            dt = None
+            for kw in node.keywords:
+                if kw.arg in ("dtype", "new_dtype"):
+                    dt = self._low_dtype(mod, kw.value)
+            if last == "convert_element_type" and len(node.args) > 1:
+                dt = dt or self._low_dtype(mod, node.args[1])
+            if dt:
+                return dt, node.args[0] if node.args else None
+        return None
+
+    def _critical_ref(self, node: ast.AST,
+                      local_crit: Dict[str, str]) -> Optional[str]:
+        """Partition name when `node` references an entropy-critical
+        partition (subscript/attribute/.get("..."), or a local bound
+        from one)."""
+        crit = self.entropy_critical
+        while node is not None:
+            if isinstance(node, ast.Name):
+                return local_crit.get(node.id)
+            if isinstance(node, ast.Subscript):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and \
+                        isinstance(sl.value, str) and sl.value in crit:
+                    return sl.value
+                node = node.value
+                continue
+            if isinstance(node, ast.Attribute):
+                if node.attr in crit:
+                    return node.attr
+                node = node.value
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value in crit:
+                return node.args[0].value
+            return None
+        return None
+
+    def _scan_precision(self) -> Iterable[Finding]:
+        if not self.entropy_critical:
+            return
+        rule = RULES["contract-precision-wall"]
+        seen: Set[Tuple] = set()
+        for f in self.funcs.values():
+            mod = self.modules.get(f.module)
+            if mod is None or _is_test_path(f.path):
+                continue
+            local_crit: Dict[str, str] = {}
+            for node in _body_nodes(f.node):
+                if isinstance(node, ast.Assign):
+                    part = self._critical_ref(node.value, local_crit)
+                    if part:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_crit[t.id] = part
+            for node in _body_nodes(f.node):
+                if isinstance(node, ast.Call):
+                    cast = self._cast_site(mod, node)
+                    if cast:
+                        dt, value = cast
+                        part = self._critical_ref(value, local_crit) \
+                            if value is not None else None
+                        if part:
+                            key = (f.path, node.lineno, part)
+                            if key not in seen:
+                                seen.add(key)
+                                yield rule.finding_at(
+                                    f.path, node,
+                                    f"entropy-critical partition "
+                                    f"'{part}' is cast to {dt} in "
+                                    f"{f.qname} — the probclass->rANS "
+                                    f"path is frozen-point-exact fp32 "
+                                    f"at every ladder rung "
+                                    f"({self.precision_source} "
+                                    f"ENTROPY_CRITICAL); only "
+                                    f"cast_params' identity path may "
+                                    f"touch it")
+                elif isinstance(node, ast.Assign):
+                    parts = [p for p in
+                             (self._critical_ref(t, local_crit)
+                              for t in node.targets) if p]
+                    if not parts:
+                        continue
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call):
+                            cast = self._cast_site(mod, sub)
+                            if cast:
+                                key = (f.path, node.lineno, parts[0])
+                                if key in seen:
+                                    continue
+                                seen.add(key)
+                                yield rule.finding_at(
+                                    f.path, node,
+                                    f"a {cast[0]}-cast value is stored "
+                                    f"into entropy-critical partition "
+                                    f"'{parts[0]}' in {f.qname} — the "
+                                    f"fp32 wall "
+                                    f"({self.precision_source}) "
+                                    f"admits no low-precision writes")
+
+    # -- findings -------------------------------------------------------------
+
+    def _describe_effect(self, key: Tuple) -> str:
+        if key[0] == "effect":
+            return f"may touch {key[1]} (`{key[2]}`)"
+        if key[0] == "lock":
+            return f"may acquire ranked lock `{key[1]}`"
+        if key[0] == "global":
+            return f"mutates module global `{key[1]}`"
+        cls = key[1].split(".")[-1]
+        return (f"mutates `self.{key[2]}` ({cls}) outside __init__ "
+                f"without a `# contract: state` declaration")
+
+    def pure_findings(self) -> Iterable[Finding]:
+        rule = RULES["contract-pure-policy"]
+        seen: Set[Tuple] = set()
+        for root in sorted(self.pure_roots):
+            if root not in self.funcs:
+                continue
+            owner = self.pure_roots[root]
+            f = self.funcs[root]
+            for key in sorted(self._te.get(root, {}),
+                              key=lambda k: tuple(map(str, k))):
+                if key[0] == "selfmut" and \
+                        key[2] in self.state_decls.get(key[1], ()):
+                    continue
+                line, via = self._te[root][key]
+                dkey = (f.path, line, key)
+                if dkey in seen:
+                    continue
+                seen.add(dkey)
+                trace = self._trace(self._te, root, key)
+                suffix = f": {' -> '.join(trace)}" if len(trace) > 1 \
+                    else ""
+                yield rule.finding_at(
+                    f.path, _Line(line),
+                    f"`{root}` rides the `# contract: pure` on "
+                    f"`{owner.split('.')[-1]}` but "
+                    f"{self._describe_effect(key)}{suffix} — policy "
+                    f"math must stay a pure function of its inputs "
+                    f"(the scenario-lab replay contract)")
+
+    def raise_findings(self) -> Iterable[Finding]:
+        rule = RULES["contract-typed-raise"]
+        seen: Set[Tuple] = set()
+        for root in sorted(self.request_roots):
+            for key in sorted(self._tr.get(root, {}),
+                              key=lambda k: tuple(map(str, k))):
+                _, name, path, line = key
+                dkey = (path, line, name)
+                if dkey in seen:
+                    continue
+                seen.add(dkey)
+                yield rule.finding_at(
+                    path, _Line(line),
+                    f"`raise {name}` is reachable from serve request "
+                    f"entry `{root}` (`# contract: request-path`) — "
+                    f"raise a registered typed error instead so "
+                    f"clients and the batcher can map the failure "
+                    f"(bare builtins break the zero-hung-futures "
+                    f"typed-error contract)")
+
+    def findings(self) -> List[Finding]:
+        out = list(self._registry_findings)
+        out.extend(self._precision_findings)
+        out.extend(self.pure_findings())
+        out.extend(self.raise_findings())
+        return sorted(set(out))
+
+    # -- artifact -------------------------------------------------------------
+
+    def build_contracts(self) -> dict:
+        """The contract surface the code actually implements.
+        Deterministic (sorted, no timestamps) so the artifact can be
+        committed and drift-pinned."""
+        roster = []
+        for q in sorted(self.pure_entities):
+            e = dict(self.pure_entities[q])
+            e["state"] = self.state_decls.get(q, [])
+            roster.append(e)
+        registered = list(self.fault_sites or [])
+        chaos = sorted(s for s in self.chaos_sites
+                       if s in (self.fault_sites or ()))
+        return {
+            "pure_policy": {
+                "roster": roster,
+                "state_declared": {q: v for q, v in
+                                   sorted(self.state_decls.items())
+                                   if q in self.pure_entities},
+            },
+            "request_roots": sorted(self.request_entities),
+            "precision_wall": {
+                "entropy_critical": sorted(self.entropy_critical),
+                "distortion_side": list(self.distortion_side),
+                "source": self.precision_source,
+            },
+            "typed_errors": self.error_registry,
+            "fault_sites": {
+                "registered": registered,
+                "source": self.fault_source,
+                "visits": {s: sorted(v) for s, v in
+                           sorted(self.fault_visits.items())
+                           if s in (self.fault_sites or ())},
+                "chaos_covered": chaos,
+                "uncovered_by_chaos": sorted(
+                    s for s in registered if s not in chaos),
+            },
+            "metrics": {
+                "registry": list(self.metric_registry or []),
+                "source": self.metric_source,
+            },
+            "functions_analyzed": len(self.funcs),
+            "modules_analyzed": len(self.modules),
+        }
+
+
+# -- rule registration --------------------------------------------------------
+
+class PurePolicy(RepoRule):
+    name = "contract-pure-policy"
+    description = ("a `# contract: pure` function/class reaches "
+                   "time/random/IO/env, device/jit entry points, "
+                   "ranked locks, or undeclared mutation on some call "
+                   "path — policy math must stay replayable")
+
+
+class PrecisionWall(RepoRule):
+    name = "contract-precision-wall"
+    description = ("a bf16/int8/fp16 cast draws from or stores into an "
+                   "entropy-critical partition (coding/precision.py "
+                   "ENTROPY_CRITICAL) outside cast_params' identity "
+                   "path")
+
+
+class TypedRaise(RepoRule):
+    name = "contract-typed-raise"
+    description = ("a bare builtin exception raise is reachable from a "
+                   "`# contract: request-path` serve entry — every "
+                   "request-path failure must be a registered typed "
+                   "error")
+
+
+class RegistryDrift(RepoRule):
+    name = "contract-registry-drift"
+    description = ("a fault-site or metric-name literal does not "
+                   "resolve to its central registry (faults.SITES / "
+                   "metrics.METRIC_REGISTRY), or a registered row is "
+                   "never visited")
+
+
+CONTRACTS_RULES = [PurePolicy(), PrecisionWall(), TypedRaise(),
+                   RegistryDrift()]
+CONTRACTS_RULE_NAMES = tuple(r.name for r in CONTRACTS_RULES)
+RULES = {r.name: r for r in CONTRACTS_RULES}
+
+
+# -- entry points -------------------------------------------------------------
+
+def analyze(sources: Sequence[Tuple[str, str]], config=None
+            ) -> ContractAnalysis:
+    from tools.jaxlint.config import LintConfig
+    return ContractAnalysis(sources, config or LintConfig())
+
+
+def analyze_paths(paths: Sequence[str], config=None) -> ContractAnalysis:
+    from tools.jaxlint.config import LintConfig
+    config = config or LintConfig()
+    sources = []
+    for path in config.iter_files(paths):
+        with open(path, encoding="utf-8") as f:
+            sources.append((path, f.read()))
+    return analyze(sources, config)
+
+
+def lint_repo(sources: Sequence[Tuple[str, str]], config=None
+              ) -> Tuple[List[Finding], List[Finding]]:
+    """The whole-repo pass: (active, suppressed) contracts findings,
+    restricted to the rules enabled in `config` and filtered through
+    each anchor file's inline suppressions."""
+    from tools.jaxlint.config import LintConfig
+    config = config or LintConfig()
+    enabled = {n for n in config.enabled_rules()
+               if n in CONTRACTS_RULE_NAMES}
+    if not enabled:
+        return [], []
+    analysis = analyze(sources, config)
+    raw = [f for f in analysis.findings() if f.rule in enabled]
+    return filter_suppressed(raw, sources)
+
+
+def emit_artifacts(analysis: ContractAnalysis, prefix: str) -> Tuple[str]:
+    """Write `<prefix>.json`; returns the path (1-tuple, mirroring
+    lockgraph.emit_artifacts)."""
+    contracts = analysis.build_contracts()
+    json_path = prefix + ".json"
+    os.makedirs(os.path.dirname(os.path.abspath(json_path)),
+                exist_ok=True)
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(contracts, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return (json_path,)

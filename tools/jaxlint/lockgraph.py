@@ -19,10 +19,12 @@ The pass (one per lint invocation, over every walked file together):
    and ad-hoc `rank=` constructions outside tests are
    `lockgraph-unresolved-lock` findings.
 
-2. **Call graph + per-function summaries.** Module-qualified defs,
-   `self.method` resolved through the enclosing class (and its repo
-   bases), attribute receivers resolved through `self.x = Class(...)`
-   type seeds, locals through `v = Class(...)` / `v = self.x`.
+2. **Call graph + per-function summaries** — the shared machinery in
+   tools/jaxlint/callgraph.py (PR 20 extracted it so the contracts
+   family could reuse it): module-qualified defs, `self.method`
+   resolved through the enclosing class (and its repo bases),
+   attribute receivers resolved through `self.x = Class(...)` type
+   seeds, locals through `v = Class(...)` / `v = self.x`.
    Per function: locks acquired via `with <lock>:` (the repo's only
    acquire idiom — verified by grep: no bare `.acquire()` on ranked
    locks outside the wrapper), the lock set HELD at every call site,
@@ -75,140 +77,18 @@ from __future__ import annotations
 import ast
 import json
 import os
-import re
-from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from tools.jaxlint.framework import (Finding, Rule, Suppressions,
-                                     _statement_start_lines, dotted_name)
-from tools.jaxlint.concurrency import (BLOCKING_DOTTED, BLOCKING_METHODS,
-                                       GUARDED_RE, QUEUEISH_RE)
+from tools.jaxlint.framework import Finding
+from tools.jaxlint.callgraph import (  # noqa: F401  (re-exported names)
+    CallGraph, MAX_PATH_HOPS, PIPE_METHODS, PIPEISH_RE, RANKED_FACTORIES,
+    ROOT_PACKAGES, RepoRule, _Class, _Func, _FuncScanner, _Line, _Module,
+    _collect_module, _display, _held_names, _is_test_path, _module_name,
+    _norm_raw, _ranked_construction, climb_for, filter_suppressed)
 
-RANKED_FACTORIES = frozenset({"RankedLock", "RankedCondition"})
-
-#: receivers whose `.send()`/`.recv()` is a (potentially indefinitely)
-#: blocking pipe operation — the replica/entropy-pool transport idiom
-PIPEISH_RE = re.compile(r"(conn|pipe)s?$", re.IGNORECASE)
-PIPE_METHODS = frozenset({"send", "recv"})
-
-#: call-path hops rendered before truncation (cycles are cut anyway)
-MAX_PATH_HOPS = 12
-
-ROOT_PACKAGES = ("dsin_tpu", "tools")
-
-
-def _is_test_path(path: str) -> bool:
-    # stem-only on purpose: lint fixtures live under tests/fixtures/
-    # but are analyzed as production code
-    stem = os.path.splitext(os.path.basename(path))[0]
-    return stem.startswith("test_") or stem == "conftest"
-
-
-def _norm_raw(expr: str) -> str:
-    """`self._mu` and a `# guarded-by: _mu` annotation name the same
-    instance lock — compare them with the receiver stripped."""
-    return expr[5:] if expr.startswith("self.") else expr
-
-
-def _display(path: str) -> str:
-    """Repo-relative display path for messages/artifacts."""
-    parts = path.replace(os.sep, "/").split("/")
-    for root in ROOT_PACKAGES:
-        if root in parts:
-            return "/".join(parts[parts.index(root):])
-    return parts[-1]
-
-
-def _module_name(path: str) -> str:
-    parts = _display(path).split("/")
-    parts[-1] = os.path.splitext(parts[-1])[0]
-    if parts[-1] == "__init__":
-        parts = parts[:-1] or [parts[0]]
-    return ".".join(parts)
-
-
-# -- held-lock entries --------------------------------------------------------
-# ("L", lockname)            a resolved ranked lock
-# ("R", class_qname, expr)   an unresolved lock-ish expression, matched
-#                            raw (and only within the same class)
-
-def _held_names(held: Tuple) -> List[str]:
-    return [h[1] for h in held if h[0] == "L"]
-
-
-# -- per-module collection ----------------------------------------------------
-
-@dataclass
-class _Class:
-    qname: str
-    module: str
-    name: str
-    node: ast.ClassDef
-    bases: List[str] = field(default_factory=list)
-    methods: Dict[str, ast.AST] = field(default_factory=dict)
-    lock_attrs: Dict[str, str] = field(default_factory=dict)
-    attr_seeds: List[Tuple[str, str]] = field(default_factory=list)
-    attr_types: Dict[str, str] = field(default_factory=dict)
-    guarded: Dict[str, str] = field(default_factory=dict)
-
-
-@dataclass
-class _Module:
-    path: str
-    name: str
-    stem: str
-    tree: ast.Module
-    source: str
-    imports: Dict[str, str] = field(default_factory=dict)
-    funcs: Dict[str, ast.AST] = field(default_factory=dict)
-    classes: Dict[str, _Class] = field(default_factory=dict)
-    locks: Dict[str, str] = field(default_factory=dict)
-    var_seeds: List[Tuple[str, str]] = field(default_factory=list)
-    var_types: Dict[str, str] = field(default_factory=dict)
-
-
-@dataclass
-class _Func:
-    qname: str
-    module: str
-    cls: Optional[str]           # class qname, or None
-    name: str
-    path: str
-    line: int
-    node: ast.AST
-    # (lockname, line, held)
-    acquires: List[Tuple[str, int, Tuple]] = field(default_factory=list)
-    # (targets, line, held)
-    calls: List[Tuple[Tuple[str, ...], int, Tuple]] = field(
-        default_factory=list)
-    # (desc, line)
-    blocking: List[Tuple[str, int]] = field(default_factory=list)
-    # (desc, line, held) — pipe send/recv lexically under a lock;
-    # reported here (not left to threadlint) because the per-file
-    # blocking rule predates the pipe transport and does not model it
-    pipe_lexical: List[Tuple[str, int, Tuple]] = field(
-        default_factory=list)
-    # (field, guard_key, line) — touches WITHOUT the guard held
-    touches: List[Tuple[str, Tuple, int]] = field(default_factory=list)
-
-
-def _ranked_construction(node: ast.Call) -> Optional[Tuple]:
-    """(lockname|None, explicit_rank: bool) for RankedLock/Condition
-    construction calls, else None."""
-    dn = dotted_name(node.func)
-    if not dn or dn.split(".")[-1] not in RANKED_FACTORIES:
-        return None
-    name: Optional[str] = None
-    if node.args and isinstance(node.args[0], ast.Constant) and \
-            isinstance(node.args[0].value, str):
-        name = node.args[0].value
-    for kw in node.keywords:
-        if kw.arg == "name" and isinstance(kw.value, ast.Constant) and \
-                isinstance(kw.value.value, str):
-            name = kw.value.value
-    explicit_rank = len(node.args) > 1 or any(
-        kw.arg == "rank" for kw in node.keywords)
-    return name, explicit_rank
+# kept under the old private name: lockgraph grew the pattern before
+# the callgraph extraction and downstream code imports it from here
+_RepoRule = RepoRule
 
 
 def _parse_hierarchy(tree: ast.Module) -> Optional[Dict[str, int]]:
@@ -239,131 +119,17 @@ def _parse_hierarchy(tree: ast.Module) -> Optional[Dict[str, int]]:
     return None
 
 
-def _collect_module(path: str, source: str, tree: ast.Module) -> _Module:
-    mod = _Module(path=path, name=_module_name(path),
-                  stem=os.path.splitext(os.path.basename(path))[0],
-                  tree=tree, source=source)
-    pkg = mod.name.rsplit(".", 1)[0] if "." in mod.name else ""
-    for node in tree.body:
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.asname:
-                    mod.imports[alias.asname] = alias.name
-                else:
-                    head = alias.name.split(".")[0]
-                    mod.imports[head] = head
-        elif isinstance(node, ast.ImportFrom):
-            base = node.module or ""
-            if node.level:
-                up = pkg.split(".") if pkg else []
-                up = up[:len(up) - (node.level - 1)] if node.level > 1 \
-                    else up
-                base = ".".join(up + ([node.module] if node.module
-                                      else []))
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                mod.imports[alias.asname or alias.name] = \
-                    f"{base}.{alias.name}" if base else alias.name
-
-    ann_by_line: Dict[int, str] = {}
-    for i, text in enumerate(source.splitlines(), start=1):
-        m = GUARDED_RE.search(text)
-        if m:
-            ann_by_line[i] = m.group(1).strip()
-
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            mod.funcs[node.name] = node
-        elif isinstance(node, ast.ClassDef):
-            cls = _Class(qname=f"{mod.name}.{node.name}",
-                         module=mod.name, name=node.name, node=node)
-            cls.bases = [b for b in (dotted_name(x) for x in node.bases)
-                         if b]
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    cls.methods.setdefault(item.name, item)
-            for meth in cls.methods.values():
-                for sub in ast.walk(meth):
-                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
-                        continue
-                    targets = (sub.targets if isinstance(sub, ast.Assign)
-                               else [sub.target])
-                    self_attrs = [
-                        t.attr for t in targets
-                        if isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"]
-                    if not self_attrs:
-                        continue
-                    value = sub.value
-                    if isinstance(value, ast.Call):
-                        rc = _ranked_construction(value)
-                        if rc and rc[0]:
-                            for a in self_attrs:
-                                cls.lock_attrs.setdefault(a, rc[0])
-                        elif rc is None:
-                            fn = dotted_name(value.func)
-                            if fn:
-                                for a in self_attrs:
-                                    cls.attr_seeds.append((a, fn))
-                    end = getattr(sub, "end_lineno", sub.lineno) \
-                        or sub.lineno
-                    guard = next((ann_by_line[ln]
-                                  for ln in range(sub.lineno, end + 1)
-                                  if ln in ann_by_line), None)
-                    if guard is not None:
-                        for a in self_attrs:
-                            cls.guarded.setdefault(a, guard)
-            mod.classes[node.name] = cls
-        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            names = [t.id for t in targets if isinstance(t, ast.Name)]
-            value = node.value
-            if names and isinstance(value, ast.Call):
-                rc = _ranked_construction(value)
-                if rc and rc[0]:
-                    for n in names:
-                        mod.locks.setdefault(n, rc[0])
-                elif rc is None:
-                    fn = dotted_name(value.func)
-                    if fn:
-                        for n in names:
-                            mod.var_seeds.append((n, fn))
-    return mod
-
-
 # -- whole-repo analysis ------------------------------------------------------
 
-class Analysis:
+class Analysis(CallGraph):
     """The whole-repo lock/call model one lint invocation builds."""
 
     def __init__(self, sources: Sequence[Tuple[str, str]], config):
-        self.config = config
-        self.modules: Dict[str, _Module] = {}
-        self.parse_failures: List[str] = []
-        for path, source in sources:
-            try:
-                tree = ast.parse(source, filename=path)
-            except SyntaxError:
-                self.parse_failures.append(path)
-                continue
-            mod = _collect_module(path, source, tree)
-            self.modules[mod.name] = mod
-
+        super().__init__(sources, config)
         self.hierarchy = self._find_hierarchy()
-        self.classes: Dict[str, _Class] = {}
-        for mod in self.modules.values():
-            for cls in mod.classes.values():
-                self.classes[cls.qname] = cls
-        self._resolve_types()
         self.construction_findings: List[Finding] = []
         self.constructed: Dict[str, List[str]] = {}
         self._scan_constructions()
-        self.funcs: Dict[str, _Func] = {}
-        self._scan_functions()
         self._ta = self._fix_acquires()
         self._tb = self._fix_blocking()
         self._tg = self._fix_guarded()
@@ -382,93 +148,9 @@ class Analysis:
             return fallback
         # partial walks (e.g. linting serve/ alone) still need the repo
         # hierarchy: climb from any walked file to the wrapper module
-        for mod in self.modules.values():
-            d = os.path.dirname(os.path.abspath(mod.path))
-            for _ in range(8):
-                cand = os.path.join(d, "dsin_tpu", "utils", "locks.py")
-                if os.path.isfile(cand):
-                    try:
-                        with open(cand, encoding="utf-8") as f:
-                            h = _parse_hierarchy(ast.parse(f.read()))
-                        if h:
-                            return h
-                    except (OSError, SyntaxError):
-                        pass
-                parent = os.path.dirname(d)
-                if parent == d:
-                    break
-                d = parent
-            break
-        return {}
-
-    # -- type seeds -----------------------------------------------------------
-
-    def _resolve_symbol(self, mod: _Module, dotted: str) -> Optional[str]:
-        """Resolve a dotted name used in `mod` to a global qname."""
-        parts = dotted.split(".")
-        head = parts[0]
-        if head in mod.classes:
-            base = mod.classes[head].qname
-        elif head in mod.funcs:
-            base = f"{mod.name}.{head}"
-        elif head in mod.imports:
-            base = mod.imports[head]
-        else:
-            return None
-        return ".".join([base] + parts[1:])
-
-    def _class_for_call(self, mod: _Module, fn_dotted: str
-                        ) -> Optional[str]:
-        q = self._resolve_symbol(mod, fn_dotted)
-        return q if q in self.classes else None
-
-    def _resolve_types(self) -> None:
-        for mod in self.modules.values():
-            for var, fn in mod.var_seeds:
-                q = self._class_for_call(mod, fn)
-                if q:
-                    mod.var_types.setdefault(var, q)
-            for cls in mod.classes.values():
-                for attr, fn in cls.attr_seeds:
-                    q = self._class_for_call(mod, fn)
-                    if q:
-                        cls.attr_types.setdefault(attr, q)
-
-    def _mro(self, cls_qname: str) -> List[_Class]:
-        out, queue, seen = [], [cls_qname], set()
-        while queue:
-            q = queue.pop(0)
-            if q in seen or q not in self.classes:
-                continue
-            seen.add(q)
-            cls = self.classes[q]
-            out.append(cls)
-            mod = self.modules.get(cls.module)
-            for b in cls.bases:
-                bq = self._resolve_symbol(mod, b) if mod else None
-                if bq:
-                    queue.append(bq)
-        return out
-
-    def _class_lock_attr(self, cls_qname: str, attr: str
-                         ) -> Optional[str]:
-        for cls in self._mro(cls_qname):
-            if attr in cls.lock_attrs:
-                return cls.lock_attrs[attr]
-        return None
-
-    def _class_attr_type(self, cls_qname: str, attr: str
-                         ) -> Optional[str]:
-        for cls in self._mro(cls_qname):
-            if attr in cls.attr_types:
-                return cls.attr_types[attr]
-        return None
-
-    def _class_method(self, cls_qname: str, name: str) -> Optional[str]:
-        for cls in self._mro(cls_qname):
-            if name in cls.methods:
-                return f"{cls.qname}.{name}"
-        return None
+        h, _ = climb_for(self.modules, "dsin_tpu/utils/locks.py",
+                         _parse_hierarchy)
+        return h or {}
 
     # -- construction sites ---------------------------------------------------
 
@@ -512,55 +194,7 @@ class Analysis:
                         f"between its outermost caller and everything "
                         f"its critical section touches)"))
 
-    # -- per-function scan ----------------------------------------------------
-
-    def _scan_functions(self) -> None:
-        for mod in self.modules.values():
-            for name, fn in mod.funcs.items():
-                self._scan_one(mod, None, f"{mod.name}.{name}", fn)
-            for cls in mod.classes.values():
-                for mname, meth in cls.methods.items():
-                    self._scan_one(mod, cls,
-                                   f"{cls.qname}.{mname}", meth)
-
-    def _scan_one(self, mod: _Module, cls: Optional[_Class],
-                  qname: str, fn: ast.AST) -> None:
-        info = _Func(qname=qname, module=mod.name,
-                     cls=cls.qname if cls else None, name=fn.name,
-                     path=mod.path, line=fn.lineno, node=fn)
-        self.funcs[qname] = info
-        _FuncScanner(self, mod, cls, info).run()
-        # nested defs: their own scope, empty held (they may run on
-        # another thread after the enclosing `with` exited)
-        for sub in ast.walk(fn):
-            if sub is not fn and isinstance(
-                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                sub_q = f"{qname}.{sub.name}"
-                if sub_q not in self.funcs:
-                    sub_info = _Func(
-                        qname=sub_q, module=mod.name,
-                        cls=cls.qname if cls else None, name=sub.name,
-                        path=mod.path, line=sub.lineno, node=sub)
-                    self.funcs[sub_q] = sub_info
-                    _FuncScanner(self, mod, cls, sub_info).run()
-
     # -- fixpoints ------------------------------------------------------------
-
-    def _fix(self, seed):
-        """Generic reachability fixpoint: table[f][key] = (line, via)."""
-        table = {q: dict(seed(f)) for q, f in self.funcs.items()}
-        changed = True
-        while changed:
-            changed = False
-            for q, f in self.funcs.items():
-                row = table[q]
-                for targets, line, _held in f.calls:
-                    for t in targets:
-                        for key in table.get(t, ()):
-                            if key not in row:
-                                row[key] = (line, t)
-                                changed = True
-        return table
 
     def _fix_acquires(self):
         return self._fix(lambda f: {lock: (line, None)
@@ -609,18 +243,6 @@ class Analysis:
         return any(h[0] == "R" and h[2] == guard[2] for h in held)
 
     # -- findings -------------------------------------------------------------
-
-    def _trace(self, table, start: str, key) -> List[str]:
-        hops, q, seen = [], start, set()
-        while q is not None and len(hops) < MAX_PATH_HOPS:
-            f = self.funcs[q]
-            line, via = table[q][key]
-            hops.append(f"{f.qname} ({_display(f.path)}:{line})")
-            if via is None or via in seen:
-                break
-            seen.add(via)
-            q = via
-        return hops
 
     def inversion_findings(self) -> Iterable[Finding]:
         rule = RULES["lockgraph-rank-inversion"]
@@ -809,285 +431,30 @@ class Analysis:
         }
 
 
-class _Line:
-    """Minimal node stand-in so Rule.finding anchors at a line."""
-
-    def __init__(self, lineno: int, col_offset: int = 0):
-        self.lineno = lineno
-        self.col_offset = col_offset
-
-
-class _FuncScanner:
-    """One function's body walk: held-lock tracking, lock resolution,
-    call/blocking/guarded-touch recording."""
-
-    def __init__(self, analysis: Analysis, mod: _Module,
-                 cls: Optional[_Class], info: _Func):
-        self.a = analysis
-        self.mod = mod
-        self.cls = cls
-        self.info = info
-        self.local_types: Dict[str, str] = {}
-        self.local_defs: Set[str] = set()
-        fn = info.node
-        for stmt in ast.walk(fn):
-            if stmt is fn:
-                continue
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.local_defs.add(stmt.name)
-        self._seed_local_types(fn)
-        self.guarded = {}
-        if cls is not None:
-            for c in analysis._mro(cls.qname):
-                for fld, guard in c.guarded.items():
-                    self.guarded.setdefault(fld, guard)
-
-    def _seed_local_types(self, fn: ast.AST) -> None:
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Assign):
-                continue
-            names = [t.id for t in node.targets
-                     if isinstance(t, ast.Name)]
-            if not names:
-                continue
-            value = node.value
-            q = None
-            if isinstance(value, ast.Call):
-                fnname = dotted_name(value.func)
-                if fnname:
-                    q = self.a._class_for_call(self.mod, fnname)
-            elif isinstance(value, ast.Attribute):
-                dn = dotted_name(value)
-                if dn:
-                    q = self._type_of(dn)
-            if q:
-                for n in names:
-                    self.local_types.setdefault(n, q)
-
-    # -- type / lock resolution ----------------------------------------------
-
-    def _type_of(self, dotted: str) -> Optional[str]:
-        """Class qname of the object a dotted expr evaluates to."""
-        parts = dotted.split(".")
-        head, rest = parts[0], parts[1:]
-        if head == "self" and self.cls is not None:
-            cur = self.cls.qname
-        elif head in self.local_types:
-            cur = self.local_types[head]
-        elif head in self.mod.var_types:
-            cur = self.mod.var_types[head]
-        else:
-            return None
-        for attr in rest:
-            nxt = self.a._class_attr_type(cur, attr)
-            if nxt is None:
-                return None
-            cur = nxt
-        return cur
-
-    def _resolve_lock(self, expr: ast.AST) -> Optional[Tuple]:
-        """held-entry for a with-item context expr, or None."""
-        dn = dotted_name(expr)
-        if dn is None:
-            return None
-        parts = dn.split(".")
-        if len(parts) == 1:
-            if dn in self.mod.locks:
-                return ("L", self.mod.locks[dn])
-        else:
-            recv, attr = ".".join(parts[:-1]), parts[-1]
-            recv_type = self._type_of(recv)
-            if recv_type is not None:
-                name = self.a._class_lock_attr(recv_type, attr)
-                if name is not None:
-                    return ("L", name)
-            if recv in self.mod.imports:
-                target = self.mod.imports[recv]
-                tmod = self.a.modules.get(target)
-                if tmod and attr in tmod.locks:
-                    return ("L", tmod.locks[attr])
-            # unique ranked-attr fallback: exactly one class in the
-            # repo constructs a ranked lock under this attribute name
-            owners = {c.lock_attrs[attr] for c in
-                      self.a.classes.values() if attr in c.lock_attrs}
-            if len(owners) == 1:
-                return ("L", next(iter(owners)))
-        if re.search(r"(lock|cond|mutex)", parts[-1], re.IGNORECASE):
-            return ("R", self.cls.qname if self.cls else None,
-                    _norm_raw(dn))
-        return None
-
-    def _resolve_call(self, func: ast.AST) -> Tuple[str, ...]:
-        dn = dotted_name(func)
-        if dn is None:
-            return ()
-        parts = dn.split(".")
-        if len(parts) == 1:
-            name = parts[0]
-            if name in self.local_defs:
-                return (f"{self.info.qname}.{name}",)
-            if name in self.mod.funcs:
-                return (f"{self.mod.name}.{name}",)
-            q = self.a._resolve_symbol(self.mod, name)
-            if q in self.a.classes:
-                init = self.a._class_method(q, "__init__")
-                return (init,) if init else ()
-            if q in self.a.funcs:
-                return (q,)
-            return ()
-        recv, meth = ".".join(parts[:-1]), parts[-1]
-        recv_type = self._type_of(recv)
-        if recv_type is not None:
-            m = self.a._class_method(recv_type, meth)
-            return (m,) if m else ()
-        q = self.a._resolve_symbol(self.mod, dn)
-        if q is not None:
-            if q in self.a.classes:
-                init = self.a._class_method(q, "__init__")
-                return (init,) if init else ()
-            if q in self.a.funcs:
-                return (q,)
-        return ()
-
-    # -- body walk ------------------------------------------------------------
-
-    def run(self) -> None:
-        for stmt in self.info.node.body:
-            self._visit(stmt, ())
-
-    def _visit(self, node: ast.AST, held: Tuple) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda, ast.ClassDef)):
-            return   # separate scope; scanned with an empty held set
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                self._visit(item.context_expr, held)
-            inner = list(held)
-            for item in node.items:
-                entry = self._resolve_lock(item.context_expr)
-                if entry is not None:
-                    if entry[0] == "L":
-                        self.info.acquires.append(
-                            (entry[1], node.lineno, tuple(inner)))
-                    inner.append(entry)
-            for stmt in node.body:
-                self._visit(stmt, tuple(inner))
-            return
-        if isinstance(node, ast.Call):
-            targets = self._resolve_call(node.func)
-            if targets and _ranked_construction(node) is None:
-                self.info.calls.append((targets, node.lineno, held))
-            desc = self._blocking_desc(node)
-            if desc is not None:
-                self.info.blocking.append((desc, node.lineno))
-                if held and isinstance(node.func, ast.Attribute) and \
-                        node.func.attr in PIPE_METHODS:
-                    self.info.pipe_lexical.append(
-                        (desc, node.lineno, held))
-        self._note_guarded_touch(node, held)
-        for child in ast.iter_child_nodes(node):
-            self._visit(child, held)
-
-    def _note_guarded_touch(self, node: ast.AST, held: Tuple) -> None:
-        if not self.guarded or not isinstance(node, ast.Attribute):
-            return
-        if not (isinstance(node.value, ast.Name)
-                and node.value.id == "self"):
-            return
-        fld = node.attr
-        guard_expr = self.guarded.get(fld)
-        if guard_expr is None:
-            return
-        entry = self._resolve_lock_expr_str(guard_expr)
-        if entry[0] == "L":
-            if entry[1] in _held_names(held):
-                return
-        else:
-            if any(h[0] == "R" and h[2] == entry[2] for h in held):
-                return
-        self.info.touches.append((f"self.{fld}", entry, node.lineno))
-
-    def _resolve_lock_expr_str(self, expr: str) -> Tuple:
-        """Resolve a `# guarded-by:` annotation text to a held entry.
-        Bare names (`_lock`) resolve as instance attrs of the enclosing
-        class first, then module-level locks."""
-        expr = _norm_raw(expr)
-        if "." not in expr:
-            if self.cls is not None:
-                name = self.a._class_lock_attr(self.cls.qname, expr)
-                if name is not None:
-                    return ("L", name)
-            if expr in self.mod.locks:
-                return ("L", self.mod.locks[expr])
-            return ("R", self.cls.qname if self.cls else None, expr)
-        try:
-            parsed = ast.parse(expr, mode="eval").body
-        except SyntaxError:
-            return ("R", self.cls.qname if self.cls else None, expr)
-        entry = self._resolve_lock(parsed)
-        if entry is not None and entry[0] == "L":
-            return entry
-        return ("R", self.cls.qname if self.cls else None,
-                _norm_raw(expr))
-
-    @staticmethod
-    def _blocking_desc(node: ast.Call) -> Optional[str]:
-        dn = dotted_name(node.func)
-        if dn in BLOCKING_DOTTED:
-            return f"`{dn}`"
-        if isinstance(node.func, ast.Attribute) and \
-                not isinstance(node.func.value, ast.Constant):
-            attr = node.func.attr
-            recv = dotted_name(node.func.value)
-            last = recv.split(".")[-1] if recv else ""
-            if attr in BLOCKING_METHODS:
-                return f"`.{attr}()`"
-            if attr == "get" and last and QUEUEISH_RE.search(last):
-                return f"`{last}.get()`"
-            if attr in PIPE_METHODS and last and \
-                    PIPEISH_RE.search(last):
-                return f"`{last}.{attr}()`"
-        return None
-
-
 # -- rule registration --------------------------------------------------------
 
-class _RepoRule(Rule):
-    """Whole-repo rule: per-file check is a no-op (the real pass runs
-    once per lint invocation in lint_repo); registering keeps the rule
-    selectable/suppressible/documented like any other."""
-
-    def check(self, ctx) -> Iterable[Finding]:
-        return ()
-
-    def finding_at(self, path: str, node, message: str) -> Finding:
-        return Finding(path=path, line=getattr(node, "lineno", 1),
-                       col=getattr(node, "col_offset", 0) + 1,
-                       rule=self.name, message=message)
-
-
-class RankInversionPath(_RepoRule):
+class RankInversionPath(RepoRule):
     name = "lockgraph-rank-inversion"
     description = ("a call path exists on which a lock of rank <= a "
                    "held rank may be acquired — the static, "
                    "whole-program twin of LockOrderViolation")
 
 
-class BlockingReachableUnderLock(_RepoRule):
+class BlockingReachableUnderLock(RepoRule):
     name = "lockgraph-blocking-reachable-under-lock"
     description = ("a blocking call (.result/.join/pipe send/device "
                    "transfer/sleep) is reachable through the call "
                    "graph while a ranked lock is held")
 
 
-class GuardedFieldUnlockedPath(_RepoRule):
+class GuardedFieldUnlockedPath(RepoRule):
     name = "lockgraph-guarded-field-unlocked-path"
     description = ("a `# guarded-by:` field is touched in a *_locked "
                    "function reachable from a caller that does not "
                    "hold the guard")
 
 
-class UnresolvedLock(_RepoRule):
+class UnresolvedLock(RepoRule):
     name = "lockgraph-unresolved-lock"
     description = ("a RankedLock/RankedCondition construction the "
                    "static hierarchy cannot resolve: non-literal "
@@ -1131,23 +498,7 @@ def lint_repo(sources: Sequence[Tuple[str, str]], config=None
         return [], []
     analysis = analyze(sources, config)
     raw = [f for f in analysis.findings() if f.rule in enabled]
-    by_path: Dict[str, List[Finding]] = {}
-    for f in raw:
-        by_path.setdefault(f.path, []).append(f)
-    src_by_path = dict(sources)
-    active: List[Finding] = []
-    suppressed: List[Finding] = []
-    for path, findings in by_path.items():
-        source = src_by_path.get(path, "")
-        sup = Suppressions(source)
-        try:
-            stmt_start = _statement_start_lines(ast.parse(source))
-        except SyntaxError:
-            stmt_start = {}
-        for f in findings:
-            (suppressed if sup.covers(f, stmt_start)
-             else active).append(f)
-    return sorted(active), sorted(suppressed)
+    return filter_suppressed(raw, sources)
 
 
 def render_dot(graph: dict) -> str:

@@ -20,12 +20,16 @@ def format_text(findings: List[Finding], suppressed: List[Finding],
 def format_json(findings: List[Finding], suppressed: List[Finding],
                 files_count: int) -> str:
     """The machine-readable contract CI consumes. Each finding is
-    exactly {rule, path, line, message, suppressed} — suppressed
-    findings are included (flagged true) so dashboards can audit what
-    inline disables are absorbing, but only active ones drive the exit
-    code."""
+    exactly {rule, family, path, line, message, suppressed} — family
+    is core/concurrency/lockgraph/contracts so the tpu_session stages
+    can partition failures; suppressed findings are included (flagged
+    true) so dashboards can audit what inline disables are absorbing,
+    but only active ones drive the exit code."""
+    from tools.jaxlint.rules import rule_family
+
     def row(f: Finding, is_suppressed: bool) -> dict:
-        return {"rule": f.rule, "path": f.path, "line": f.line,
+        return {"rule": f.rule, "family": rule_family(f.rule),
+                "path": f.path, "line": f.line,
                 "message": f.message, "suppressed": is_suppressed}
     rows = ([row(f, False) for f in sorted(findings)]
             + [row(f, True) for f in sorted(suppressed)])

@@ -388,10 +388,27 @@ from tools.jaxlint.concurrency import (CONCURRENCY_RULES,
                                        CONCURRENCY_RULE_NAMES)
 from tools.jaxlint.lockgraph import (LOCKGRAPH_RULES,
                                      LOCKGRAPH_RULE_NAMES)
+from tools.jaxlint.contracts import (CONTRACTS_RULES,
+                                     CONTRACTS_RULE_NAMES)
 
 ALL_RULES = [HostCallInJit(), TracedPythonBranch(), PrngKeyReuse(),
              HostSyncInLoop(), NonStaticJitCapture(),
              ShardMapMissingSpecs(), BareExperimentalImport(),
-             PytreeArgMutation()] + CONCURRENCY_RULES + LOCKGRAPH_RULES
+             PytreeArgMutation()] + CONCURRENCY_RULES + LOCKGRAPH_RULES \
+            + CONTRACTS_RULES
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+
+def rule_family(name: str) -> str:
+    """The family a rule name belongs to — the key tpu_session stages
+    partition JSON findings on: concurrency / lockgraph / contracts,
+    else "core" (the per-file JAX rules and the suppression
+    meta-findings)."""
+    if name in CONCURRENCY_RULE_NAMES:
+        return "concurrency"
+    if name in LOCKGRAPH_RULE_NAMES:
+        return "lockgraph"
+    if name in CONTRACTS_RULE_NAMES:
+        return "contracts"
+    return "core"

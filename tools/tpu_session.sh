@@ -5,51 +5,44 @@
 #
 #   sh tools/tpu_session.sh [stage ...]     # default: all stages
 #
-# Stages: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep
+# Stages: lint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep
 # (the reference-geometry trained run is rd_sweep's final point)
 # NOTE: tools/relay_watch.sh is the authoritative round-4 queue (per-stage
 # state, timeouts, resume); this script remains the manual one-shot runner.
 set -x
 cd "$(dirname "$0")/.."
 REPO=$(pwd)
-STAGES=${*:-"lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep"}
+STAGES=${*:-"lint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep"}
 FAILED=""
 
 for s in $STAGES; do
 rc=0
 case $s in
 lint)
-  # fail fast BEFORE burning chip time: jaxlint's exit-code contract
-  # (0 clean / 1 findings / 2 internal) gates the queue on the static
-  # JAX hazards — recompilation captures, host syncs in step loops, ...
-  # The dsin_tpu/ walk includes dsin_tpu/serve/ (the serving subsystem);
-  # tests/test_jaxlint_repo.py pins that coverage.
-  python -m tools.jaxlint dsin_tpu/ tools/ bench.py __graft_entry__.py \
+  # fail fast BEFORE burning chip time: ONE stage, all four rule
+  # families — the per-file JAX hazards (recompilation captures, host
+  # syncs in step loops, ...), the per-file threadlint rules (lock
+  # discipline, guarded fields, blocking calls under locks), the
+  # whole-repo lockgraph pass (interprocedural rank inversions,
+  # blocking/guarded reachability), and the whole-repo contracts pass
+  # (policy purity, precision wall, typed raises, registry drift).
+  # Default invocation == all families, so no flags; the emit flags
+  # regenerate both committed audit artifacts so a hierarchy or
+  # contract change in this run shows up as a lockgraph.json /
+  # contracts.json diff (tests/test_lockgraph_repo.py and
+  # tests/test_contracts_repo.py pin freshness). The dsin_tpu/ walk
+  # includes dsin_tpu/serve/; tests/test_jaxlint_repo.py pins that
+  # coverage. Runtime halves (ranked-lock inversion checks, typed-error
+  # propagation) are exercised by chaos-smoke right below.
+  python -m tools.jaxlint \
+    --emit-lockgraph artifacts/lockgraph \
+    --emit-contracts artifacts/contracts \
+    dsin_tpu/ tools/ bench.py __graft_entry__.py \
     > artifacts/jaxlint.log 2>&1 || rc=$?
   if [ "$rc" -ne 0 ]; then
     # a dirty tree aborts the whole queue — that is the point of the gate
     cat artifacts/jaxlint.log
     echo "TPU_SESSION_FAILED: lint (queue aborted before chip stages)"
-    exit 1
-  fi
-  ;;
-threadlint)
-  # fail fast: both concurrency families in one stage — the per-file
-  # threadlint rules (lock discipline, guarded fields, blocking calls
-  # under locks, thread-local escapes) AND the whole-repo lockgraph
-  # pass (interprocedural rank inversions, blocking calls and guarded
-  # fields reachable through the call graph). Also regenerates the
-  # committed lock-order artifact so a hierarchy change in this run
-  # shows up as a lockgraph.json diff (tests/test_lockgraph_repo.py
-  # pins freshness). The runtime half (ranked-lock inversion checks)
-  # is exercised by chaos-smoke right below.
-  python -m tools.jaxlint --concurrency --lockgraph \
-    --emit-lockgraph artifacts/lockgraph \
-    dsin_tpu/ tools/ bench.py __graft_entry__.py \
-    > artifacts/threadlint.log 2>&1 || rc=$?
-  if [ "$rc" -ne 0 ]; then
-    cat artifacts/threadlint.log
-    echo "TPU_SESSION_FAILED: threadlint (queue aborted before chip stages)"
     exit 1
   fi
   ;;
@@ -396,7 +389,7 @@ rd_sweep)
     --max_test_images 8 2> artifacts/rd_refgeom.log || rc=$?
   ;;
 *)
-  echo "unknown stage: $s (valid: lint threadlint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep)" >&2
+  echo "unknown stage: $s (valid: lint chaos-smoke hotswap-chaos serve-smoke serve-multidevice entropy-bench frontdoor-bench si-bench quality-smoke autoscale-bench transport-bench federation-bench precision-bench bench checks breakdown mfu rd_sweep)" >&2
   rc=2
   ;;
 esac
